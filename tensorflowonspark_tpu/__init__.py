@@ -1,0 +1,31 @@
+"""tensorflowonspark_tpu — a TPU-native distributed ML framework.
+
+Capability parity with TensorFlowOnSpark (reference:
+``tensorflowonspark/TFCluster.py`` et al. — see SURVEY.md), re-designed
+TPU-first on JAX/XLA: the driver-side cluster API binds executor processes
+onto TPU hosts, data parallelism runs as XLA collectives over ICI/DCN
+(never NCCL), and the queue feed plane batches records into device infeed
+with double-buffered host->HBM prefetch.
+
+Public surface (mirrors the reference's, per SURVEY.md §2):
+
+- :class:`~tensorflowonspark_tpu.cluster.TFCluster` /
+  :func:`~tensorflowonspark_tpu.cluster.run` — driver entry point
+  (reference: ``tensorflowonspark/TFCluster.py :: TFCluster.run``).
+- :class:`~tensorflowonspark_tpu.cluster.InputMode` — SPARK (queue-fed) vs
+  TENSORFLOW (direct file read) input modes.
+- :class:`~tensorflowonspark_tpu.datafeed.DataFeed` — executor-side user API
+  (reference: ``tensorflowonspark/TFNode.py :: DataFeed``).
+- :mod:`~tensorflowonspark_tpu.pipeline` — Estimator/Model ML-pipeline layer
+  (reference: ``tensorflowonspark/pipeline.py``).
+- :mod:`~tensorflowonspark_tpu.dfutil` — TFRecord <-> table interop
+  (reference: ``tensorflowonspark/dfutil.py``).
+
+IMPORTANT import discipline: this top-level module must stay importable in
+processes that must NOT initialize a TPU backend (the feeder/driver
+processes) — so nothing here may import jax at module scope.
+"""
+
+__version__ = "0.1.0"
+
+from tensorflowonspark_tpu.marker import EndFeed, EndPartition, Marker  # noqa: F401

@@ -1,0 +1,148 @@
+"""Executor-side user API for the queue feed plane.
+
+Reference: ``tensorflowonspark/TFNode.py :: DataFeed`` (SURVEY.md §2
+"Executor user API"): the object user ``map_fun`` code uses to pull training
+batches off the input queue, push inference results to the output queue, and
+observe end-of-feed.
+
+TPU-native differences:
+
+- Queue items are *chunks* (lists of records) assembled feeder-side, not
+  single records — see manager.py. ``next_batch`` re-slices chunks to the
+  requested batch size, buffering remainders, so user-visible semantics are
+  unchanged (batches never straddle an ``EndPartition``).
+- With ``input_mapping``, ``next_batch`` returns columns stacked as numpy
+  arrays (ready for ``jax.device_put``), not python lists.
+- ``numpy_batches()`` is an infinite-batch generator suitable for wrapping
+  in a prefetching infeed (see infeed.py) — the analog of the reference's
+  ``tf.data.Dataset.from_generator(DataFeed...)`` idiom.
+"""
+
+import logging
+
+import numpy as np
+
+from tensorflowonspark_tpu.marker import EndFeed, EndPartition, Marker
+
+logger = logging.getLogger(__name__)
+
+
+class DataFeed(object):
+    """Pull batches from / push results to this node's queue broker.
+
+    Args mirror the reference: ``mgr`` (a ``ManagerClient``), ``train_mode``
+    (True = no output queue), ``qname_in``/``qname_out``, ``input_mapping``
+    (ordered {record_field -> name}; when set, batches are dicts of stacked
+    numpy arrays keyed by the mapped names).
+    """
+
+    def __init__(self, mgr, train_mode=True, qname_in="input", qname_out="output",
+                 input_mapping=None):
+        self.mgr = mgr
+        self.train_mode = train_mode
+        self.qname_in = qname_in
+        self.qname_out = qname_out
+        self.input_mapping = dict(input_mapping) if input_mapping else None
+        self.input_tensors = list(input_mapping.values()) if input_mapping else None
+        self.done_feeding = False
+        self._queue_in = mgr.get_queue(qname_in)
+        self._queue_out = None if train_mode else mgr.get_queue(qname_out)
+        self._pending = []  # remainder of a partially-consumed chunk
+
+    def next_batch(self, batch_size):
+        """Next batch of up to ``batch_size`` records.
+
+        Blocks until data arrives. Returns a short (possibly empty) batch at
+        an ``EndPartition`` boundary or at end-of-feed; after end-of-feed,
+        ``should_stop()`` is True and subsequent calls return empty batches.
+
+        Reference: ``TFNode.DataFeed.next_batch`` — same contract, including
+        ``task_done`` accounting per queue item so the feeder's
+        ``queue.join()`` unblocks once the partition is consumed.
+        """
+        batch = []
+        while len(batch) < batch_size:
+            take = batch_size - len(batch)
+            if self._pending:
+                batch.extend(self._pending[:take])
+                self._pending = self._pending[take:]
+                continue
+            if self.done_feeding:
+                break
+            item = self._queue_in.get(block=True)
+            if isinstance(item, Marker):
+                self._queue_in.task_done()
+                if isinstance(item, EndFeed):
+                    self.done_feeding = True
+                if isinstance(item, (EndPartition, EndFeed)) and batch:
+                    break
+                if isinstance(item, EndFeed):
+                    break
+                continue  # EndPartition with empty batch: keep reading
+            chunk = item if isinstance(item, list) else [item]
+            self._pending.extend(chunk)
+            self._queue_in.task_done()
+        if self.input_tensors is None:
+            return batch
+        return self._stack_columns(batch)
+
+    def _stack_columns(self, batch):
+        """Stack records column-wise into {mapped_name: np.ndarray}."""
+        cols = {name: [] for name in self.input_tensors}
+        fields = list(self.input_mapping.keys())
+        for rec in batch:
+            if isinstance(rec, dict):
+                values = [rec[k] for k in fields]
+            else:
+                values = list(rec)
+            for name, v in zip(self.input_tensors, values):
+                cols[name].append(v)
+        return {name: np.asarray(vs) for name, vs in cols.items()}
+
+    def numpy_batches(self, batch_size):
+        """Generator of non-empty batches until end-of-feed.
+
+        The TPU-idiomatic consumption loop: wrap in ``infeed.prefetch`` to
+        overlap host->HBM transfer with the device step.
+        """
+        while not self.should_stop():
+            batch = self.next_batch(batch_size)
+            size = len(batch) if self.input_tensors is None else \
+                (len(next(iter(batch.values()))) if batch else 0)
+            if size == 0:
+                continue
+            yield batch
+
+    def should_stop(self):
+        """True once the feed has ended (reference: ``DataFeed.should_stop``)."""
+        return self.done_feeding and not self._pending
+
+    def batch_results(self, results):
+        """Push a batch of inference results to the output queue.
+
+        Reference: ``DataFeed.batch_results``. The node runtime counts
+        records in vs. records out per partition, so results must be pushed
+        1:1 with consumed records (order preserved).
+        """
+        if self._queue_out is None:
+            raise RuntimeError("batch_results() requires train_mode=False")
+        self._queue_out.put(list(results), block=True)
+
+    def terminate(self):
+        """Signal termination and drain the input queue so feeders unblock.
+
+        Reference: ``DataFeed.terminate`` — sets state='terminating' and
+        consumes (with ``task_done``) whatever the feeders already queued.
+        """
+        logger.info("DataFeed terminating: draining input queue")
+        self.mgr.set("state", "terminating")
+        self.done_feeding = True
+        count = 0
+        while True:
+            try:
+                self._queue_in.get(block=True, timeout=1.0)
+                self._queue_in.task_done()
+                count += 1
+            except Exception:  # queue.Empty via proxy
+                break
+        logger.info("DataFeed terminate drained %d items", count)

@@ -1,0 +1,145 @@
+"""Per-executor queue broker: the process-boundary bridge of the feed plane.
+
+Reference: ``tensorflowonspark/TFManager.py`` (SURVEY.md §2 "Queue broker"):
+a ``multiprocessing.managers.BaseManager`` serving one joinable queue per
+canonical name ('input', 'output', 'error') plus a shared k/v dict (cluster
+state machine: 'running' | 'terminating' | 'stopped'), authkey-protected,
+bound to localhost ('local' mode) or the executor's routable IP ('remote'
+mode, for engines that run worker processes on other hosts).
+
+This broker bridges the *feeder* process (runs data tasks, owns no TPU) and
+the *trainer* process (runs the user map_fun, owns the TPU). TPU-native
+throughput fix (SURVEY.md §7.3 "Feed throughput"): queue items are batches
+(lists of records), assembled feeder-side — the reference's per-record
+manager-proxy round trip is its known bottleneck and is deliberately not
+reproduced. The manager proxy then costs one round trip per *chunk*, and
+``DataFeed`` re-slices chunks to the requested batch size.
+"""
+
+import logging
+import queue as _queue
+import threading
+from multiprocessing.managers import BaseManager
+
+logger = logging.getLogger(__name__)
+
+# Canonical queue names (reference: TFSparkNode.run's `queues` default).
+QUEUES_TRAIN = ["input", "error"]
+QUEUES_INFERENCE = ["input", "output", "error"]
+
+
+class _KV(object):
+    """Lock-protected k/v store (cluster state machine + endpoint info)."""
+
+    def __init__(self):
+        self._d = {}
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            return self._d.get(key)
+
+    def set(self, key, value):
+        with self._lock:
+            self._d[key] = value
+
+
+class _ManagerBase(BaseManager):
+    pass
+
+
+class ManagerClient(object):
+    """Handle to a broker, same API surface as the reference's TFManager.
+
+    ``get_queue(name)`` returns a shared joinable-queue proxy (put/get/
+    task_done/join/qsize/empty all forwarded); ``get``/``set`` hit the shared
+    k/v store. Proxies are cached per name — manager round trips are per
+    *operation*, not per lookup.
+    """
+
+    def __init__(self, mgr, address, authkey):
+        self._mgr = mgr
+        self.address = tuple(address)
+        self.authkey = authkey
+        self._kv = None
+        self._qcache = {}
+        self._lock = threading.Lock()
+
+    def get_queue(self, qname):
+        with self._lock:
+            if qname not in self._qcache:
+                self._qcache[qname] = self._mgr.get_queue(qname)
+            return self._qcache[qname]
+
+    def _kv_proxy(self):
+        with self._lock:
+            if self._kv is None:
+                self._kv = self._mgr.get_kv()
+            return self._kv
+
+    def get(self, key):
+        return self._kv_proxy().get(key)
+
+    def set(self, key, value):
+        return self._kv_proxy().set(key, value)
+
+
+def start(authkey, queues, mode="local", host=None):
+    """Start a broker server in a daemon thread of *this* process.
+
+    Returns a connected :class:`ManagerClient` (``.address`` is the
+    endpoint to publish via the reservation meta). Reference:
+    ``TFManager.start(authkey, queues, mode)``.
+
+    The reference spawns the manager as a forked server process so it
+    survives Spark's python-worker recycling; our engine's executor
+    processes are long-lived, so a daemon server thread suffices and dies
+    with the node — one less orphan to reap on task retry.
+    """
+    qdict = {name: _queue.Queue() for name in queues}
+    kv = _KV()
+    kv.set("state", "running")
+
+    class _Server(_ManagerBase):
+        pass
+
+    # Registered callables return *proxies* to server-held objects — exactly
+    # right for the shared queues and the kv store. Value-returning calls
+    # (kv.get) happen as proxy *method* calls, which return real values.
+    _Server.register("get_queue", callable=lambda qname: qdict[qname])
+    _Server.register("get_kv", callable=lambda: kv)
+
+    if mode == "remote":
+        if host is None:
+            from tensorflowonspark_tpu.util import get_ip_address
+            host = get_ip_address()
+        address = (host, 0)
+    else:
+        address = ("127.0.0.1", 0)
+
+    mgr = _Server(address=address, authkey=authkey)
+    server = mgr.get_server()
+    threading.Thread(target=server.serve_forever, name="tfmanager-server",
+                     daemon=True).start()
+    # get_server() binds immediately, so server.address is final here.
+    client = connect(server.address, authkey)
+    logger.info("queue broker listening at %s (mode=%s)", server.address, mode)
+    return client
+
+
+def connect(address, authkey):
+    """Connect to a broker from a sibling process.
+
+    Reference: ``TFManager.connect(addr, authkey)``. Callers in freshly
+    spawned processes must first set
+    ``multiprocessing.current_process().authkey`` (the node runtime does).
+    """
+
+    class _Client(_ManagerBase):
+        pass
+
+    _Client.register("get_queue")
+    _Client.register("get_kv")
+    mgr = _Client(address=tuple(address), authkey=authkey)
+    mgr.connect()
+    return ManagerClient(mgr, address, authkey)

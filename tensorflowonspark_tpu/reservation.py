@@ -1,0 +1,292 @@
+"""Cluster-formation barrier + service discovery (the control plane).
+
+Reference: ``tensorflowonspark/reservation.py`` (SURVEY.md §2 "Reservation
+service"): a zero-dependency TCP rendezvous hosted on the driver. Every
+executor registers its node metadata (host, ports, authkey, role); everyone
+blocks until exactly N registrations exist; then every node can fetch the
+full cluster_info list. Message types REG / QUERY / QINFO / STOP.
+
+TPU-native differences from the reference's design:
+
+- Wire format is length-prefixed JSON, not pickle: registration messages
+  cross trust boundaries (any process that can reach the port), and the
+  driver must never unpickle executor-supplied bytes. Binary fields
+  (authkeys) travel hex-encoded.
+- The barrier's output doubles as the *JAX coordination bootstrap*: once all
+  N nodes are registered, node metas are sorted deterministically and the
+  chief's (host, coordinator_port) becomes the
+  ``jax.distributed.initialize`` coordinator address — the piece
+  ``TF_CONFIG`` provided in the reference.
+"""
+
+import json
+import logging
+import socket
+import struct
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+#: Default seconds to wait for all nodes to register (reference default 600).
+DEFAULT_TIMEOUT = 600
+
+_LEN = struct.Struct(">I")
+_MAX_MSG = 16 * 1024 * 1024
+
+
+class TimeoutError_(RuntimeError):
+    """Barrier did not complete within the timeout."""
+
+
+class Reservations(object):
+    """Thread-safe registry counting up to ``required`` node registrations.
+
+    Reference: ``reservation.Reservations`` — lock-protected list + count.
+    """
+
+    def __init__(self, required):
+        self.required = required
+        self._lock = threading.Condition()
+        self._meta = []
+
+    def add(self, meta):
+        """Register one node; a re-registration (retried worker) with the
+        same executor_id *replaces* the stale entry — it must not double
+        count, or the barrier opens early and the sorted-index == process-
+        index contract breaks."""
+        with self._lock:
+            eid = meta.get("executor_id")
+            for i, m in enumerate(self._meta):
+                if eid is not None and m.get("executor_id") == eid:
+                    self._meta[i] = meta
+                    break
+            else:
+                self._meta.append(meta)
+            if self.done():
+                self._lock.notify_all()
+
+    def done(self):
+        return len(self._meta) >= self.required
+
+    def get(self):
+        with self._lock:
+            return list(self._meta)
+
+    def remaining(self):
+        with self._lock:
+            return self.required - len(self._meta)
+
+    def wait(self, timeout=DEFAULT_TIMEOUT):
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while not self.done():
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError_(
+                        "timed out waiting for {} of {} node reservations".format(
+                            self.required - len(self._meta), self.required))
+                self._lock.wait(left)
+            return list(self._meta)
+
+
+class MessageSocket(object):
+    """Length-prefixed JSON messages over a stream socket.
+
+    Reference: ``reservation.MessageSocket`` (which framed *pickled* payloads
+    — deliberately not reproduced; see module docstring).
+    """
+
+    def __init__(self, sock):
+        self.sock = sock
+
+    def send(self, msg):
+        data = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+        self.sock.sendall(_LEN.pack(len(data)) + data)
+
+    def receive(self):
+        header = self._recv_exact(_LEN.size)
+        (length,) = _LEN.unpack(header)
+        if length > _MAX_MSG:
+            raise ValueError("reservation message too large: {} bytes".format(length))
+        return json.loads(self._recv_exact(length).decode("utf-8"))
+
+    def _recv_exact(self, n):
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("reservation peer closed connection")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def close(self):
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+
+class Server(object):
+    """Driver-hosted rendezvous server.
+
+    Reference: ``reservation.Server`` — ``start()`` binds an ephemeral port,
+    a background thread serves REG/QUERY/QINFO/STOP until stopped.
+    """
+
+    def __init__(self, count):
+        self.reservations = Reservations(count)
+        self._sock = None
+        self._thread = None
+        self.done = threading.Event()
+
+    def start(self, host=None):
+        """Bind and serve in the background; returns (host, port)."""
+        if host is None:
+            from tensorflowonspark_tpu.util import get_ip_address
+            host = get_ip_address()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # Bind the wildcard so both loopback (local tests) and the routable
+        # interface (real executors) can connect; advertise the routable host.
+        self._sock.bind(("", 0))
+        self._sock.listen(64)
+        port = self._sock.getsockname()[1]
+        self.addr = (host, port)
+        self._thread = threading.Thread(target=self._serve, name="reservation-server",
+                                        daemon=True)
+        self._thread.start()
+        logger.info("reservation server listening at %s", self.addr)
+        return self.addr
+
+    def _serve(self):
+        while not self.done.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break  # listening socket closed by stop()
+            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+
+    def _handle(self, conn):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        ms = MessageSocket(conn)
+        try:
+            while not self.done.is_set():
+                msg = ms.receive()
+                mtype = msg.get("type")
+                if mtype == "REG":
+                    self.reservations.add(msg["meta"])
+                    ms.send({"type": "OK"})
+                elif mtype == "QUERY":
+                    ms.send({"type": "STATE", "done": self.reservations.done()})
+                elif mtype == "QINFO":
+                    ms.send({"type": "INFO", "meta": self.reservations.get(),
+                             "done": self.reservations.done()})
+                elif mtype == "STOP":
+                    self.done.set()
+                    self._close_listener()  # unblock _serve's accept()
+                    ms.send({"type": "OK"})
+                else:
+                    ms.send({"type": "ERR", "error": "unknown type {!r}".format(mtype)})
+        except (ConnectionError, ValueError, OSError):
+            pass
+        finally:
+            ms.close()
+
+    def await_reservations(self, timeout=DEFAULT_TIMEOUT, status=None):
+        """Block until all N nodes registered; returns sorted cluster_info.
+
+        ``status`` is an optional zero-arg callable polled for early-abort
+        (the reference passes the SparkContext to notice cancelled jobs).
+        """
+        deadline = time.monotonic() + timeout
+        while not self.reservations.done():
+            if status is not None:
+                status()
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise TimeoutError_(
+                    "timed out waiting for {} node registrations".format(
+                        self.reservations.remaining()))
+            try:
+                self.reservations.wait(min(left, 1.0))
+            except TimeoutError_:
+                continue
+        return sort_cluster_info(self.reservations.get())
+
+    def _close_listener(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def stop(self):
+        self.done.set()
+        self._close_listener()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class Client(object):
+    """Executor-side client of the rendezvous server.
+
+    Reference: ``reservation.Client`` — one persistent connection; register,
+    poll until the barrier opens, fetch the full node list.
+    """
+
+    def __init__(self, server_addr):
+        self.server_addr = tuple(server_addr)
+        sock = socket.create_connection(self.server_addr, timeout=30)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(None)
+        self._ms = MessageSocket(sock)
+        self._lock = threading.Lock()
+
+    def _call(self, msg):
+        with self._lock:
+            self._ms.send(msg)
+            return self._ms.receive()
+
+    def register(self, meta):
+        resp = self._call({"type": "REG", "meta": meta})
+        if resp.get("type") != "OK":
+            raise RuntimeError("registration rejected: {!r}".format(resp))
+
+    def get_reservations(self):
+        return sort_cluster_info(self._call({"type": "QINFO"})["meta"])
+
+    def await_reservations(self, timeout=DEFAULT_TIMEOUT, poll_interval=0.1):
+        """Poll until all nodes registered; returns sorted cluster_info."""
+        deadline = time.monotonic() + timeout
+        while True:
+            # Cheap QUERY while waiting (O(1) reply); one QINFO at the end —
+            # N clients polling full metas would be O(N^2) on the driver.
+            resp = self._call({"type": "QUERY"})
+            if resp.get("done"):
+                return sort_cluster_info(self._call({"type": "QINFO"})["meta"])
+            if time.monotonic() > deadline:
+                raise TimeoutError_("timed out awaiting cluster reservations")
+            time.sleep(poll_interval)
+            # back off gently to keep the driver's accept loop unloaded
+            poll_interval = min(poll_interval * 1.5, 2.0)
+
+    def request_stop(self):
+        try:
+            self._call({"type": "STOP"})
+        except (ConnectionError, OSError):
+            pass
+
+    def close(self):
+        self._ms.close()
+
+
+def sort_cluster_info(meta_list):
+    """Deterministic node ordering: by executor_id (every view identical).
+
+    The sorted list is the framework's ``cluster_spec`` analog: index in the
+    sorted list == JAX process index; entry 0's host/port is the
+    coordination-service address (SURVEY.md §2.4 plane 1).
+    """
+    return sorted(meta_list, key=lambda m: m.get("executor_id", 0))

@@ -1,0 +1,93 @@
+"""Small host/process utilities.
+
+Reference: ``tensorflowonspark/util.py`` (SURVEY.md §2 "Misc util"):
+``get_ip_address`` (UDP-connect trick), ``find_in_path``,
+``single_node_env``, ``write_executor_id``/``read_executor_id``.
+
+The executor-id persistence trick matters here exactly as it does in the
+reference: a re-launched worker process (task retry) must keep the same
+node ordinal, because TPU-host binding and the queue-broker endpoint are
+keyed on it.
+"""
+
+import errno
+import logging
+import os
+import socket
+
+logger = logging.getLogger(__name__)
+
+EXECUTOR_ID_FILE = "executor_id"
+
+
+def get_ip_address():
+    """Routable IP of this host (UDP-connect trick; no packets are sent)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        # No route (air-gapped test env): localhost is the right answer there.
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def find_free_port(host=""):
+    """Reserve an ephemeral TCP port and return it (socket is closed).
+
+    Mirrors the reference's port-reservation in ``TFSparkNode.run`` (bind
+    port 0, publish via reservation, then hand it to the server). There is a
+    tiny close->rebind race window, same as the reference accepts.
+    """
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def find_in_path(path, file_name):
+    """Find a file in a ':'-separated search path; '' if absent."""
+    for p in path.split(os.pathsep):
+        candidate = os.path.join(p, file_name)
+        if os.path.exists(candidate) and os.path.isfile(candidate):
+            return candidate
+    return ""
+
+
+def write_executor_id(num, cwd=None):
+    """Persist this worker's node ordinal in its working dir.
+
+    Reference: ``util.write_executor_id`` — Spark may recycle python workers;
+    the ordinal must survive so a re-launched worker keeps its identity.
+    """
+    path = os.path.join(cwd or os.getcwd(), EXECUTOR_ID_FILE)
+    with open(path, "w") as f:
+        f.write(str(num))
+
+
+def read_executor_id(cwd=None):
+    """Read the persisted node ordinal, or None if never written."""
+    path = os.path.join(cwd or os.getcwd(), EXECUTOR_ID_FILE)
+    try:
+        with open(path) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError) as e:
+        if isinstance(e, OSError) and e.errno not in (errno.ENOENT,):
+            raise
+        return None
+
+
+def single_node_env(num_devices=1):
+    """Environment setup for a non-cluster single-node run.
+
+    Reference: ``util.single_node_env`` (GPU pinning via CUDA_VISIBLE_DEVICES
+    for standalone runs). TPU-native: nothing to pin — the host's chips
+    belong to whichever single process initializes the runtime — but we keep
+    host-side BLAS threads bounded so feeder processes don't fight the
+    device-owning process for cores.
+    """
+    os.environ.setdefault("OMP_NUM_THREADS", "1")
+    os.environ.setdefault("OPENBLAS_NUM_THREADS", "1")

@@ -1,0 +1,19 @@
+"""Test fixtures: hermetic multi-device JAX on CPU.
+
+SURVEY.md §4 carry-over: the reference tests multi-node for real on one
+machine (Spark ``local-cluster[N,...]``); our analog is JAX on a virtual
+8-device CPU platform (``--xla_force_host_platform_device_count``), set
+BEFORE any jax import anywhere in the test process.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("TFOS_TPU_TEST_MODE", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

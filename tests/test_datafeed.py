@@ -1,0 +1,110 @@
+"""DataFeed semantics tests.
+
+Reference analog: ``tests/test_TFNode.py`` (SURVEY.md §4) — batching,
+EndPartition handling, should_stop, terminate drain — against a locally
+started broker.
+"""
+
+import numpy as np
+
+from tensorflowonspark_tpu import manager
+from tensorflowonspark_tpu.datafeed import DataFeed
+from tensorflowonspark_tpu.marker import EndFeed, EndPartition
+
+
+def _mgr(queues=("input", "output", "error")):
+    return manager.start(b"feedkey", list(queues))
+
+
+def test_next_batch_reslices_chunks():
+    mgr = _mgr()
+    q = mgr.get_queue("input")
+    q.put([1, 2, 3, 4, 5])  # one chunk of 5
+    q.put([6, 7])
+    q.put(EndFeed())
+    feed = DataFeed(mgr, train_mode=True)
+    assert feed.next_batch(3) == [1, 2, 3]
+    assert feed.next_batch(3) == [4, 5, 6]
+    assert feed.next_batch(3) == [7]
+    assert feed.should_stop()
+    assert feed.next_batch(3) == []
+
+
+def test_end_partition_short_batch():
+    mgr = _mgr()
+    q = mgr.get_queue("input")
+    q.put([1, 2])
+    q.put(EndPartition())
+    q.put([3, 4, 5])
+    q.put(EndFeed())
+    feed = DataFeed(mgr, train_mode=True)
+    assert feed.next_batch(4) == [1, 2]  # short at partition boundary
+    assert not feed.should_stop()
+    assert feed.next_batch(4) == [3, 4, 5]
+    assert feed.should_stop()
+
+
+def test_feeder_join_unblocks_after_consumption():
+    mgr = _mgr()
+    q = mgr.get_queue("input")
+    q.put([10, 20])
+    q.put(EndPartition())
+    feed = DataFeed(mgr, train_mode=True)
+    assert feed.next_batch(2) == [10, 20]
+    assert feed.next_batch(0) == []  # a zero-size poll doesn't consume markers
+    # EndPartition is still queued; next_batch(1) will block on more data, so
+    # push EndFeed then confirm join() returns (all task_done called).
+    q.put(EndFeed())
+    assert feed.next_batch(1) == []
+    q.join()
+
+
+def test_input_mapping_stacks_numpy_columns():
+    mgr = _mgr()
+    q = mgr.get_queue("input")
+    q.put([(np.zeros(4), 0), (np.ones(4), 1)])
+    q.put(EndFeed())
+    feed = DataFeed(mgr, train_mode=True,
+                    input_mapping={"image_col": "image", "label_col": "label"})
+    batch = feed.next_batch(2)
+    assert set(batch) == {"image", "label"}
+    assert batch["image"].shape == (2, 4)
+    np.testing.assert_array_equal(batch["label"], [0, 1])
+
+
+def test_numpy_batches_generator():
+    mgr = _mgr()
+    q = mgr.get_queue("input")
+    q.put([1, 2, 3])
+    q.put(EndPartition())
+    q.put([4])
+    q.put(EndFeed())
+    feed = DataFeed(mgr, train_mode=True)
+    batches = list(feed.numpy_batches(2))
+    assert batches == [[1, 2], [3], [4]]
+
+
+def test_batch_results_and_terminate():
+    mgr = _mgr()
+    feed = DataFeed(mgr, train_mode=False)
+    feed.batch_results(["a", "b"])
+    assert mgr.get_queue("output").get() == ["a", "b"]
+    # terminate drains whatever feeders queued and flips the state machine
+    mgr.get_queue("input").put([1, 2])
+    mgr.get_queue("input").put([3])
+    feed.terminate()
+    assert mgr.get("state") == "terminating"
+    mgr.get_queue("input").join()  # drained items were task_done'd
+    assert feed.should_stop()
+
+
+def test_input_mapping_dict_records_use_field_names():
+    mgr = _mgr()
+    q = mgr.get_queue("input")
+    q.put([{"image_col": np.zeros(3), "label_col": 7}])
+    q.put(EndFeed())
+    feed = DataFeed(mgr, train_mode=True,
+                    input_mapping={"image_col": "image", "label_col": "label"})
+    batch = feed.next_batch(1)
+    assert batch["image"].shape == (1, 3)
+    assert batch["label"][0] == 7

@@ -1,0 +1,47 @@
+"""Queue broker tests (reference analog: TFManager usage in tests/test_TFNode.py).
+
+Covers: same-process client, cross-process connect with authkey, joinable
+queue semantics (join unblocks after task_done), k/v state machine.
+"""
+
+import multiprocessing
+
+from tensorflowonspark_tpu import manager
+
+
+def test_same_process_queue_and_kv():
+    mgr = manager.start(b"key1", ["input", "output", "error"])
+    q = mgr.get_queue("input")
+    q.put([1, 2, 3])
+    assert q.get() == [1, 2, 3]
+    q.task_done()
+    q.join()  # all consumed -> returns immediately
+    assert mgr.get("state") == "running"
+    mgr.set("state", "terminating")
+    assert mgr.get("state") == "terminating"
+
+
+def _child(address, authkey_hex):
+    authkey = bytes.fromhex(authkey_hex)
+    multiprocessing.current_process().authkey = authkey
+    mgr = manager.connect(tuple(address), authkey)
+    q = mgr.get_queue("input")
+    item = q.get()
+    q.task_done()
+    out = mgr.get_queue("output")
+    out.put([x * 2 for x in item])
+    mgr.set("state", "done")
+
+
+def test_cross_process_connect():
+    authkey = b"\x01\x02secret"
+    mgr = manager.start(authkey, ["input", "output"])
+    ctx = multiprocessing.get_context("spawn")
+    p = ctx.Process(target=_child, args=(list(mgr.address), authkey.hex()))
+    p.start()
+    mgr.get_queue("input").put([1, 2, 3])
+    mgr.get_queue("input").join()  # child consumed it
+    assert mgr.get_queue("output").get(timeout=30) == [2, 4, 6]
+    p.join(timeout=30)
+    assert p.exitcode == 0
+    assert mgr.get("state") == "done"

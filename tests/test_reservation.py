@@ -1,0 +1,88 @@
+"""Reservation barrier tests.
+
+Reference test analog: ``tests/test_reservation.py`` (SURVEY.md §4) —
+Server(n) + n threaded Client.register -> await returns all metas; timeout
+raises; request_stop stops the server.
+"""
+
+import threading
+
+import pytest
+
+from tensorflowonspark_tpu import reservation
+
+
+def _meta(i):
+    return {"executor_id": i, "host": "127.0.0.1", "port": 6000 + i,
+            "authkey": "%02x" % i}
+
+
+def test_barrier_completes_with_threaded_clients():
+    n = 3
+    server = reservation.Server(n)
+    addr = server.start(host="127.0.0.1")
+
+    def register(i):
+        c = reservation.Client(addr)
+        c.register(_meta(i))
+        got = c.await_reservations(timeout=10)
+        assert len(got) == n
+        c.close()
+
+    threads = [threading.Thread(target=register, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    info = server.await_reservations(timeout=10)
+    for t in threads:
+        t.join(timeout=10)
+    assert [m["executor_id"] for m in info] == [0, 1, 2]  # sorted, complete
+    assert info[1]["port"] == 6001
+    server.stop()
+
+
+def test_await_timeout_raises():
+    server = reservation.Server(2)
+    addr = server.start(host="127.0.0.1")
+    c = reservation.Client(addr)
+    c.register(_meta(0))  # only 1 of 2
+    with pytest.raises(reservation.TimeoutError_):
+        server.await_reservations(timeout=0.5)
+    with pytest.raises(reservation.TimeoutError_):
+        c.await_reservations(timeout=0.5)
+    c.close()
+    server.stop()
+
+
+def test_client_query_and_stop():
+    server = reservation.Server(1)
+    addr = server.start(host="127.0.0.1")
+    c = reservation.Client(addr)
+    c.register(_meta(7))
+    got = c.get_reservations()
+    assert got == [_meta(7)]
+    c.request_stop()
+    assert server.done.is_set()
+    c.close()
+    server.stop()
+
+
+def test_sort_cluster_info_is_deterministic():
+    metas = [_meta(2), _meta(0), _meta(1)]
+    assert [m["executor_id"] for m in reservation.sort_cluster_info(metas)] == [0, 1, 2]
+
+
+def test_reregistration_replaces_not_duplicates():
+    server = reservation.Server(2)
+    addr = server.start(host="127.0.0.1")
+    c = reservation.Client(addr)
+    meta0 = _meta(0)
+    c.register(meta0)
+    retry = dict(meta0, port=9999)  # relaunched worker, same ordinal
+    c.register(retry)
+    assert server.reservations.remaining() == 1  # still waiting for node 1
+    c.register(_meta(1))
+    info = server.await_reservations(timeout=5)
+    assert [m["executor_id"] for m in info] == [0, 1]
+    assert info[0]["port"] == 9999  # the retry's meta won
+    c.close()
+    server.stop()

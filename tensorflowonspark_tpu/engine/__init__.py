@@ -1,0 +1,21 @@
+"""Spark-shaped execution engine (the substrate the reference borrowed from Spark).
+
+SURVEY.md §7 "Environment reality check": pyspark is not in the image, so
+the framework supplies its own driver/executor engine with a Spark-
+compatible *shape* — an RDD with partitions, closure-shipping tasks,
+async partition jobs, and driver-visible task errors — sized to what the
+cluster layer (cluster.py / node.py) actually needs. If real pyspark
+appears later, a thin adapter can swap in underneath cluster.py, whose
+surface deliberately mirrors ``TFCluster.run(sc, ...)``.
+
+Pieces:
+- :mod:`~tensorflowonspark_tpu.engine.rdd` — lazy partitioned collections.
+- :mod:`~tensorflowonspark_tpu.engine.executor` — executor process main
+  loop (connects back to the driver, runs tasks serially like a 1-core
+  Spark executor).
+- :mod:`~tensorflowonspark_tpu.engine.context` — driver context: spawns /
+  accepts executors, schedules tasks, surfaces errors.
+"""
+
+from tensorflowonspark_tpu.engine.context import Context  # noqa: F401
+from tensorflowonspark_tpu.engine.rdd import RDD  # noqa: F401

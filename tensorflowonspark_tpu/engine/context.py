@@ -1,0 +1,410 @@
+"""Driver context: owns executors, schedules tasks, surfaces errors.
+
+The Spark-role substrate (SURVEY.md §1 "load-bearing third-party
+substrate"): process placement, task dispatch, and error aggregation for
+the cluster layer above. Local mode spawns executor processes itself;
+standalone mode (``spawn_local=False``) just listens and lets a launcher
+start ``python -m tensorflowonspark_tpu.engine.executor`` on each host —
+the ``spark-submit``-shaped path.
+
+Deliberate semantic carried over from Spark: a failed task fails the job
+and the error (with the executor-side traceback) re-raises on the driver
+when the job result is awaited — the reference's error-propagation story
+(SURVEY.md §3.5) depends on exactly this.
+"""
+
+import logging
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+from multiprocessing.connection import Listener
+
+from tensorflowonspark_tpu.engine import serializer
+from tensorflowonspark_tpu.engine.rdd import RDD, _Partition
+
+logger = logging.getLogger(__name__)
+
+_STOP = object()
+
+
+class TaskError(RuntimeError):
+    """A task failed on an executor; message carries the remote traceback."""
+
+
+class AsyncResult(object):
+    """Handle to a running job (analog of Spark's ASyncRDDActions result)."""
+
+    def __init__(self, num_tasks):
+        self._results = [None] * num_tasks
+        self._pending = num_tasks
+        self._errors = []
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+
+    def _complete(self, task_id, value):
+        with self._lock:
+            self._results[task_id] = value
+            self._pending -= 1
+            if self._pending == 0:
+                self._done.set()
+
+    def _fail(self, task_id, error):
+        with self._lock:
+            self._errors.append((task_id, error))
+            self._pending -= 1
+            if self._pending == 0:
+                self._done.set()
+
+    def done(self):
+        return self._done.is_set()
+
+    def successful(self):
+        return self._done.is_set() and not self._errors
+
+    def get(self, timeout=None):
+        """Block for completion; re-raise the first task error if any."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("job did not complete within {}s".format(timeout))
+        if self._errors:
+            task_id, error = self._errors[0]
+            raise TaskError("task {} failed: {}".format(task_id, error))
+        return list(self._results)
+
+
+class _ExecutorHandle(object):
+    """Driver-side mirror of one executor: its connection + dispatch thread."""
+
+    def __init__(self, ctx, conn, meta):
+        self.ctx = ctx
+        self.conn = conn
+        self.executor_id = meta["executor_id"]
+        self.meta = meta
+        self.own_queue = queue.Queue()
+        self.alive = True
+        self.conn_broken = False
+        self.thread = threading.Thread(
+            target=self._loop, name="executor-handle-%d" % self.executor_id,
+            daemon=True)
+        self.thread.start()
+
+    def _next_task(self):
+        """Prefer pinned tasks, else pull from the shared pool."""
+        while self.alive and not self.ctx._stopping.is_set():
+            try:
+                return self.own_queue.get(timeout=0.05)
+            except queue.Empty:
+                pass
+            try:
+                return self.ctx._shared_tasks.get(timeout=0.05)
+            except queue.Empty:
+                continue
+        return _STOP
+
+    def _loop(self):
+        task = None
+        try:
+            while True:
+                task = self._next_task()
+                if task is _STOP:
+                    break
+                self.conn.send({"type": "task", "job_id": task["job_id"],
+                                "task_id": task["task_id"], "func": task["func"],
+                                "payload": task["payload"]})
+                reply = self.conn.recv()
+                result = task["result"]
+                if reply.get("ok"):
+                    result._complete(task["task_id"],
+                                     serializer.loads(reply["value"]))
+                else:
+                    result._fail(task["task_id"],
+                                 reply.get("traceback") or reply.get("error"))
+                task = None
+        except (EOFError, OSError, BrokenPipeError) as e:
+            logger.error("executor %d connection lost: %s", self.executor_id, e)
+            self.conn_broken = True
+            if task is not None and task is not _STOP:
+                task["result"]._fail(
+                    task["task_id"],
+                    "executor {} died while running task (connection lost: {})"
+                    .format(self.executor_id, e))
+            self.alive = False
+            self.ctx._on_handle_dead(self)
+        finally:
+            self.alive = False
+
+    def send_stop(self):
+        self.own_queue.put(_STOP)
+
+    def close(self):
+        try:
+            if not self.conn_broken:
+                self.conn.send({"type": "stop"})
+                # Only await the bye reply if our dispatch thread has exited:
+                # a Connection must not be recv()'d from two threads, and a
+                # still-alive thread may be blocked in recv on a long task.
+                if not self.thread.is_alive() and self.conn.poll(5):
+                    self.conn.recv()
+        except (EOFError, OSError, BrokenPipeError):
+            pass
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+class Context(object):
+    """Driver entry point (the ``sc`` the cluster API takes).
+
+    Args:
+      num_executors: world size (fixed, like the reference's).
+      spawn_local: spawn executor subprocesses on this host (local mode);
+        False = standalone mode, wait for externally launched executors.
+      executor_env: extra env vars for spawned executors.
+      work_root: scratch root; each executor gets work_root/executor-N as
+        its cwd (the executor-id persistence dir, SURVEY.md util row).
+      host: address to listen on (default loopback — local mode).
+    """
+
+    def __init__(self, num_executors, spawn_local=True, executor_env=None,
+                 work_root=None, host="127.0.0.1", app_name="tfos-tpu",
+                 start_timeout=120):
+        self.num_executors = num_executors
+        self.app_name = app_name
+        self.authkey = os.urandom(20)
+        self.work_root = work_root or os.path.join(
+            os.getcwd(), ".tfos-{}-{}".format(app_name, os.getpid()))
+        os.makedirs(self.work_root, exist_ok=True)
+        self._listener = Listener((host, 0), authkey=self.authkey)
+        self.driver_addr = self._listener.address
+        self._handles = {}
+        self._procs = []
+        self._shared_tasks = queue.Queue()
+        self._stopping = threading.Event()
+        self._job_counter = 0
+        self._lock = threading.Lock()
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               name="engine-accept", daemon=True)
+        self._accept_thread.start()
+        # Connection info lands on disk BEFORE we block waiting for
+        # executors, so standalone-mode launchers can read it and start
+        # `python -m tensorflowonspark_tpu.engine.executor` on each host.
+        self.authkey_file = self._write_connection_info()
+        if spawn_local:
+            self._spawn_local_executors(executor_env or {})
+        self._await_executors(start_timeout)
+
+    # -- bootstrap -------------------------------------------------------
+
+    def _write_connection_info(self):
+        """Write authkey (0600) + driver.info JSON; returns authkey path."""
+        import json
+        authkey_file = os.path.join(self.work_root, "authkey")
+        fd = os.open(authkey_file, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "wb") as f:
+            f.write(self.authkey)
+        with open(os.path.join(self.work_root, "driver.info"), "w") as f:
+            json.dump({"host": self.driver_addr[0], "port": self.driver_addr[1],
+                       "authkey_file": authkey_file,
+                       "num_executors": self.num_executors}, f)
+        return authkey_file
+
+    def _spawn_local_executors(self, executor_env):
+        authkey_file = self.authkey_file
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        for i in range(self.num_executors):
+            env = dict(os.environ)
+            env.update(executor_env)
+            env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+            work_dir = os.path.join(self.work_root, "executor-%d" % i)
+            os.makedirs(work_dir, exist_ok=True)
+            log_path = os.path.join(work_dir, "executor.log")
+            logfh = open(log_path, "ab")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "tensorflowonspark_tpu.engine.executor",
+                 "--driver", "{}:{}".format(*self.driver_addr),
+                 "--executor-id", str(i),
+                 "--authkey-file", authkey_file,
+                 "--work-dir", work_dir],
+                env=env, stdout=logfh, stderr=subprocess.STDOUT)
+            logfh.close()
+            self._procs.append(proc)
+        logger.info("spawned %d local executors (logs under %s)",
+                    self.num_executors, self.work_root)
+
+    def _accept_loop(self):
+        while not self._stopping.is_set():
+            try:
+                conn = self._listener.accept()
+            except Exception as e:  # noqa: BLE001 - incl. AuthenticationError
+                if self._stopping.is_set():
+                    break
+                logger.warning("rejected executor connection: %s", e)
+                continue
+            try:
+                hello = conn.recv()
+            except (EOFError, OSError):
+                conn.close()
+                continue
+            if hello.get("type") != "hello":
+                conn.close()
+                continue
+            eid = hello.get("executor_id")
+            with self._lock:
+                old = self._handles.get(eid)
+                if old is not None and old.alive:
+                    logger.error(
+                        "duplicate executor_id %s from %s rejected (already "
+                        "registered and alive)", eid, hello.get("host"))
+                    conn.close()
+                    continue
+            handle = _ExecutorHandle(self, conn, hello)
+            with self._lock:
+                self._handles[eid] = handle
+            logger.info("executor %d registered from %s (pid %s)",
+                        eid, hello.get("host"), hello.get("pid"))
+
+    def _await_executors(self, timeout):
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                n = len(self._handles)
+            if n >= self.num_executors:
+                return
+            for proc in self._procs:
+                if proc.poll() is not None:
+                    self.stop()
+                    raise RuntimeError(
+                        "executor process exited with code {} during startup; "
+                        "see logs under {}".format(proc.returncode, self.work_root))
+            if time.monotonic() > deadline:
+                self.stop()
+                raise TimeoutError(
+                    "only {}/{} executors connected within {}s".format(
+                        n, self.num_executors, timeout))
+            time.sleep(0.05)
+
+    # -- Spark-shaped API ------------------------------------------------
+
+    @property
+    def defaultParallelism(self):
+        return self.num_executors
+
+    def parallelize(self, data, num_slices=None):
+        data = list(data)
+        n = num_slices or self.num_executors
+        n = max(1, min(n, len(data)) if data else 1)
+        size, extra = divmod(len(data), n)
+        parts, start = [], 0
+        for i in range(n):
+            end = start + size + (1 if i < extra else 0)
+            parts.append(_Partition(data[start:end]))
+            start = end
+        return RDD(self, parts)
+
+    def union(self, rdds):
+        out = rdds[0]
+        for r in rdds[1:]:
+            out = out.union(r)
+        return out
+
+    def run_job(self, rdd, func, one_task_per_executor=False):
+        """Ship ``func`` over every partition; returns :class:`AsyncResult`."""
+        partitions = rdd._partitions
+        result = AsyncResult(len(partitions))
+        with self._lock:
+            self._job_counter += 1
+            job_id = self._job_counter
+            handles = {eid: h for eid, h in self._handles.items() if h.alive}
+        if not handles:
+            raise RuntimeError("no executors alive to run job")
+        if one_task_per_executor and len(partitions) > len(handles):
+            raise ValueError(
+                "job needs {} executors but only {} are alive".format(
+                    len(partitions), len(handles)))
+        for task_id, part in enumerate(partitions):
+            full = _compose(part.transform, func)
+            task = {"job_id": job_id, "task_id": task_id,
+                    "func": serializer.dumps(full),
+                    "payload": serializer.dumps(part.payload),
+                    "result": result}
+            if one_task_per_executor:
+                executor_id = sorted(handles)[task_id]
+                handles[executor_id].own_queue.put(task)
+            else:
+                self._shared_tasks.put(task)
+        return result
+
+    def executors_alive(self):
+        with self._lock:
+            return sorted(eid for eid, h in self._handles.items() if h.alive)
+
+    def _on_handle_dead(self, handle):
+        """Reap a dead executor: fail its pinned tasks, and if no executors
+        remain, fail everything in the shared pool — a job must never hang
+        because its worker died (the docstring's failed-task-fails-the-job
+        contract)."""
+        with self._lock:
+            if self._handles.get(handle.executor_id) is handle:
+                del self._handles[handle.executor_id]
+            any_alive = any(h.alive for h in self._handles.values())
+        _drain_failing(handle.own_queue,
+                       "executor {} died before running pinned task".format(
+                           handle.executor_id))
+        if not any_alive and not self._stopping.is_set():
+            _drain_failing(self._shared_tasks, "no executors alive")
+
+    def stop(self, timeout=15):
+        """Stop executors and the listener; idempotent."""
+        if self._stopping.is_set():
+            return
+        self._stopping.set()
+        _drain_failing(self._shared_tasks, "driver stopping")
+        with self._lock:
+            handles = list(self._handles.values())
+        for h in handles:
+            h.send_stop()
+        for h in handles:
+            h.thread.join(timeout=5)
+        for h in handles:
+            h.close()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        deadline = time.monotonic() + timeout
+        for proc in self._procs:
+            left = max(0.1, deadline - time.monotonic())
+            try:
+                proc.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                logger.warning("killing unresponsive executor pid %s", proc.pid)
+                proc.kill()
+                proc.wait(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def _drain_failing(q, reason):
+    """Fail every task currently waiting in queue ``q`` with ``reason``."""
+    while True:
+        try:
+            task = q.get_nowait()
+        except queue.Empty:
+            return
+        if task is _STOP or not isinstance(task, dict):
+            continue
+        task["result"]._fail(task["task_id"], reason)
+
+
+def _compose(transform, func):
+    def full(raw_iter, _t=transform, _f=func):
+        return _f(_t(raw_iter) if _t is not None else raw_iter)
+    return full

@@ -1,0 +1,17 @@
+"""Closure/task serialization for the engine.
+
+Spark ships task closures with cloudpickle; so do we (cloudpickle 3.x is
+in the image). Payloads travel only over authkey-authenticated
+``multiprocessing.connection`` channels between our own driver and
+executors — the same trust model as Spark's closure plane.
+"""
+
+import cloudpickle
+
+
+def dumps(obj):
+    return cloudpickle.dumps(obj)
+
+
+def loads(data):
+    return cloudpickle.loads(data)

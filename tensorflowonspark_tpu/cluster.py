@@ -1,0 +1,222 @@
+"""Driver-side cluster API — the framework's main entry point.
+
+Reference: ``tensorflowonspark/TFCluster.py`` (SURVEY.md §2 "Cluster API",
+§3.1/§3.5 call stacks): assign executor→role template, start the
+reservation barrier, launch the async node-bootstrap job, wait for the
+cluster to form, and hand back a handle with ``train`` / ``inference`` /
+``shutdown`` / ``tensorboard_url``.
+
+The reference's "<10 lines of code change" conversion story is preserved:
+
+    cluster = TFCluster.run(sc, map_fun, args, num_executors,
+                            input_mode=InputMode.SPARK)
+    cluster.train(dataRDD, num_epochs)
+    cluster.shutdown()
+
+where ``sc`` is an :class:`~tensorflowonspark_tpu.engine.Context` (or any
+object with the same RDD surface), and ``map_fun(args, ctx)`` receives a
+:class:`~tensorflowonspark_tpu.node.NodeContext`.
+"""
+
+import logging
+import os
+import random
+import string
+import threading
+import time
+
+from tensorflowonspark_tpu import node, reservation
+
+logger = logging.getLogger(__name__)
+
+
+class InputMode(object):
+    """How the user fn gets its data (reference: ``TFCluster.InputMode``)."""
+
+    TENSORFLOW = 0  #: user fn reads files itself (runs in the foreground)
+    SPARK = 1       #: records stream from RDD partitions via queues (background)
+
+
+class TFCluster(object):
+    """Handle to a running cluster; returned by :func:`run`."""
+
+    def __init__(self, sc, cluster_info, cluster_meta, input_mode, server,
+                 async_result, queues, num_executors):
+        self.sc = sc
+        self.cluster_info = cluster_info
+        self.cluster_meta = cluster_meta
+        self.input_mode = input_mode
+        self.server = server
+        self.async_result = async_result
+        self.queues = queues
+        self.num_executors = num_executors
+
+    # -- training --------------------------------------------------------
+
+    def train(self, dataRDD, num_epochs=0, feed_timeout=600, qname="input"):
+        """Feed an RDD to the cluster for training (``InputMode.SPARK``).
+
+        Epochs are implemented exactly as the reference does (SURVEY.md
+        §3.2): ``sc.union([dataRDD] * num_epochs)`` — partition order is
+        preserved, so every epoch replays the same data stream.
+        """
+        logger.info("training over %d partitions, %d epoch(s)",
+                    dataRDD.getNumPartitions(), max(num_epochs, 1))
+        assert self.input_mode == InputMode.SPARK, \
+            "train() requires InputMode.SPARK"
+        if num_epochs > 1:
+            dataRDD = self.sc.union([dataRDD] * num_epochs)
+        dataRDD.foreachPartition(
+            node.train(self.cluster_info, self.cluster_meta,
+                       feed_timeout=feed_timeout, qname=qname))
+
+    def inference(self, dataRDD, feed_timeout=600, qname="output"):
+        """Feed an RDD through the cluster for inference; returns an RDD of
+        result rows (reference: ``TFCluster.inference`` → RDD[str],
+        SURVEY.md §3.3).
+        """
+        assert self.input_mode == InputMode.SPARK, \
+            "inference() requires InputMode.SPARK"
+        return dataRDD.mapPartitions(
+            node.inference(self.cluster_info, self.cluster_meta,
+                           feed_timeout=feed_timeout, qname=qname))
+
+    # -- lifecycle -------------------------------------------------------
+
+    def shutdown(self, ssc=None, grace_secs=0, timeout=259200):
+        """Stop the cluster; re-raise any executor-side error on the driver.
+
+        Reference: ``TFCluster.shutdown`` (SURVEY.md §3.5): stop streaming
+        first if present; SPARK mode feeds stop markers and joins the
+        background trainers; waits for the async bootstrap job; stops the
+        reservation server; errors surface as a raised ``RuntimeError``.
+        """
+        if ssc is not None:
+            ssc.stop()
+
+        shutdown_error = None
+        if self.input_mode == InputMode.SPARK:
+            workers = self.sc.parallelize(range(self.num_executors),
+                                          self.num_executors)
+            # EndFeed goes to every input-like queue the cluster created
+            # (everything that isn't the output/error plane).
+            feed_queues = tuple(q for q in self.queues
+                                if q not in ("output", "error")) or ("input",)
+            try:
+                workers.foreachPartitionAsync(
+                    node.shutdown(self.cluster_info, self.cluster_meta,
+                                  queues=feed_queues, grace_secs=grace_secs),
+                    one_task_per_executor=True).get(timeout=timeout)
+            except Exception as e:  # noqa: BLE001 - re-raised after cleanup
+                shutdown_error = e
+
+        # Wait for the node-bootstrap job itself (in TENSORFLOW mode this is
+        # where inline map_fun errors surface).
+        bootstrap_error = None
+        try:
+            self.async_result.get(timeout=timeout)
+        except Exception as e:  # noqa: BLE001
+            bootstrap_error = e
+
+        self.server.stop()
+
+        if shutdown_error is not None:
+            raise RuntimeError(
+                "cluster shutdown surfaced a trainer error") from shutdown_error
+        if bootstrap_error is not None:
+            raise RuntimeError(
+                "cluster node failed") from bootstrap_error
+        logger.info("cluster shut down cleanly")
+
+    def tensorboard_url(self):
+        """URL of the TensorBoard spawned on the chief node, or None."""
+        for n in self.cluster_info:
+            if n.get("tb_port"):
+                return "http://{}:{}".format(n["host"], n["tb_port"])
+        return None
+
+
+def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
+        input_mode=InputMode.SPARK, log_dir=None, driver_ps_nodes=False,
+        master_node="chief", reservation_timeout=reservation.DEFAULT_TIMEOUT,
+        queues=("input", "output", "error"), eval_node=False):
+    """Start a cluster: one node per executor, roles per the template.
+
+    Reference: ``TFCluster.run`` (SURVEY.md §3.1). ``num_ps`` and
+    ``driver_ps_nodes`` are accepted for API parity but parameter-server
+    roles are not meaningful on TPU (SURVEY.md §2.3: async-PS DP is not
+    idiomatic — DP is synchronous allreduce via XLA collectives); passing
+    ``num_ps > 0`` still creates ps-role nodes for program compatibility,
+    and their fns simply see ``ctx.job_name == 'ps'``.
+    """
+    # 1. executor -> role template (reference: cluster_template build).
+    needed = num_ps + 1 + (1 if eval_node else 0)
+    if needed > num_executors:
+        raise ValueError(
+            "cluster needs at least {} executors for num_ps={}, master, "
+            "eval_node={} but num_executors={}".format(
+                needed, num_ps, eval_node, num_executors))
+    template = {}
+    next_id = 0
+    if num_ps > 0:
+        template["ps"] = list(range(next_id, next_id + num_ps))
+        next_id += num_ps
+    template[master_node] = [next_id]
+    next_id += 1
+    if eval_node:
+        template["evaluator"] = [next_id]
+        next_id += 1
+    if next_id < num_executors:
+        template["worker"] = list(range(next_id, num_executors))
+    logger.info("cluster template: %s", template)
+
+    # 2. reservation barrier on the driver.
+    server = reservation.Server(num_executors)
+    server_addr = server.start()
+
+    # 3. cluster metadata shipped to every node task.
+    cluster_id = "{}-{}".format(
+        int(time.time()),
+        "".join(random.choice(string.ascii_lowercase) for _ in range(6)))
+    cluster_meta = {
+        "id": cluster_id,
+        "cluster_template": template,
+        "server_addr": list(server_addr),
+        "authkey": os.urandom(20).hex(),
+        "default_fs": os.environ.get("TFOS_DEFAULT_FS", "file://"),
+        "working_dir": os.getcwd(),
+        "num_executors": num_executors,
+        "master_node": master_node,
+        "reservation_timeout": reservation_timeout,
+    }
+
+    # 4. async bootstrap job: one pinned task per executor.
+    try:
+        nodeRDD = sc.parallelize(range(num_executors), num_executors)
+        background = (input_mode == InputMode.SPARK)
+        async_result = nodeRDD.foreachPartitionAsync(
+            node.run(map_fun, tf_args, cluster_meta, tensorboard=tensorboard,
+                     log_dir=log_dir, queues=tuple(queues),
+                     background=background),
+            one_task_per_executor=True)
+
+        # 5. wait for the cluster to form; fail fast if a node task died.
+        def _status():
+            if async_result.done() and not async_result.successful():
+                async_result.get(timeout=0)  # raises the task error
+
+        cluster_info = server.await_reservations(timeout=reservation_timeout,
+                                                 status=_status)
+    except BaseException:
+        # Don't leak the barrier: executors still blocked in
+        # await_reservations see the server vanish and fail their node
+        # tasks instead of occupying their serial task slot for the full
+        # reservation timeout.
+        server.stop()
+        raise
+    logger.info("cluster formed: %s", [
+        "{}:{} {}:{}".format(n["job_name"], n["task_index"], n["host"],
+                             n["port"]) for n in cluster_info])
+
+    return TFCluster(sc, cluster_info, cluster_meta, input_mode, server,
+                     async_result, tuple(queues), num_executors)

@@ -137,12 +137,13 @@ class DataFeed(object):
         logger.info("DataFeed terminating: draining input queue")
         self.mgr.set("state", "terminating")
         self.done_feeding = True
+        import queue as _queue
         count = 0
         while True:
             try:
                 self._queue_in.get(block=True, timeout=1.0)
                 self._queue_in.task_done()
                 count += 1
-            except Exception:  # queue.Empty via proxy
+            except _queue.Empty:
                 break
         logger.info("DataFeed terminate drained %d items", count)

@@ -44,6 +44,33 @@ class _KV(object):
             self._d[key] = value
 
 
+class _Control(object):
+    """Server-side helper: queue operations the stock proxy lacks.
+
+    ``join(qname, timeout)`` is the load-bearing one: a feeder must be able
+    to wait for its partition to be consumed *without* blocking forever when
+    the trainer has died (the reference's bare ``queue.join()`` can hang
+    exactly that way; SURVEY.md §5 failure-detection notes feed timeouts as
+    the mitigation — this makes the timeout enforceable during the join).
+    """
+
+    def __init__(self, qdict):
+        self._qdict = qdict
+
+    def join(self, qname, timeout):
+        """True once all items put to ``qname`` were task_done'd."""
+        import time as _time
+        q = self._qdict[qname]
+        deadline = _time.monotonic() + timeout
+        with q.all_tasks_done:
+            while q.unfinished_tasks:
+                left = deadline - _time.monotonic()
+                if left <= 0:
+                    return False
+                q.all_tasks_done.wait(left)
+        return True
+
+
 class _ManagerBase(BaseManager):
     pass
 
@@ -62,6 +89,7 @@ class ManagerClient(object):
         self.address = tuple(address)
         self.authkey = authkey
         self._kv = None
+        self._control = None
         self._qcache = {}
         self._lock = threading.Lock()
 
@@ -82,6 +110,14 @@ class ManagerClient(object):
 
     def set(self, key, value):
         return self._kv_proxy().set(key, value)
+
+    def join_queue(self, qname, timeout):
+        """Bounded-wait queue join; True if fully consumed (see _Control)."""
+        with self._lock:
+            if self._control is None:
+                self._control = self._mgr.get_control()
+            control = self._control
+        return control.join(qname, timeout)
 
 
 def start(authkey, queues, mode="local", host=None):
@@ -106,8 +142,10 @@ def start(authkey, queues, mode="local", host=None):
     # Registered callables return *proxies* to server-held objects — exactly
     # right for the shared queues and the kv store. Value-returning calls
     # (kv.get) happen as proxy *method* calls, which return real values.
+    control = _Control(qdict)
     _Server.register("get_queue", callable=lambda qname: qdict[qname])
     _Server.register("get_kv", callable=lambda: kv)
+    _Server.register("get_control", callable=lambda: control)
 
     if mode == "remote":
         if host is None:
@@ -140,6 +178,7 @@ def connect(address, authkey):
 
     _Client.register("get_queue")
     _Client.register("get_kv")
+    _Client.register("get_control")
     mgr = _Client(address=tuple(address), authkey=authkey)
     mgr.connect()
     return ManagerClient(mgr, address, authkey)

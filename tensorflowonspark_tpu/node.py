@@ -1,0 +1,592 @@
+"""Node runtime: the executor-side heart of the framework.
+
+Reference: ``tensorflowonspark/TFSparkNode.py`` (SURVEY.md §2 "Node
+runtime", §3.1/§3.2 call stacks). One bootstrap task runs per executor and:
+derives the node ordinal, starts the per-node queue broker, binds the
+accelerator, reserves the ports the node will serve on, registers with the
+driver's reservation barrier, blocks until the whole cluster is formed,
+then runs the user ``map_fun`` — in a background process for
+``InputMode.SPARK`` (the queue-fed path) or inline for
+``InputMode.TENSORFLOW`` (direct file reads).
+
+TPU-native differences from the reference:
+
+- **No GPU-grab race.** The reference's ``gpu_info.get_gpus`` parses
+  ``nvidia-smi`` and retries when concurrent executors steal devices; on a
+  TPU host the chips belong to whichever single process initializes the
+  runtime, so "device pinning" here means: the *trainer* process (spawned
+  below) owns the TPU, and this bootstrap/feeder process must never import
+  jax (SURVEY.md §7.3 "Background process + libtpu").
+- **TF_CONFIG → JAX coordination.** Instead of exporting ``TF_CONFIG`` for
+  a TF gRPC server mesh, the barrier's sorted node list yields
+  ``process_id`` (= sorted index) and the chief's reserved port becomes the
+  ``jax.distributed.initialize`` coordinator address. The trainer process
+  reads these from env (``TFOS_*`` variables below).
+- **Chunked feed.** Feed tasks batch records into chunks before the queue
+  ``put`` — the reference's per-record manager-proxy round trip is its
+  documented bottleneck (SURVEY.md §3.2 hot loop) and is not reproduced.
+"""
+
+import logging
+import multiprocessing
+import os
+import queue as _queue
+import subprocess
+import sys
+import time
+
+from tensorflowonspark_tpu import manager, marker, reservation, util
+from tensorflowonspark_tpu.datafeed import DataFeed
+
+logger = logging.getLogger(__name__)
+
+#: Chunk size for the feed plane: records per queue item. Tuned for
+#: pickling cost, not device batch size — DataFeed re-slices.
+FEED_CHUNK = 256
+
+#: Per-executor node state, set by the bootstrap task and read by the
+#: feed/shutdown tasks that later run in the same executor process
+#: (reference: executor_id file + ``_get_manager`` reconnect).
+_NODE_STATE = {}
+
+
+class NodeContext(object):
+    """Handed to the user ``map_fun`` as its second argument.
+
+    Reference: ``TFSparkNode.py :: TFNodeContext`` — executor_id, job_name,
+    task_index, cluster_spec, defaultFS, working_dir, mgr + helpers.
+    """
+
+    def __init__(self, executor_id, job_name, task_index, cluster_info,
+                 cluster_meta, mgr_addr=None, mgr_authkey=None, mgr=None):
+        self.executor_id = executor_id
+        self.job_name = job_name
+        self.task_index = task_index
+        self.cluster_info = cluster_info
+        self.cluster_meta = cluster_meta
+        self.default_fs = cluster_meta.get("default_fs", "file://")
+        self.working_dir = cluster_meta.get("working_dir", os.getcwd())
+        self._mgr_addr = mgr_addr
+        self._mgr_authkey = mgr_authkey
+        self._mgr = mgr
+        master = cluster_meta.get("master_node", "chief")
+        self.num_workers = sum(
+            1 for n in cluster_info
+            if n.get("job_name") in (master, "chief", "worker"))
+
+    # -- queue plane -----------------------------------------------------
+
+    @property
+    def mgr(self):
+        """Queue-broker client, connected lazily (the trainer is a freshly
+        spawned process and must authkey-stamp itself before connecting)."""
+        if self._mgr is None:
+            multiprocessing.current_process().authkey = self._mgr_authkey
+            self._mgr = manager.connect(self._mgr_addr, self._mgr_authkey)
+        return self._mgr
+
+    def get_data_feed(self, train_mode=True, qname_in="input",
+                      qname_out="output", input_mapping=None):
+        """The queue-fed input API (reference: ``TFNodeContext.get_data_feed``)."""
+        return DataFeed(self.mgr, train_mode, qname_in, qname_out, input_mapping)
+
+    # -- paths -----------------------------------------------------------
+
+    def absolute_path(self, path):
+        """Absolutize a user path against default_fs / working dir.
+
+        Reference: ``TFNodeContext.absolute_path`` / ``TFNode.hdfs_path``.
+        """
+        if path.startswith("hdfs://") or path.startswith("gs://") or \
+                path.startswith("file://") or os.path.isabs(path):
+            return path
+        return os.path.join(self.working_dir, path)
+
+    # -- cluster / devices ------------------------------------------------
+
+    def cluster_spec(self):
+        """{job_name: [host:port, ...]} — the TF_CONFIG-shaped view."""
+        spec = {}
+        for node in self.cluster_info:
+            spec.setdefault(node["job_name"], []).append(
+                "{}:{}".format(node["host"], node["port"]))
+        return spec
+
+    def coordinator_address(self):
+        """host:port of node 0 — the jax.distributed coordinator."""
+        chief = self.cluster_info[0]
+        return "{}:{}".format(chief["host"], chief["port"])
+
+    def initialize_jax(self):
+        """Initialize JAX for this node; the ``start_cluster_server`` analog.
+
+        Reference: ``TFNode.start_cluster_server`` built a
+        ``tf.train.Server`` from the cluster spec; here multi-host execution
+        is ``jax.distributed.initialize(coordinator, N, process_id)`` and
+        the collectives are compiler-emitted over ICI/DCN (SURVEY.md §2.4).
+        Single-process clusters (and the hermetic test harness, where every
+        trainer owns its own virtual device set) skip the distributed init.
+        """
+        if len(self.cluster_info) > 1 and _jax_distributed_enabled():
+            import jax
+            jax.distributed.initialize(
+                coordinator_address=self.coordinator_address(),
+                num_processes=len(self.cluster_info),
+                process_id=self.task_sorted_index())
+        import jax
+        return jax.devices()
+
+    def task_sorted_index(self):
+        """This node's index in the sorted cluster_info == JAX process_id."""
+        for i, node in enumerate(self.cluster_info):
+            if node["executor_id"] == self.executor_id:
+                return i
+        raise RuntimeError(
+            "executor {} not present in cluster_info".format(self.executor_id))
+
+    def mesh(self, axis_shapes=None):
+        """Build a ``jax.sharding.Mesh`` over all addressable devices.
+
+        ``axis_shapes``: ordered {axis_name: size}; defaults to a pure
+        data-parallel mesh ``{'data': n_devices}`` (the reference's only
+        parallelism family, SURVEY.md §2.3). Imports jax lazily: only the
+        trainer process may do this.
+        """
+        from tensorflowonspark_tpu.parallel import mesh as mesh_lib
+        return mesh_lib.build_mesh(axis_shapes)
+
+
+def _jax_distributed_enabled():
+    """Default ON: a real multi-node cluster that skipped
+    ``jax.distributed.initialize`` would train as N unsynchronized replicas
+    and produce silently wrong models. The hermetic single-host test
+    harness (where each trainer owns a private virtual CPU device set)
+    opts out with ``TFOS_TPU_DISTRIBUTED=0``."""
+    return os.environ.get("TFOS_TPU_DISTRIBUTED", "1") == "1"
+
+
+def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
+        queues=("input", "output", "error"), background=True):
+    """Return the bootstrap closure run once per executor.
+
+    Reference: ``TFSparkNode.run(fn, tf_args, cluster_meta, tensorboard,
+    log_dir, queues, background)`` — the returned ``_mapfn`` is shipped via
+    ``nodeRDD.foreachPartitionAsync`` (SURVEY.md §3.1).
+    """
+
+    def _mapfn(iterator):
+        # Partition payload is [executor_id]; also cross-check the engine's
+        # persisted ordinal (reference: util.read_executor_id).
+        ids = list(iterator)
+        from tensorflowonspark_tpu.engine import executor as engine_executor
+        info = engine_executor.get_executor_info()
+        executor_id = ids[0] if ids else info.get("executor_id")
+
+        # Duplicate-bootstrap guard (reference: cluster-id check in
+        # TFSparkNode.run for retried tasks).
+        if _NODE_STATE.get("cluster_id") == cluster_meta["id"]:
+            logger.warning("executor %s already bootstrapped for cluster %s; "
+                           "skipping duplicate node task", executor_id,
+                           cluster_meta["id"])
+            return
+
+        job_name, task_index = _assign_role(executor_id,
+                                            cluster_meta["cluster_template"])
+        host = info.get("host") or util.get_ip_address()
+        authkey = bytes.fromhex(cluster_meta["authkey"])
+
+        # 1. queue broker for this node (the process-boundary bridge)
+        mgr = manager.start(authkey, list(queues),
+                            mode=cluster_meta.get("manager_mode", "local"),
+                            host=host)
+
+        # 2. reserve the port this node serves on (chief's doubles as the
+        # jax.distributed coordinator address)
+        port = int(os.environ.get("TFOS_SERVER_PORT", 0)) or util.find_free_port()
+
+        # 3. optional tensorboard on the designated master node
+        tb_port, tb_pid = 0, 0
+        if tensorboard and job_name == cluster_meta.get("master_node", "chief"):
+            tb_port, tb_pid = _start_tensorboard(log_dir)
+
+        # 4. register with the driver's barrier; block until cluster formed
+        client = reservation.Client(cluster_meta["server_addr"])
+        node_meta = {"executor_id": executor_id, "host": host,
+                     "job_name": job_name, "task_index": task_index,
+                     "port": port, "tb_port": tb_port, "tb_pid": tb_pid,
+                     "mgr_addr": list(mgr.address), "pid": os.getpid()}
+        client.register(node_meta)
+        cluster_info = client.await_reservations(
+            timeout=cluster_meta.get("reservation_timeout",
+                                     reservation.DEFAULT_TIMEOUT))
+        client.close()
+        logger.info("node %s/%d (executor %s) sees cluster of %d",
+                    job_name, task_index, executor_id, len(cluster_info))
+
+        mgr.set("endpoint", {"host": host, "mgr_addr": list(mgr.address)})
+
+        ctx = NodeContext(executor_id, job_name, task_index, cluster_info,
+                          cluster_meta, mgr_addr=mgr.address,
+                          mgr_authkey=authkey, mgr=mgr)
+
+        _NODE_STATE.update(cluster_id=cluster_meta["id"], mgr=mgr,
+                           executor_id=executor_id, ctx=ctx,
+                           trainer_proc=None, tb_pid=tb_pid)
+
+        if background:
+            # InputMode.SPARK: the trainer runs in a child process (it will
+            # own the TPU); this bootstrap task returns so the executor's
+            # task slot frees up for feed tasks (SURVEY.md §3.2).
+            # Start method: fork (default) is safe *because this executor
+            # process never initializes jax/libtpu* — the child is the first
+            # TPU toucher — and it inherits the user fn without pickling.
+            # spawn (TFOS_TRAINER_START_METHOD=spawn) is available for
+            # paranoid isolation; it ships one opaque cloudpickle payload,
+            # since mp re-pickles spawn args with *standard* pickle, which
+            # cannot handle dynamically-defined closures.
+            method = os.environ.get("TFOS_TRAINER_START_METHOD", "fork")
+            if method == "fork":
+                proc = multiprocessing.get_context("fork").Process(
+                    target=_trainer_main_fork,
+                    args=(fn, tf_args, executor_id, job_name, task_index,
+                          cluster_info, cluster_meta, list(mgr.address)),
+                    name="tfos-trainer-%s" % executor_id)
+            else:
+                from tensorflowonspark_tpu.engine import serializer
+                payload = serializer.dumps(
+                    (fn, tf_args, executor_id, job_name, task_index,
+                     cluster_info, cluster_meta, list(mgr.address)))
+                proc = multiprocessing.get_context("spawn").Process(
+                    target=_trainer_main, args=(payload,),
+                    name="tfos-trainer-%s" % executor_id)
+            proc.daemon = True
+            proc.start()
+            _NODE_STATE["trainer_proc"] = proc
+            logger.info("spawned background trainer pid %d", proc.pid)
+
+            # Watchdog: a trainer killed without running its exception
+            # handler (OOM SIGKILL) would leave state='running' and feeders
+            # blocked until feed_timeout; flip state the moment it exits
+            # abnormally. (Reference has no analog — its feeders just time
+            # out; SURVEY.md §5 failure-detection.)
+            def _watch(proc=proc, mgr=mgr, executor_id=executor_id):
+                proc.join()
+                if proc.exitcode not in (0, None) and \
+                        mgr.get("state") == "running":
+                    msg = ("trainer on executor {} exited with code {} "
+                           "without reporting an error (killed?)".format(
+                               executor_id, proc.exitcode))
+                    logger.error(msg)
+                    try:
+                        mgr.get_queue("error").put(msg)
+                        mgr.set("state", "error")
+                    except Exception:
+                        pass
+
+            import threading
+            threading.Thread(target=_watch, name="trainer-watchdog",
+                             daemon=True).start()
+        else:
+            # InputMode.TENSORFLOW: run inline; exceptions go to the error
+            # queue AND re-raise to fail the task (driver sees both).
+            try:
+                fn(tf_args, ctx)
+            except BaseException as e:  # noqa: BLE001
+                import traceback
+                tb = traceback.format_exc()
+                logger.error("user map_fun failed:\n%s", tb)
+                mgr.get_queue("error").put(tb)
+                raise
+
+    return _mapfn
+
+
+def _trainer_main(payload):
+    """spawn-mode entry: unwrap the cloudpickle payload first."""
+    from tensorflowonspark_tpu.engine import serializer
+    _trainer_main_fork(*serializer.loads(payload))
+
+
+def _trainer_main_fork(fn, tf_args, executor_id, job_name, task_index,
+                       cluster_info, cluster_meta, mgr_addr):
+    """Entry of the trainer process — the TPU owner.
+
+    Mirrors the reference's ``fn_wrapper``: run the user fn; on exception,
+    push the traceback to the 'error' queue so ``shutdown()`` can re-raise
+    it on the driver (SURVEY.md §3.5).
+    """
+    logging.basicConfig(
+        level=os.environ.get("TFOS_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s trainer[{}] %(name)s: %(message)s"
+        .format(executor_id))
+    authkey = bytes.fromhex(cluster_meta["authkey"])
+    multiprocessing.current_process().authkey = authkey
+    ctx = NodeContext(executor_id, job_name, task_index, cluster_info,
+                      cluster_meta, mgr_addr=tuple(mgr_addr),
+                      mgr_authkey=authkey)
+    try:
+        fn(tf_args, ctx)
+    except BaseException:  # noqa: BLE001 - must reach the driver
+        import traceback
+        tb = traceback.format_exc()
+        logger.error("trainer failed:\n%s", tb)
+        try:
+            ctx.mgr.get_queue("error").put(tb)
+            ctx.mgr.set("state", "error")
+        except Exception:
+            pass
+        sys.exit(1)
+
+
+def _assign_role(executor_id, cluster_template):
+    """executor ordinal -> (job_name, task_index).
+
+    Reference: the cluster_template built in ``TFCluster.run`` maps executor
+    index ranges to ps/chief/worker/evaluator roles.
+    """
+    for job_name, ids in cluster_template.items():
+        if executor_id in ids:
+            return job_name, ids.index(executor_id)
+    raise RuntimeError(
+        "executor {} not in cluster template {}".format(
+            executor_id, cluster_template))
+
+
+def _start_tensorboard(log_dir):
+    """Spawn `tensorboard --logdir` if the binary exists; (port, pid)."""
+    import shutil
+    exe = shutil.which("tensorboard")
+    if exe is None or not log_dir:
+        logger.info("tensorboard unavailable or no log_dir; skipping")
+        return 0, 0
+    port = util.find_free_port()
+    proc = subprocess.Popen(
+        [exe, "--logdir", log_dir, "--port", str(port), "--bind_all"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    logger.info("tensorboard pid %d on port %d", proc.pid, port)
+    return port, proc.pid
+
+
+# -- data-plane closures (run on arbitrary executors) ----------------------
+
+def _get_manager(cluster_info, cluster_meta, executor_id):
+    """Connect to the queue broker of the node on this executor.
+
+    Reference: ``TFSparkNode._get_manager``. Fast path: the broker lives in
+    this very process (our engine runs feed tasks in the executor process
+    that bootstrapped the node) — use the cached client. Slow path: look up
+    the node's advertised mgr_addr in cluster_info and connect with the
+    cluster authkey from cluster_meta.
+    """
+    if _NODE_STATE.get("executor_id") == executor_id and "mgr" in _NODE_STATE:
+        return _NODE_STATE["mgr"]
+    for node in cluster_info:
+        if node["executor_id"] == executor_id:
+            authkey = bytes.fromhex(cluster_meta["authkey"])
+            multiprocessing.current_process().authkey = authkey
+            return manager.connect(tuple(node["mgr_addr"]), authkey)
+    raise RuntimeError(
+        "no cluster node found for executor {}".format(executor_id))
+
+
+def _local_executor_id():
+    from tensorflowonspark_tpu.engine import executor as engine_executor
+    info = engine_executor.get_executor_info()
+    eid = info.get("executor_id")
+    if eid is None:
+        eid = util.read_executor_id()
+    return eid
+
+
+def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
+    """Feed closure: push this partition's records into the local node's
+    input queue, chunked; block until consumed.
+
+    Reference: ``TFSparkNode.train`` → ``_train`` (SURVEY.md §3.2 hot path).
+    """
+
+    def _train(iterator):
+        mgr = _get_manager(cluster_info, cluster_meta, _local_executor_id())
+        state = mgr.get("state")
+        if state in ("terminating", "stopped", "error"):
+            logger.info("feed task skipping: node state is %r", state)
+            # Drain the partition so upstream iterators don't block.
+            for _ in iterator:
+                pass
+            return
+        count = _feed_partition(iterator, mgr, qname, feed_timeout)
+        _join_feed(mgr, qname, feed_timeout)  # until the partition is consumed
+        logger.info("fed %d records to %r", count, qname)
+
+    return _train
+
+
+def _feed_partition(iterator, mgr, qname, feed_timeout):
+    """Push one partition into ``qname`` as chunks + EndPartition; returns
+    the record count. Shared by the train and inference feed closures."""
+    q = mgr.get_queue(qname)
+    deadline = time.monotonic() + feed_timeout
+    chunk = []
+    count = 0
+    for item in iterator:
+        chunk.append(item)
+        if len(chunk) >= FEED_CHUNK:
+            _put_chunk(q, chunk, mgr, deadline)
+            count += len(chunk)
+            chunk = []
+            deadline = time.monotonic() + feed_timeout
+    if chunk:
+        _put_chunk(q, chunk, mgr, deadline)
+        count += len(chunk)
+    q.put(marker.EndPartition())
+    return count
+
+
+def _join_feed(mgr, qname, feed_timeout, on_error="return"):
+    """Wait (bounded) for the queue to drain; never hang on a dead trainer.
+
+    The reference's feeder does a bare ``queue.join()`` — correct while the
+    trainer lives, a permanent hang when it died mid-batch. Here the join is
+    chunked with state checks: trainer error/termination either returns
+    (train path — the real traceback surfaces at ``shutdown()``) or raises
+    (inference path — results can never arrive); feed_timeout still raises.
+    """
+    deadline = time.monotonic() + feed_timeout
+    while not mgr.join_queue(qname, 1.0):
+        state = mgr.get("state")
+        if state in ("error", "terminating", "stopped"):
+            if on_error == "raise":
+                raise RuntimeError(
+                    "feed incomplete: node state is {!r}".format(state))
+            logger.warning("feed incomplete: node state is %r", state)
+            return False
+        if time.monotonic() > deadline:
+            raise RuntimeError("feed timeout: partition not consumed within "
+                               "{}s".format(feed_timeout))
+    return True
+
+
+def _put_chunk(q, chunk, mgr, deadline):
+    """put with terminating-state + timeout checks (reference: abort if
+    mgr state == 'terminating'; raise on feed_timeout -> task fail).
+
+    Only ``queue.Full`` is retried — anything else (e.g. an unpicklable
+    record) must surface immediately with its real traceback, not spin
+    until a misleading 'feed timeout'.
+    """
+    while True:
+        try:
+            q.put(list(chunk), block=True, timeout=1.0)
+            return
+        except _queue.Full:
+            if mgr.get("state") in ("terminating", "stopped", "error"):
+                raise RuntimeError("feed aborted: node is terminating")
+            if time.monotonic() > deadline:
+                raise RuntimeError("feed timeout exceeded")
+
+
+def inference(cluster_info, cluster_meta, feed_timeout=600, qname="output"):
+    """Inference closure: push partition records, then pull exactly as many
+    results as records pushed; yields result rows.
+
+    Reference: ``TFSparkNode.inference`` → ``_inference`` (SURVEY.md §3.3):
+    per-partition count/order is guaranteed by ``q_in.join()`` + counted
+    ``q_out`` reads.
+    """
+
+    def _inference(iterator):
+        mgr = _get_manager(cluster_info, cluster_meta, _local_executor_id())
+        count = _feed_partition(iterator, mgr, "input", feed_timeout)
+        _join_feed(mgr, "input", feed_timeout, on_error="raise")
+        if count == 0:
+            return iter(())
+
+        q_out = mgr.get_queue(qname)
+        results = []
+        deadline = time.monotonic() + feed_timeout
+        while len(results) < count:
+            try:
+                batch = q_out.get(block=True, timeout=1.0)
+            except _queue.Empty:
+                if mgr.get("state") in ("error", "terminating", "stopped"):
+                    raise RuntimeError(
+                        "inference aborted: trainer terminated with {}/{} "
+                        "results delivered".format(len(results), count))
+                if time.monotonic() > deadline:
+                    raise RuntimeError("inference results timeout")
+                continue
+            q_out.task_done()
+            deadline = time.monotonic() + feed_timeout
+            if isinstance(batch, list):
+                results.extend(batch)
+            else:
+                results.append(batch)
+        return iter(results[:count])
+
+    return _inference
+
+
+def shutdown(cluster_info, cluster_meta, queues=("input",), grace_secs=0):
+    """Shutdown closure, one per executor: surface trainer errors, stop the
+    feed, join the background trainer.
+
+    Reference: ``TFSparkNode.shutdown`` → ``_shutdown`` (SURVEY.md §3.5).
+    Raises on the executor if the trainer pushed an error — the driver's
+    ``cluster.shutdown()`` re-raises it (error-propagation contract).
+    """
+
+    def _shutdown(iterator):
+        for _ in iterator:
+            pass
+        mgr = _get_manager(cluster_info, cluster_meta, _local_executor_id())
+        # End-of-feed marker unblocks DataFeed.next_batch deterministically.
+        for qname in queues:
+            try:
+                mgr.get_queue(qname).put(marker.EndFeed())
+            except Exception:
+                pass
+        if mgr.get("state") == "running":
+            mgr.set("state", "terminating")
+
+        proc = _NODE_STATE.get("trainer_proc")
+        we_terminated = False
+        if proc is not None:
+            proc.join(timeout=max(grace_secs, 60))
+            if proc.is_alive():
+                logger.warning("trainer pid %d unresponsive; terminating",
+                               proc.pid)
+                we_terminated = True
+                proc.terminate()
+                proc.join(timeout=10)
+        tb_pid = _NODE_STATE.get("tb_pid")
+        if tb_pid:
+            try:
+                os.kill(tb_pid, 15)
+            except OSError:
+                pass
+        _NODE_STATE.pop("cluster_id", None)
+
+        # Error surfacing: anything on the error queue fails this task.
+        errors = []
+        try:
+            eq = mgr.get_queue("error")
+            while True:
+                try:
+                    errors.append(eq.get(block=False))
+                    eq.task_done()
+                except _queue.Empty:
+                    break
+        except Exception:
+            pass
+        # A trainer killed in the shutdown window can race the watchdog's
+        # state check and report nothing — its exit code is still evidence.
+        if (proc is not None and not errors and not we_terminated
+                and proc.exitcode not in (0, None)):
+            errors.append("trainer exited with code {} without reporting "
+                          "an error (killed?)".format(proc.exitcode))
+        if errors:
+            raise RuntimeError(
+                "trainer on executor {} failed:\n{}".format(
+                    _local_executor_id(), "\n---\n".join(str(e) for e in errors)))
+
+    return _shutdown

@@ -1,0 +1,18 @@
+"""Parallelism layer: meshes, shardings, and collective patterns.
+
+The reference's parallelism surface is data-parallelism only (async-PS and
+sync-allreduce, SURVEY.md §2.3), delegated to ``tf.distribute`` + NCCL. On
+TPU the whole family is expressed through one mechanism — a
+``jax.sharding.Mesh`` plus named shardings, with XLA emitting the
+collectives over ICI/DCN — so this package is where DP, and the natural
+extensions TP/PP/SP/EP, all live.
+
+Import discipline: importing this package must not initialize a backend;
+submodules import jax lazily inside functions where practical.
+"""
+
+from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401
+    build_mesh,
+    data_parallel_sharding,
+    replicated_sharding,
+)

@@ -1,0 +1,80 @@
+"""Mesh construction from device topology.
+
+The TPU-native replacement for the reference's cluster_spec/TF_CONFIG role
+wiring (SURVEY.md §2.4 plane 3): the framework's job is to build the right
+``jax.sharding.Mesh`` from the topology; the collectives themselves are
+compiler-emitted from sharding annotations, so there is no NCCL-analog
+code here at all.
+
+Axis conventions used across the framework (models/ and examples/ follow
+these names):
+
+- ``data``  — batch (pure DP; the reference's only strategy family)
+- ``model`` — tensor parallelism (weights sharded)
+- ``stage`` — pipeline parallelism
+- ``seq``   — sequence/context parallelism (ring attention)
+- ``expert``— MoE expert parallelism
+"""
+
+import math
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+STAGE_AXIS = "stage"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+
+def build_mesh(axis_shapes=None, devices=None):
+    """Build a ``jax.sharding.Mesh``.
+
+    Args:
+      axis_shapes: ordered ``{axis_name: size}``; one axis may be ``-1``
+        (inferred so the product equals the device count). Default:
+        ``{'data': <n_devices>}``.
+      devices: device list (default ``jax.devices()`` — i.e. *global*
+        devices, which is what pjit over multi-host meshes wants).
+
+    On a multi-host pod this must be called with identical arguments on
+    every process (same global device order), which holds because
+    ``jax.devices()`` is globally consistent after
+    ``jax.distributed.initialize``.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if not axis_shapes:
+        axis_shapes = {DATA_AXIS: n}
+    names = list(axis_shapes.keys())
+    sizes = [int(s) for s in axis_shapes.values()]
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        if known == 0 or n % known:
+            raise ValueError(
+                "cannot infer -1 axis: {} devices over {}".format(n, sizes))
+        sizes[sizes.index(-1)] = n // known
+    total = math.prod(sizes)
+    if total != n:
+        raise ValueError(
+            "mesh {} needs {} devices but {} are available".format(
+                dict(zip(names, sizes)), total, n))
+    mesh_devices = np.asarray(devices).reshape(sizes)
+    return Mesh(mesh_devices, tuple(names))
+
+
+def data_parallel_sharding(mesh, axis=DATA_AXIS):
+    """NamedSharding that splits the leading (batch) dim over ``axis``."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated_sharding(mesh):
+    """NamedSharding that replicates (params under pure DP)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec())

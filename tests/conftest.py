@@ -10,10 +10,19 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The TPU-tunnel sitecustomize registers its PJRT plugin (and grabs the
+# real chip) in EVERY python process where PALLAS_AXON_POOL_IPS is truthy,
+# overriding JAX_PLATFORMS=cpu — clear it so tests (and the executor/
+# trainer processes they spawn) stay on the virtual CPU platform.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("TFOS_TPU_TEST_MODE", "1")
+# Single-host harness: each trainer process owns a private virtual CPU
+# device set, so the multi-node jax.distributed bootstrap (default ON for
+# real clusters) must be disabled.
+os.environ["TFOS_TPU_DISTRIBUTED"] = "0"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

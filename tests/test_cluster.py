@@ -1,0 +1,139 @@
+"""End-to-end cluster micro-jobs.
+
+Reference test strategy (SURVEY.md §4 ``tests/test_TFCluster.py``): run the
+full bootstrap on a real multi-process engine on one host, with trivial
+map_funs — a sum-the-fed-numbers trainer, a SPARK-mode train + inference
+round-trip, an inline TENSORFLOW-mode run, and shutdown error propagation.
+"""
+
+import json
+import os
+
+import pytest
+
+from tensorflowonspark_tpu import cluster
+from tensorflowonspark_tpu.engine import Context
+
+
+@pytest.fixture()
+def sc(tmp_path):
+    ctx = Context(num_executors=2, work_root=str(tmp_path / "engine"))
+    yield ctx
+    ctx.stop()
+
+
+def test_spark_mode_train_roundtrip(sc, tmp_path):
+    """Queue-fed training: each node sums what it is fed; totals add up."""
+    out_dir = str(tmp_path / "sums")
+    os.makedirs(out_dir)
+
+    def map_fun(args, ctx):
+        feed = ctx.get_data_feed(train_mode=True)
+        total = 0
+        count = 0
+        while not feed.should_stop():
+            batch = feed.next_batch(8)
+            total += sum(batch)
+            count += len(batch)
+        with open(os.path.join(args["out_dir"],
+                               "node-{}.json".format(ctx.executor_id)), "w") as f:
+            json.dump({"total": total, "count": count,
+                       "job_name": ctx.job_name,
+                       "task_index": ctx.task_index,
+                       "num_workers": ctx.num_workers}, f)
+
+    tfc = cluster.run(sc, map_fun, {"out_dir": out_dir}, num_executors=2,
+                      input_mode=cluster.InputMode.SPARK)
+    assert len(tfc.cluster_info) == 2
+    data = sc.parallelize(range(100), 4)
+    tfc.train(data, num_epochs=2)
+    tfc.shutdown()
+
+    files = sorted(os.listdir(out_dir))
+    assert len(files) == 2
+    stats = [json.load(open(os.path.join(out_dir, f))) for f in files]
+    assert sum(s["total"] for s in stats) == sum(range(100)) * 2
+    assert sum(s["count"] for s in stats) == 200
+    assert sorted(s["job_name"] for s in stats) == ["chief", "worker"]
+    assert all(s["num_workers"] == 2 for s in stats)
+
+
+def test_spark_mode_inference_roundtrip(sc):
+    """Inference: every record comes back transformed, count preserved."""
+
+    def map_fun(args, ctx):
+        feed = ctx.get_data_feed(train_mode=False)
+        while not feed.should_stop():
+            batch = feed.next_batch(8)
+            if batch:
+                feed.batch_results([x * 10 for x in batch])
+
+    tfc = cluster.run(sc, map_fun, {}, num_executors=2,
+                      input_mode=cluster.InputMode.SPARK)
+    data = sc.parallelize(range(20), 4)
+    results = tfc.inference(data).collect()
+    assert sorted(results) == [x * 10 for x in range(20)]
+    tfc.shutdown()
+
+
+def test_tensorflow_mode_inline(sc, tmp_path):
+    """InputMode.TENSORFLOW: fn runs inline; run() returns after barrier."""
+    out_dir = str(tmp_path / "marks")
+    os.makedirs(out_dir)
+
+    def map_fun(args, ctx):
+        with open(os.path.join(args["out_dir"],
+                               "node-{}".format(ctx.executor_id)), "w") as f:
+            f.write("{}:{}".format(ctx.job_name, ctx.task_index))
+
+    tfc = cluster.run(sc, map_fun, {"out_dir": out_dir}, num_executors=2,
+                      input_mode=cluster.InputMode.TENSORFLOW)
+    tfc.shutdown()
+    assert sorted(os.listdir(out_dir)) == ["node-0", "node-1"]
+
+
+def test_spark_mode_error_propagates(sc):
+    """A trainer exception must surface as a driver-side raise at shutdown."""
+
+    def map_fun(args, ctx):
+        feed = ctx.get_data_feed(train_mode=True)
+        feed.next_batch(1)
+        raise ValueError("boom on node {}".format(ctx.executor_id))
+
+    tfc = cluster.run(sc, map_fun, {}, num_executors=2,
+                      input_mode=cluster.InputMode.SPARK)
+    data = sc.parallelize(range(10), 2)
+    tfc.train(data)
+    with pytest.raises(RuntimeError) as err:
+        tfc.shutdown(grace_secs=1)
+    assert "boom" in str(err.value.__cause__ or err.value)
+
+
+def test_tensorflow_mode_error_propagates(sc):
+    """Inline map_fun exception fails the bootstrap job -> shutdown raises."""
+
+    def map_fun(args, ctx):
+        if ctx.job_name == "worker":
+            raise ValueError("inline boom")
+
+    tfc = cluster.run(sc, map_fun, {}, num_executors=2,
+                      input_mode=cluster.InputMode.TENSORFLOW)
+    with pytest.raises(RuntimeError):
+        tfc.shutdown()
+
+
+def test_cluster_spec_shape(sc):
+    """cluster_spec has the TF_CONFIG shape; tensorboard_url None if off."""
+    seen = {}
+
+    def map_fun(args, ctx):
+        pass
+
+    tfc = cluster.run(sc, map_fun, {}, num_executors=2,
+                      input_mode=cluster.InputMode.TENSORFLOW)
+    assert tfc.tensorboard_url() is None
+    info = tfc.cluster_info
+    assert [n["executor_id"] for n in info] == [0, 1]
+    assert info[0]["job_name"] == "chief"
+    assert info[1]["job_name"] == "worker"
+    tfc.shutdown()

@@ -1,0 +1,95 @@
+"""Materialize an MNIST-shaped dataset for the examples.
+
+Reference: ``examples/mnist/mnist_data_setup.py`` downloads MNIST and
+writes CSV/TFRecord copies via Spark. This environment has no network
+egress, so the source chain is:
+
+1. a keras-cache copy of the real MNIST if one exists (``~/.keras``),
+2. sklearn's bundled ``load_digits`` (1797 real 8x8 handwritten digits)
+   bilinearly upscaled to 28x28 and repeated to the requested size.
+
+Output: ``<out>/{train,test}/part-*.csv`` where each row is
+``label,p0,p1,...,p783`` with pixels in [0, 255] — the same row shape the
+reference's CSV path feeds through ``DataFeed``.
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+
+def load_mnist_like(num_train=60000, num_test=10000, seed=0):
+    """Returns (x_train, y_train, x_test, y_test); x uint8 [N,28,28]."""
+    try:
+        from keras.datasets import mnist  # only works if cached locally
+
+        (x_tr, y_tr), (x_te, y_te) = mnist.load_data()
+        return x_tr, y_tr, x_te, y_te
+    except Exception:
+        pass
+
+    from sklearn.datasets import load_digits
+
+    digits = load_digits()
+    imgs = digits.images.astype(np.float32) / 16.0  # [1797, 8, 8] in [0,1]
+    labels = digits.target.astype(np.int64)
+
+    # bilinear 8x8 -> 28x28 without scipy: interpolate rows then cols
+    def upscale(batch):
+        idx = np.linspace(0, batch.shape[1] - 1, 28)
+        lo = np.floor(idx).astype(int)
+        hi = np.minimum(lo + 1, batch.shape[1] - 1)
+        w = (idx - lo)[None, :, None]
+        rows = batch[:, lo, :] * (1 - w) + batch[:, hi, :] * w
+        w2 = (idx - lo)[None, None, :]
+        return rows[:, :, lo] * (1 - w2) + rows[:, :, hi] * w2
+
+    imgs28 = upscale(imgs)
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(len(imgs28))
+    imgs28, labels = imgs28[order], labels[order]
+    n_test_src = max(len(imgs28) // 5, 1)
+    te_x, te_y = imgs28[:n_test_src], labels[:n_test_src]
+    tr_x, tr_y = imgs28[n_test_src:], labels[n_test_src:]
+
+    def tile(x, y, n):
+        reps = -(-n // len(x))
+        return (np.tile(x, (reps, 1, 1))[:n], np.tile(y, reps)[:n])
+
+    tr_x, tr_y = tile(tr_x, tr_y, num_train)
+    te_x, te_y = tile(te_x, te_y, num_test)
+    return ((tr_x * 255).astype(np.uint8), tr_y,
+            (te_x * 255).astype(np.uint8), te_y)
+
+
+def write_csv(x, y, out_dir, num_parts):
+    os.makedirs(out_dir, exist_ok=True)
+    flat = x.reshape(len(x), -1)
+    parts = np.array_split(np.arange(len(x)), num_parts)
+    for p, idx in enumerate(parts):
+        with open(os.path.join(out_dir, "part-%05d.csv" % p), "w") as f:
+            for i in idx:
+                f.write(str(int(y[i])) + "," +
+                        ",".join(str(int(v)) for v in flat[i]) + "\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--output", default="data/mnist")
+    ap.add_argument("--num-train", type=int, default=6000)
+    ap.add_argument("--num-test", type=int, default=1000)
+    ap.add_argument("--num-partitions", type=int, default=4)
+    args = ap.parse_args(argv)
+
+    x_tr, y_tr, x_te, y_te = load_mnist_like(args.num_train, args.num_test)
+    write_csv(x_tr, y_tr, os.path.join(args.output, "train"),
+              args.num_partitions)
+    write_csv(x_te, y_te, os.path.join(args.output, "test"),
+              args.num_partitions)
+    print("wrote {} train / {} test rows under {}".format(
+        len(x_tr), len(x_te), args.output))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""MNIST map_fun: the code that runs on every cluster node.
+
+Reference: ``examples/mnist/spark/mnist_dist.py`` — the ``map_fun(args,
+ctx)`` convention (SURVEY.md §2.1): build the model, consume batches from
+``ctx.get_data_feed()`` (InputMode.SPARK) or read files directly
+(InputMode.TENSORFLOW), train, and let the chief export.
+
+TPU-native shape: flax LeNet + optax, pure-DP mesh, sharded prefetch
+infeed, loss/step-rate logged per node.
+"""
+
+import json
+import logging
+import os
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _parse_csv_row(row):
+    """'label,p0,...,p783' -> {'x': [28,28,1] float32 in [0,1], 'y': int}"""
+    vals = np.fromstring(row, dtype=np.float32, sep=",") \
+        if isinstance(row, str) else np.asarray(row, np.float32)
+    y = int(vals[0])
+    x = (vals[1:] / 255.0).reshape(28, 28, 1).astype(np.float32)
+    return {"x": x, "y": y}
+
+
+def map_fun(args, ctx):
+    import jax
+    import optax
+
+    from tensorflowonspark_tpu import infeed, training
+    from tensorflowonspark_tpu.models.lenet import LeNet
+
+    ctx.initialize_jax()
+    mesh = ctx.mesh()
+    trainer = training.Trainer(LeNet(), optax.adam(args["lr"]), mesh)
+    state = trainer.init(jax.random.PRNGKey(args.get("seed", 0)),
+                         np.zeros((8, 28, 28, 1), np.float32))
+
+    if args.get("input_mode") == "tensorflow":
+        batches = _file_batches(args, ctx)
+    else:
+        feed = ctx.get_data_feed(train_mode=True)
+        batches = _feed_batches(feed, args["batch_size"])
+
+    state, steps, rate = trainer.train_loop(
+        state, infeed.sharded_batches(batches, mesh),
+        log_every=args.get("log_every", 50))
+    logger.info("node %s done: %d steps, %.1f examples/sec",
+                ctx.executor_id, steps, rate)
+
+    if args.get("model_dir") and ctx.job_name == "chief":
+        model_dir = ctx.absolute_path(args["model_dir"])
+        os.makedirs(model_dir, exist_ok=True)
+        with open(os.path.join(model_dir, "train_stats.json"), "w") as f:
+            json.dump({"steps": steps, "examples_per_sec": rate}, f)
+
+
+def _feed_batches(feed, batch_size):
+    """DataFeed records (CSV rows) -> stacked {'x','y'} device batches.
+
+    Drops ragged tails smaller than the device count so the batch dim
+    always splits over the mesh (static shapes keep XLA recompiles away:
+    pad-to-batch instead of shape-per-tail).
+    """
+    for records in feed.numpy_batches(batch_size):
+        parsed = [_parse_csv_row(r) for r in records]
+        n = len(parsed)
+        if n < batch_size:  # pad the tail to the compiled batch shape
+            parsed.extend(parsed[: batch_size - n])
+        yield {"x": np.stack([p["x"] for p in parsed]),
+               "y": np.asarray([p["y"] for p in parsed], np.int64)}
+
+
+def _file_batches(args, ctx):
+    """InputMode.TENSORFLOW: read the CSV shards assigned to this worker."""
+    data_dir = ctx.absolute_path(args["images"])
+    parts = sorted(os.listdir(data_dir))
+    mine = parts[ctx.task_sorted_index()::len(ctx.cluster_info)]
+    for epoch in range(args.get("epochs", 1)):
+        for part in mine:
+            rows = open(os.path.join(data_dir, part)).read().splitlines()
+            for i in range(0, len(rows) - args["batch_size"] + 1,
+                           args["batch_size"]):
+                parsed = [_parse_csv_row(r)
+                          for r in rows[i:i + args["batch_size"]]]
+                yield {"x": np.stack([p["x"] for p in parsed]),
+                       "y": np.asarray([p["y"] for p in parsed], np.int64)}

@@ -118,6 +118,20 @@ class TFCluster(object):
         except Exception as e:  # noqa: BLE001
             bootstrap_error = e
 
+        if self.input_mode == InputMode.TENSORFLOW:
+            # Cleanup pass the SPARK branch gets from node.shutdown: kill
+            # the chief's TensorBoard subprocess, drain the error queue.
+            workers = self.sc.parallelize(range(self.num_executors),
+                                          self.num_executors)
+            try:
+                workers.foreachPartitionAsync(
+                    node.shutdown(self.cluster_info, self.cluster_meta,
+                                  queues=(), grace_secs=grace_secs),
+                    one_task_per_executor=True).get(timeout=timeout)
+            except Exception as e:  # noqa: BLE001
+                if bootstrap_error is None:
+                    shutdown_error = e
+
         self.server.stop()
 
         if shutdown_error is not None:
@@ -139,7 +153,8 @@ class TFCluster(object):
 def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
         input_mode=InputMode.SPARK, log_dir=None, driver_ps_nodes=False,
         master_node="chief", reservation_timeout=reservation.DEFAULT_TIMEOUT,
-        queues=("input", "output", "error"), eval_node=False):
+        queues=("input", "output", "error"), eval_node=False,
+        manager_mode="local"):
     """Start a cluster: one node per executor, roles per the template.
 
     Reference: ``TFCluster.run`` (SURVEY.md §3.1). ``num_ps`` and
@@ -187,6 +202,10 @@ def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
         "working_dir": os.getcwd(),
         "num_executors": num_executors,
         "master_node": master_node,
+        # 'local': broker binds loopback (feed tasks run in the node's own
+        # executor process — our engine's layout). 'remote': bind the
+        # routable IP, for engines whose data tasks may land elsewhere.
+        "manager_mode": manager_mode,
         "reservation_timeout": reservation_timeout,
     }
 
@@ -200,10 +219,15 @@ def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
                      background=background),
             one_task_per_executor=True)
 
-        # 5. wait for the cluster to form; fail fast if a node task died.
+        # 5. wait for the cluster to form; fail fast if ANY node task died
+        # (not only when all finished — the survivors are blocked at the
+        # barrier, so done() would never flip).
         def _status():
-            if async_result.done() and not async_result.successful():
-                async_result.get(timeout=0)  # raises the task error
+            err = async_result.first_error()
+            if err is not None:
+                raise RuntimeError(
+                    "cluster node task {} failed during bootstrap: {}".format(
+                        err[0], err[1]))
 
         cluster_info = server.await_reservations(timeout=reservation_timeout,
                                                  status=_status)

@@ -64,6 +64,12 @@ class AsyncResult(object):
     def successful(self):
         return self._done.is_set() and not self._errors
 
+    def first_error(self):
+        """(task_id, error) of the first failed task so far, else None —
+        readable while other tasks are still running (fail-fast probes)."""
+        with self._lock:
+            return self._errors[0] if self._errors else None
+
     def get(self, timeout=None):
         """Block for completion; re-raise the first task error if any."""
         if not self._done.wait(timeout):

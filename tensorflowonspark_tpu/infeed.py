@@ -1,0 +1,75 @@
+"""Host→device infeed: overlap transfer with the device step.
+
+The TPU-native replacement for the reference's feed consumption idiom
+(``tf.data.Dataset.from_generator(DataFeed...)`` — SURVEY.md §2.1 v2.x
+examples). The reference moves records per-item through queues and hands
+them to the TF runtime; here the host side assembles full device batches
+and stages them into HBM *ahead* of the step so the device loop never
+blocks on the host (SURVEY.md §7.3 "Feed throughput": async dispatch gives
+the overlap almost free — keep the device loop un-blocked).
+
+Two layers:
+
+- :func:`prefetch` — wrap any batch iterator with an N-deep background
+  staging pipeline (``jax.device_put`` on a worker thread; JAX transfers
+  are async, so the thread mostly just *initiates* DMA early).
+- :func:`sharded_batches` — also lay each batch out with a
+  ``NamedSharding`` over a mesh (batch dim split over the data axis), so
+  the arrays arrive ready for a pjit-ed step function.
+"""
+
+import queue as _queue
+import threading
+
+_END = object()
+
+
+def prefetch(batch_iter, size=2, device_put=None):
+    """Iterate ``batch_iter`` with ``size`` batches staged ahead.
+
+    ``device_put``: callable applied to each batch on the staging thread
+    (default ``jax.device_put`` — leaves layout to JAX). The generator
+    yields staged batches in order. Exceptions on the staging thread
+    re-raise at the consuming ``next()``.
+    """
+    import jax
+
+    put = device_put or jax.device_put
+    buf = _queue.Queue(maxsize=size)
+
+    def _stage():
+        try:
+            for batch in batch_iter:
+                buf.put(jax.tree.map(put, batch))
+            buf.put(_END)
+        except BaseException as e:  # noqa: BLE001 - re-raised at next()
+            buf.put(e)
+
+    t = threading.Thread(target=_stage, name="infeed-prefetch", daemon=True)
+    t.start()
+
+    while True:
+        item = buf.get()
+        if item is _END:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item
+
+
+def sharded_batches(batch_iter, mesh, axis="data", size=2):
+    """Prefetch + shard: yield batches laid out over ``mesh``'s data axis.
+
+    Each array's leading dim is split across ``axis`` (must divide it);
+    everything arrives as committed global arrays, so a pjit-ed step with
+    matching in_shardings runs without any implicit resharding.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, PartitionSpec(axis))
+
+    def put(x):
+        return jax.device_put(x, sharding)
+
+    return prefetch(batch_iter, size=size, device_put=put)

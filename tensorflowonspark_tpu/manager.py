@@ -120,7 +120,14 @@ class ManagerClient(object):
         return control.join(qname, timeout)
 
 
-def start(authkey, queues, mode="local", host=None):
+#: Max chunks buffered per queue. Bounded so (a) a feeder ahead of the
+#: trainer backpressures instead of ballooning broker RAM, and (b) the
+#: queue.Full path in the feed closures (state checks, feed_timeout) is
+#: live. 64 chunks x FEED_CHUNK records is plenty of runway for overlap.
+QUEUE_MAXSIZE = 64
+
+
+def start(authkey, queues, mode="local", host=None, maxsize=QUEUE_MAXSIZE):
     """Start a broker server in a daemon thread of *this* process.
 
     Returns a connected :class:`ManagerClient` (``.address`` is the
@@ -132,7 +139,7 @@ def start(authkey, queues, mode="local", host=None):
     processes are long-lived, so a daemon server thread suffices and dies
     with the node — one less orphan to reap on task retry.
     """
-    qdict = {name: _queue.Queue() for name in queues}
+    qdict = {name: _queue.Queue(maxsize=maxsize) for name in queues}
     kv = _KV()
     kv.set("state", "running")
 

@@ -1,0 +1,14 @@
+"""Model zoo: flax implementations of the reference's example model families.
+
+Reference examples (SURVEY.md §2.1): MNIST LeNet (``examples/mnist``),
+ResNet (``examples/resnet``), Inception-v3 (``examples/imagenet``),
+plus the BASELINE.json configs (BERT-base SQuAD, Wide&Deep Criteo).
+The reference imported these from TF models / Keras; here they are
+first-party flax modules designed for the MXU: NHWC conv layouts,
+bfloat16 compute with float32 params, channel dims padded to lane
+multiples where it matters.
+
+Import discipline: importing this package must not pull in jax/flax at
+module scope of the *package* — submodules do (they only ever run in the
+trainer process).
+"""

@@ -1,0 +1,34 @@
+"""LeNet-style MNIST CNN — the minimum end-to-end model.
+
+Reference: ``examples/mnist/spark/mnist_dist.py`` builds a small
+conv/dense MNIST graph fed by ``DataFeed`` (SURVEY.md §2.1 v1.x era).
+This is its flax analog, sized to the same problem (28x28x1 → 10),
+with TPU-friendly choices: NHWC layout, bfloat16 activations (params
+stay float32), dense widths at lane multiples (128/256).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNet(nn.Module):
+    """Conv(32)-Conv(64)-Dense(256)-Dense(10), bfloat16 compute."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        # x: [B, 28, 28, 1] float32 in [0, 1]
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(256, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x

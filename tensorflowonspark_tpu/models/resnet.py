@@ -1,0 +1,84 @@
+"""ResNet v1.5 family (ResNet-50 is the flagship benchmark model).
+
+Reference: ``examples/resnet`` (Keras multi-worker ResNet-CIFAR port) and
+the ResNet-50 ImageNet config in BASELINE.json. Built MXU-first:
+
+- NHWC layout, 3x3/1x1 convs — XLA tiles these straight onto the MXU.
+- bfloat16 activations with float32 params and float32 BatchNorm
+  statistics (the numerically-sensitive part).
+- The v1.5 variant (stride 2 in the bottleneck's 3x3, not the 1x1) —
+  the throughput-standard form of the model.
+- Static shapes everywhere; no python control flow in the forward.
+"""
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                 padding="SAME")(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1))(y)
+        # zero-init the last BN scale: identity-ish residual at init
+        y = norm(scale_init=nn.initializers.zeros)(y)
+
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            strides=(self.strides, self.strides))(residual)
+            residual = norm(name="norm_proj")(residual)
+        return nn.relu(residual + y.astype(residual.dtype))
+
+
+class ResNet(nn.Module):
+    """ResNet v1.5. stage_sizes=[3,4,6,3] is ResNet-50."""
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        # x: [B, H, W, 3] float32
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32, name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BottleneckBlock(self.width * 2 ** i, strides=strides,
+                                    dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3])
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3])
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3])
+#: CIFAR-sized variant used by examples/resnet (the reference's closest
+#: analog trains ResNet on CIFAR-10, SURVEY.md §2.1)
+ResNet50Cifar = partial(ResNet, stage_sizes=[3, 4, 6, 3], num_classes=10)

@@ -112,27 +112,43 @@ class NodeContext(object):
                 "{}:{}".format(node["host"], node["port"]))
         return spec
 
+    def participants(self):
+        """Nodes that join the device collective: the worker family.
+
+        ps/evaluator roles (kept for API parity, SURVEY.md §2.3) park
+        outside the mesh — they never call jax.distributed and must not be
+        counted as processes or host the coordinator.
+        """
+        return [n for n in self.cluster_info
+                if n.get("job_name") not in ("ps", "evaluator")]
+
     def coordinator_address(self):
-        """host:port of node 0 — the jax.distributed coordinator."""
-        chief = self.cluster_info[0]
-        return "{}:{}".format(chief["host"], chief["port"])
+        """host:port of the first participant — the jax.distributed
+        coordinator (its reserved port; the TF_CONFIG analog)."""
+        first = self.participants()[0]
+        return "{}:{}".format(first["host"], first["port"])
 
     def initialize_jax(self):
         """Initialize JAX for this node; the ``start_cluster_server`` analog.
 
         Reference: ``TFNode.start_cluster_server`` built a
         ``tf.train.Server`` from the cluster spec; here multi-host execution
-        is ``jax.distributed.initialize(coordinator, N, process_id)`` and
-        the collectives are compiler-emitted over ICI/DCN (SURVEY.md §2.4).
-        Single-process clusters (and the hermetic test harness, where every
-        trainer owns its own virtual device set) skip the distributed init.
+        is ``jax.distributed.initialize(coordinator, N, process_id)`` over
+        the worker-family participants and the collectives are
+        compiler-emitted over ICI/DCN (SURVEY.md §2.4). Single-process
+        clusters (and the hermetic test harness, where every trainer owns
+        its own virtual device set) skip the distributed init. ps/evaluator
+        nodes are not participants and get their local devices only.
         """
-        if len(self.cluster_info) > 1 and _jax_distributed_enabled():
+        participants = self.participants()
+        ids = [n["executor_id"] for n in participants]
+        if (len(participants) > 1 and self.executor_id in ids
+                and _jax_distributed_enabled()):
             import jax
             jax.distributed.initialize(
                 coordinator_address=self.coordinator_address(),
-                num_processes=len(self.cluster_info),
-                process_id=self.task_sorted_index())
+                num_processes=len(participants),
+                process_id=ids.index(self.executor_id))
         import jax
         return jax.devices()
 
@@ -438,7 +454,7 @@ def _feed_partition(iterator, mgr, qname, feed_timeout):
     if chunk:
         _put_chunk(q, chunk, mgr, deadline)
         count += len(chunk)
-    q.put(marker.EndPartition())
+    _bounded_put(q, marker.EndPartition(), mgr, deadline)
     return count
 
 
@@ -467,8 +483,14 @@ def _join_feed(mgr, qname, feed_timeout, on_error="return"):
 
 
 def _put_chunk(q, chunk, mgr, deadline):
+    _bounded_put(q, list(chunk), mgr, deadline)
+
+
+def _bounded_put(q, item, mgr, deadline):
     """put with terminating-state + timeout checks (reference: abort if
     mgr state == 'terminating'; raise on feed_timeout -> task fail).
+    The broker queues are bounded (manager.QUEUE_MAXSIZE), so queue.Full
+    is the live backpressure path.
 
     Only ``queue.Full`` is retried — anything else (e.g. an unpicklable
     record) must surface immediately with its real traceback, not spin
@@ -476,7 +498,7 @@ def _put_chunk(q, chunk, mgr, deadline):
     """
     while True:
         try:
-            q.put(list(chunk), block=True, timeout=1.0)
+            q.put(item, block=True, timeout=1.0)
             return
         except _queue.Full:
             if mgr.get("state") in ("terminating", "stopped", "error"):
@@ -540,9 +562,12 @@ def shutdown(cluster_info, cluster_meta, queues=("input",), grace_secs=0):
             pass
         mgr = _get_manager(cluster_info, cluster_meta, _local_executor_id())
         # End-of-feed marker unblocks DataFeed.next_batch deterministically.
+        # Bounded put: a full queue means the trainer stopped consuming —
+        # it will see the state flip below instead.
         for qname in queues:
             try:
-                mgr.get_queue(qname).put(marker.EndFeed())
+                mgr.get_queue(qname).put(marker.EndFeed(), block=True,
+                                         timeout=5.0)
             except Exception:
                 pass
         if mgr.get("state") == "running":

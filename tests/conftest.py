@@ -26,3 +26,14 @@ os.environ.setdefault("TFOS_TPU_TEST_MODE", "1")
 os.environ["TFOS_TPU_DISTRIBUTED"] = "0"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The sitecustomize's register() already ran at interpreter start (before
+# this conftest) and pinned jax.config jax_platforms to the axon TPU — env
+# vars alone can't undo a config override, so force it back to cpu before
+# any backend initializes. (Subprocesses are covered by the env vars above.)
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # pragma: no cover - jax always present in the image
+    pass

@@ -1,0 +1,164 @@
+"""DataFrame ⇄ TFRecord conversion utilities.
+
+Reference: ``tensorflowonspark/dfutil.py`` (SURVEY.md §2 "TFRecord
+interop"): ``saveAsTFRecords`` / ``loadTFRecords`` / ``infer_schema`` /
+``toTFExample`` / ``fromTFExample``. The reference delegated the file
+format to the third-party tensorflow-hadoop JAR; here the codec is
+first-party (:mod:`tensorflowonspark_tpu.tfrecord`) and the files are
+written/read directly by executor tasks in the Hadoop ``part-*`` layout.
+"""
+
+import os
+
+import numpy as np
+
+from tensorflowonspark_tpu import tfrecord
+from tensorflowonspark_tpu.engine.dataframe import DataFrame
+
+#: dtype -> (example kind, row-value converter on load)
+_KIND_OF = {"int64": "int64", "float32": "float",
+            "string": "bytes", "binary": "bytes",
+            "array<int64>": "int64", "array<float32>": "float",
+            "array<binary>": "bytes"}
+
+
+def toTFExample(schema):
+    """Returns rows -> serialized Example bytes iterator transform.
+
+    Reference: ``dfutil.toTFExample(dtypes)`` used per-partition via
+    ``df.rdd.mapPartitions``.
+    """
+    schema = list(schema)
+
+    def _convert(iterator):
+        for row in iterator:
+            features = {}
+            for name, dtype in schema:
+                v = row[name]
+                if dtype == "string":
+                    v = [v.encode("utf-8") if isinstance(v, str) else bytes(v)]
+                elif dtype == "binary":
+                    v = [bytes(v)]
+                elif dtype == "int64":
+                    v = [int(v)]
+                elif dtype == "float32":
+                    v = [float(v)]
+                elif dtype.startswith("array<"):
+                    inner = dtype[6:-1]
+                    if inner == "int64":
+                        v = [int(x) for x in v]
+                    elif inner == "float32":
+                        v = [float(x) for x in v]
+                    else:
+                        v = [bytes(x) for x in v]
+                else:
+                    raise TypeError("unsupported dtype {}".format(dtype))
+                features[name] = v
+            yield tfrecord.encode_example(features)
+
+    return _convert
+
+
+def fromTFExample(schema=None, binary_features=()):
+    """Returns serialized-Example -> row-dict iterator transform.
+
+    Reference: ``dfutil.fromTFExample``. ``binary_features`` lists
+    bytes_list columns to keep as raw bytes (others decode utf-8, matching
+    the reference's string-by-default behavior).
+    """
+    binary = set(binary_features)
+    schema = list(schema) if schema else None
+
+    def _convert(iterator):
+        for data in iterator:
+            parsed = tfrecord.parse_example(bytes(data))
+            row = {}
+            for name, (kind, values) in parsed.items():
+                if kind == "bytes":
+                    if name not in binary:
+                        values = [v.decode("utf-8") for v in values]
+                elif kind == "float":
+                    values = [float(v) for v in values]
+                elif kind == "int64":
+                    values = [int(v) for v in values]
+                if schema is not None:
+                    dtype = dict(schema).get(name, "")
+                    row[name] = values if dtype.startswith("array<") else \
+                        (values[0] if values else None)
+                else:
+                    row[name] = values[0] if len(values) == 1 else values
+            yield row
+
+    return _convert
+
+
+def infer_schema(example_bytes, binary_features=()):
+    """First serialized Example -> [(name, dtype)] (sorted).
+
+    Reference: ``dfutil.infer_schema`` on the first record. Multi-value
+    features map to array<> dtypes; single-value to scalars (so fixed-size
+    vectors round-trip as arrays).
+    """
+    parsed = tfrecord.parse_example(bytes(example_bytes))
+    schema = []
+    for name in sorted(parsed):
+        kind, values = parsed[name]
+        if kind == "bytes":
+            base = "binary" if name in binary_features else "string"
+        elif kind == "float":
+            base = "float32"
+        elif kind == "int64":
+            base = "int64"
+        else:
+            base = "float32"
+        if len(values) > 1:
+            base = "array<{}>".format(base)
+        schema.append((name, base))
+    return schema
+
+
+def saveAsTFRecords(df, output_dir):
+    """Write a DataFrame as ``part-NNNNN`` TFRecord files.
+
+    Reference: ``dfutil.saveAsTFRecords(df, output_dir)`` (which went
+    through ``saveAsNewAPIHadoopFile``). Fails if output_dir exists, like
+    Hadoop output committers do.
+    """
+    os.makedirs(output_dir, exist_ok=False)
+    schema = df.schema
+    serialized = df.rdd.mapPartitions(toTFExample(schema))
+
+    def _write(index, iterator):
+        path = os.path.join(output_dir, "part-%05d" % index)
+        with tfrecord.TFRecordWriter(path) as w:
+            count = 0
+            for record in iterator:
+                w.write(record)
+                count += 1
+        yield count
+
+    return sum(serialized.mapPartitionsWithIndex(_write).collect())
+
+
+def loadTFRecords(sc, input_dir, binary_features=(), num_partitions=None):
+    """Load a TFRecord directory as a DataFrame.
+
+    Reference: ``dfutil.loadTFRecords`` — reads the first record to infer
+    the schema, then parses every file. One partition per part file by
+    default (the Hadoop-split analog).
+    """
+    files = tfrecord.list_tfrecord_files(input_dir)
+    if not files:
+        raise FileNotFoundError("no part-* TFRecord files in " + input_dir)
+    first = next(iter(tfrecord.tfrecord_iterator(files[0])))
+    schema = infer_schema(first, binary_features)
+
+    file_rdd = sc.parallelize(files, num_partitions or len(files))
+    conv = fromTFExample(schema, binary_features)
+
+    def _read(iterator):
+        for path in iterator:
+            for row in conv(tfrecord.tfrecord_iterator(path)):
+                yield row
+
+    return DataFrame(file_rdd.mapPartitions(_read), schema)

@@ -311,6 +311,11 @@ class Context(object):
             start = end
         return RDD(self, parts)
 
+    def createDataFrame(self, data, schema=None, num_slices=None):
+        """Rows -> DataFrame (see engine/dataframe.py for row/schema forms)."""
+        from tensorflowonspark_tpu.engine.dataframe import create_dataframe
+        return create_dataframe(self, data, schema, num_slices)
+
     def union(self, rdds):
         out = rdds[0]
         for r in rdds[1:]:

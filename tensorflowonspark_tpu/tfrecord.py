@@ -1,0 +1,315 @@
+"""TFRecord + tf.train.Example codec — no TensorFlow dependency.
+
+Reference capability: the ``tensorflow-hadoop`` connector JAR (Java) that
+``dfutil`` drove through Spark's Hadoop I/O (SURVEY.md §2 "TFRecord
+interop", §2.2 native table). The format is tiny, so the TPU-native build
+owns it outright (SURVEY.md §7.2 step 6):
+
+record framing (tfrecord_writer.cc upstream):
+    uint64 length | uint32 masked_crc32c(length) | bytes data |
+    uint32 masked_crc32c(data)
+
+payload: a ``tf.train.Example`` protobuf —
+    Example{ features: Features{ feature: map<string, Feature> } }
+    Feature is oneof bytes_list(1) / float_list(2) / int64_list(3).
+
+The proto wire codec below is hand-rolled for exactly this fixed schema
+(varint + length-delimited walking), checked in tests against the real
+``tensorflow`` serializers as oracle. crc32c comes from the C-accelerated
+``google_crc32c`` when present, else a pure-python table fallback.
+"""
+
+import os
+import struct
+
+import numpy as np
+
+try:
+    import google_crc32c
+
+    def _crc32c(data):
+        return google_crc32c.value(bytes(data))
+except ImportError:  # pragma: no cover - present in the image
+    _TABLE = []
+
+    def _crc32c(data, _poly=0x82F63B78):
+        if not _TABLE:
+            for n in range(256):
+                c = n
+                for _ in range(8):
+                    c = (c >> 1) ^ (_poly if c & 1 else 0)
+                _TABLE.append(c)
+        crc = 0xFFFFFFFF
+        for b in bytes(data):
+            crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+        return crc ^ 0xFFFFFFFF
+
+
+def masked_crc32c(data):
+    """TFRecord's rotated+offset crc32c mask."""
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# -- record framing --------------------------------------------------------
+
+_U64 = struct.Struct("<Q")
+_U32 = struct.Struct("<I")
+
+
+class TFRecordWriter(object):
+    """Append-only TFRecord file writer (context manager)."""
+
+    def __init__(self, path):
+        self._f = open(path, "wb")
+
+    def write(self, record):
+        record = bytes(record)
+        header = _U64.pack(len(record))
+        self._f.write(header)
+        self._f.write(_U32.pack(masked_crc32c(header)))
+        self._f.write(record)
+        self._f.write(_U32.pack(masked_crc32c(record)))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def tfrecord_iterator(path, verify_crc=True):
+    """Yield raw record bytes from a TFRecord file."""
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if not header:
+                return
+            if len(header) < 8:
+                raise ValueError("truncated TFRecord length header")
+            (length,) = _U64.unpack(header)
+            (length_crc,) = _U32.unpack(f.read(4))
+            if verify_crc and masked_crc32c(header) != length_crc:
+                raise ValueError("corrupt TFRecord: bad length crc")
+            data = f.read(length)
+            if len(data) < length:
+                raise ValueError("truncated TFRecord payload")
+            (data_crc,) = _U32.unpack(f.read(4))
+            if verify_crc and masked_crc32c(data) != data_crc:
+                raise ValueError("corrupt TFRecord: bad data crc")
+            yield data
+
+
+# -- protobuf wire primitives ---------------------------------------------
+
+def _write_varint(buf, value):
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            buf.append(bits | 0x80)
+        else:
+            buf.append(bits)
+            return
+
+
+def _read_varint(data, pos):
+    shift = 0
+    result = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _tag(field, wire_type):
+    return (field << 3) | wire_type
+
+
+def _write_len_delimited(buf, field, payload):
+    _write_varint(buf, _tag(field, 2))
+    _write_varint(buf, len(payload))
+    buf.extend(payload)
+
+
+# -- Example encoding ------------------------------------------------------
+
+def _encode_feature(values):
+    """values: list of bytes/str | float | int -> Feature message bytes."""
+    inner = bytearray()
+    if not values:
+        return bytes(inner)  # empty Feature (no kind set)
+    v0 = values[0]
+    if isinstance(v0, (bytes, bytearray, str, np.bytes_)):
+        sub = bytearray()
+        for v in values:
+            if isinstance(v, str):
+                v = v.encode("utf-8")
+            _write_len_delimited(sub, 1, bytes(v))
+        _write_len_delimited(inner, 1, sub)  # bytes_list = field 1
+    elif isinstance(v0, (float, np.floating)):
+        packed = np.asarray(values, "<f4").tobytes()
+        sub = bytearray()
+        _write_len_delimited(sub, 1, packed)  # packed floats, field 1
+        _write_len_delimited(inner, 2, sub)  # float_list = field 2
+    elif isinstance(v0, (int, np.integer, bool)):
+        sub = bytearray()
+        packed = bytearray()
+        for v in values:
+            _write_varint(packed, int(v) & 0xFFFFFFFFFFFFFFFF)
+        _write_len_delimited(sub, 1, packed)  # packed varints, field 1
+        _write_len_delimited(inner, 3, sub)  # int64_list = field 3
+    else:
+        raise TypeError("unsupported feature value type: {}".format(type(v0)))
+    return bytes(inner)
+
+
+def encode_example(features):
+    """{name: scalar | list | 1-D ndarray} -> serialized tf.train.Example.
+
+    Type mapping mirrors the reference's ``dfutil.toTFExample``:
+    bytes/str -> bytes_list, float -> float_list, int/bool -> int64_list.
+    """
+    fmap = bytearray()
+    # deterministic output: sorted feature names (map order is unspecified
+    # in proto, but byte-stable files diff nicely)
+    for name in sorted(features):
+        values = features[name]
+        if isinstance(values, np.ndarray):
+            values = values.reshape(-1).tolist()
+        elif not isinstance(values, (list, tuple)):
+            values = [values]
+        entry = bytearray()
+        _write_len_delimited(entry, 1, name.encode("utf-8"))  # key
+        _write_len_delimited(entry, 2, _encode_feature(list(values)))  # value
+        _write_len_delimited(fmap, 1, bytes(entry))  # map entry: feature=1
+    example = bytearray()
+    _write_len_delimited(example, 1, bytes(fmap))  # features = field 1
+    return bytes(example)
+
+
+# -- Example decoding ------------------------------------------------------
+
+def _iter_fields(data):
+    """Yield (field_number, wire_type, value, next_pos) over a message."""
+    pos = 0
+    n = len(data)
+    while pos < n:
+        key, pos = _read_varint(data, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            value, pos = _read_varint(data, pos)
+        elif wire == 2:
+            length, pos = _read_varint(data, pos)
+            value = data[pos:pos + length]
+            pos += length
+        elif wire == 5:
+            value = data[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            value = data[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError("unsupported wire type {}".format(wire))
+        yield field, wire, value
+
+
+def _decode_packed_varints(data):
+    out = []
+    pos = 0
+    while pos < len(data):
+        v, pos = _read_varint(data, pos)
+        if v >= 1 << 63:  # two's-complement int64
+            v -= 1 << 64
+        out.append(v)
+    return out
+
+
+def _decode_feature(data):
+    """Feature message -> (kind, values)."""
+    for field, wire, value in _iter_fields(data):
+        if field == 1:  # bytes_list
+            vals = [bytes(v) for f, w, v in _iter_fields(value) if f == 1]
+            return "bytes", vals
+        if field == 2:  # float_list
+            vals = []
+            for f, w, v in _iter_fields(value):
+                if f != 1:
+                    continue
+                if w == 2:  # packed
+                    vals.extend(np.frombuffer(v, "<f4").tolist())
+                else:  # unpacked 32-bit
+                    vals.append(struct.unpack("<f", v)[0])
+            return "float", vals
+        if field == 3:  # int64_list
+            vals = []
+            for f, w, v in _iter_fields(value):
+                if f != 1:
+                    continue
+                if w == 2:
+                    vals.extend(_decode_packed_varints(v))
+                else:
+                    x = v if isinstance(v, int) else _read_varint(v, 0)[0]
+                    if x >= 1 << 63:
+                        x -= 1 << 64
+                    vals.append(x)
+            return "int64", vals
+    return "empty", []
+
+
+def parse_example(data):
+    """Serialized Example -> {name: (kind, values)}."""
+    out = {}
+    for field, wire, value in _iter_fields(data):
+        if field != 1:  # features
+            continue
+        for f, w, entry in _iter_fields(value):
+            if f != 1:  # feature map entry
+                continue
+            name = None
+            feat = ("empty", [])
+            for ef, ew, ev in _iter_fields(entry):
+                if ef == 1:
+                    name = ev.decode("utf-8")
+                elif ef == 2:
+                    feat = _decode_feature(ev)
+            if name is not None:
+                out[name] = feat
+    return out
+
+
+# -- directory-level helpers ----------------------------------------------
+
+def write_tfrecords(path, examples, compress=False):
+    """Write an iterable of feature-dicts to one TFRecord file."""
+    assert not compress, "compression not supported"
+    count = 0
+    with TFRecordWriter(path) as w:
+        for features in examples:
+            w.write(encode_example(features))
+            count += 1
+    return count
+
+
+def read_examples(path):
+    """Yield parsed {name: (kind, values)} dicts from a TFRecord file."""
+    for record in tfrecord_iterator(path):
+        yield parse_example(record)
+
+
+def list_tfrecord_files(directory):
+    """part-* files under ``directory``, sorted (the Hadoop layout)."""
+    names = [n for n in sorted(os.listdir(directory))
+             if n.startswith("part-") and not n.endswith(".crc")]
+    return [os.path.join(directory, n) for n in names]

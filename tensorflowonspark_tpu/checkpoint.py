@@ -1,0 +1,82 @@
+"""Training checkpoint/resume on orbax, with chief-only commit.
+
+Reference behavior (SURVEY.md §5 "Checkpoint / resume"): the reference
+delegates checkpointing to TF (MonitoredTrainingSession / Keras callbacks
+writing to shared storage); recovery = resubmit + restore latest. The
+TPU-native analog is orbax-checkpoint with the same division of labor:
+the framework supplies a manager wired to the node's role (only the chief
+commits under pure DP, where state is replicated), user code decides when
+to save.
+"""
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+class Checkpointer(object):
+    """Step-indexed train-state checkpoints under ``directory``.
+
+    Args:
+      directory: checkpoint root (shared storage in multi-host setups).
+      chief: whether this process commits (``ctx.job_name`` in the master
+        family). Non-chief saves are no-ops, mirroring chief-only export.
+      max_to_keep: retention.
+    """
+
+    def __init__(self, directory, chief=True, max_to_keep=3):
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        self.chief = chief
+        if chief:
+            os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=chief))
+
+    def save(self, step, state, force=False):
+        """Commit ``state`` at ``step`` (chief only); returns True if saved."""
+        if not self.chief:
+            return False
+        import jax
+        import orbax.checkpoint as ocp
+
+        state = jax.tree.map(lambda x: x, state)  # shallow copy
+        saved = self._mgr.save(int(step), args=ocp.args.StandardSave(state),
+                               force=force)
+        return bool(saved)
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def restore(self, state_like, step=None):
+        """Restore into the structure of ``state_like`` (init-shaped state).
+
+        Returns the restored state, or None if no checkpoint exists.
+        """
+        import orbax.checkpoint as ocp
+
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            return None
+        return self._mgr.restore(int(step),
+                                 args=ocp.args.StandardRestore(state_like))
+
+    def wait(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()
+
+
+def hook(checkpointer, every_steps=100):
+    """Trainer ``train_loop`` hook: save every N steps."""
+
+    def _hook(step_no, state, metrics):
+        if step_no % every_steps == 0:
+            checkpointer.save(int(state["step"]), state)
+
+    return _hook

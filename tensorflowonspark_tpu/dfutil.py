@@ -10,16 +10,8 @@ written/read directly by executor tasks in the Hadoop ``part-*`` layout.
 
 import os
 
-import numpy as np
-
 from tensorflowonspark_tpu import tfrecord
 from tensorflowonspark_tpu.engine.dataframe import DataFrame
-
-#: dtype -> (example kind, row-value converter on load)
-_KIND_OF = {"int64": "int64", "float32": "float",
-            "string": "bytes", "binary": "bytes",
-            "array<int64>": "int64", "array<float32>": "float",
-            "array<binary>": "bytes"}
 
 
 def toTFExample(schema):
@@ -49,8 +41,9 @@ def toTFExample(schema):
                         v = [int(x) for x in v]
                     elif inner == "float32":
                         v = [float(x) for x in v]
-                    else:
-                        v = [bytes(x) for x in v]
+                    else:  # array<string> / array<binary>
+                        v = [x.encode("utf-8") if isinstance(x, str)
+                             else bytes(x) for x in v]
                 else:
                     raise TypeError("unsupported dtype {}".format(dtype))
                 features[name] = v
@@ -68,6 +61,7 @@ def fromTFExample(schema=None, binary_features=()):
     """
     binary = set(binary_features)
     schema = list(schema) if schema else None
+    smap = dict(schema) if schema else None
 
     def _convert(iterator):
         for data in iterator:
@@ -75,18 +69,36 @@ def fromTFExample(schema=None, binary_features=()):
             row = {}
             for name, (kind, values) in parsed.items():
                 if kind == "bytes":
-                    if name not in binary:
+                    if name not in binary and (smap is None or
+                                               "binary" not in
+                                               smap.get(name, "")):
                         values = [v.decode("utf-8") for v in values]
                 elif kind == "float":
                     values = [float(v) for v in values]
                 elif kind == "int64":
                     values = [int(v) for v in values]
-                if schema is not None:
-                    dtype = dict(schema).get(name, "")
-                    row[name] = values if dtype.startswith("array<") else \
-                        (values[0] if values else None)
+                if smap is not None:
+                    dtype = smap.get(name, "")
+                    if dtype.startswith("array<"):
+                        row[name] = values
+                    else:
+                        if len(values) > 1:
+                            raise ValueError(
+                                "feature {!r} inferred as scalar {} but a "
+                                "record holds {} values — variable-length "
+                                "features need an array<> dtype (pass an "
+                                "explicit schema)".format(
+                                    name, dtype, len(values)))
+                        row[name] = values[0] if values else None
                 else:
                     row[name] = values[0] if len(values) == 1 else values
+            if smap is not None:
+                # Example features are optional per record: keep rows
+                # rectangular so select()/re-save never KeyError.
+                for cname, cdtype in smap.items():
+                    if cname not in row:
+                        row[cname] = [] if cdtype.startswith("array<") \
+                            else None
             yield row
 
     return _convert
@@ -150,7 +162,15 @@ def loadTFRecords(sc, input_dir, binary_features=(), num_partitions=None):
     files = tfrecord.list_tfrecord_files(input_dir)
     if not files:
         raise FileNotFoundError("no part-* TFRecord files in " + input_dir)
-    first = next(iter(tfrecord.tfrecord_iterator(files[0])))
+    # Hadoop committers routinely write empty part files for empty
+    # partitions: infer from the first file that actually has a record.
+    first = None
+    for path in files:
+        first = next(iter(tfrecord.tfrecord_iterator(path)), None)
+        if first is not None:
+            break
+    if first is None:
+        raise ValueError("all part-* files in {} are empty".format(input_dir))
     schema = infer_schema(first, binary_features)
 
     file_rdd = sc.parallelize(files, num_partitions or len(files))

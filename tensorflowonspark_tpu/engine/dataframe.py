@@ -14,7 +14,7 @@ import numpy as np
 
 #: schema dtype vocabulary (mirrors the subset dfutil round-trips)
 DTYPES = ("int64", "float32", "string", "binary",
-          "array<int64>", "array<float32>", "array<binary>")
+          "array<int64>", "array<float32>", "array<string>", "array<binary>")
 
 
 def _infer_dtype(value):

@@ -1,0 +1,82 @@
+"""Model export/load — the SavedModel analog.
+
+Reference: chief-only ``compat.export_saved_model`` / TF SavedModel
+consumed by ``pipeline.TFModel._transform`` (SURVEY.md §2 "TF1/TF2 compat
+shims", §3.4). The TPU-native exchange format is a directory::
+
+    export_dir/
+      meta.json        {"format": ..., "signature": {...}}
+      apply_fn.pkl     cloudpickled (variables, batch) -> outputs callable
+      variables/       orbax checkpoint of the variables pytree
+
+Loading is cached per-process keyed on the directory (the reference's
+``pipeline._run_model`` global-singleton trick) so Spark-style repeated
+partition tasks reuse the loaded model.
+"""
+
+import json
+import logging
+import os
+import threading
+
+logger = logging.getLogger(__name__)
+
+_FORMAT = "tfos-tpu-export-v1"
+_CACHE = {}
+_CACHE_LOCK = threading.Lock()
+
+
+def save_model(export_dir, apply_fn, variables, signature=None):
+    """Write an export the pipeline's TFModel can serve.
+
+    Args:
+      export_dir: target directory (created; must not exist).
+      apply_fn: ``(variables, batch_dict) -> outputs`` — a pure function
+        (cloudpickled, so closures over a flax module are fine).
+      variables: pytree of arrays (e.g. ``{"params": ..., "batch_stats"}``).
+      signature: optional {"inputs": [...], "outputs": [...]} column names,
+        the SignatureDef analog used by default input/output mappings.
+    """
+    import cloudpickle
+    import jax
+    import orbax.checkpoint as ocp
+
+    os.makedirs(export_dir, exist_ok=False)
+    # orbax wants fully-materialized host arrays for a portable export
+    variables = jax.tree.map(lambda x: jax.device_get(x), variables)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(os.path.abspath(export_dir), "variables"),
+               variables)
+    ckptr.wait_until_finished()
+    with open(os.path.join(export_dir, "apply_fn.pkl"), "wb") as f:
+        f.write(cloudpickle.dumps(apply_fn))
+    with open(os.path.join(export_dir, "meta.json"), "w") as f:
+        json.dump({"format": _FORMAT, "signature": signature or {}}, f)
+    logger.info("exported model to %s", export_dir)
+
+
+def load_model(export_dir, cache=True):
+    """(apply_fn, variables, signature) — cached per process.
+
+    Reference: ``pipeline._run_model``'s args-keyed cached SavedModel load.
+    """
+    key = os.path.abspath(export_dir)
+    with _CACHE_LOCK:
+        if cache and key in _CACHE:
+            return _CACHE[key]
+    import cloudpickle
+    import orbax.checkpoint as ocp
+
+    with open(os.path.join(export_dir, "meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("format") != _FORMAT:
+        raise ValueError("not a {} export: {}".format(_FORMAT, export_dir))
+    with open(os.path.join(export_dir, "apply_fn.pkl"), "rb") as f:
+        apply_fn = cloudpickle.loads(f.read())
+    variables = ocp.StandardCheckpointer().restore(
+        os.path.join(key, "variables"))
+    result = (apply_fn, variables, meta.get("signature", {}))
+    with _CACHE_LOCK:
+        if cache:
+            _CACHE[key] = result
+    return result

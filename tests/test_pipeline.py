@@ -1,0 +1,136 @@
+"""Pipeline API tests (reference analog: tests/test_pipeline.py).
+
+TFEstimator.fit on a tiny model -> export -> TFModel.transform with
+input/output column mappings; plus export/load and checkpoint round-trips.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.engine import Context
+
+
+@pytest.fixture()
+def sc(tmp_path):
+    ctx = Context(num_executors=2, work_root=str(tmp_path / "engine"))
+    yield ctx
+    ctx.stop()
+
+
+def test_export_load_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import export
+
+    def apply_fn(variables, batch):
+        return {"y": batch["x"] * variables["w"] + variables["b"]}
+
+    variables = {"w": jnp.asarray(2.0), "b": jnp.asarray(1.0)}
+    d = str(tmp_path / "export")
+    export.save_model(d, apply_fn, variables,
+                      signature={"inputs": ["x"], "outputs": ["y"]})
+    fn, restored, sig = export.load_model(d)
+    out = fn(restored, {"x": np.asarray([1.0, 2.0])})
+    assert np.allclose(out["y"], [3.0, 5.0])
+    assert sig["inputs"] == ["x"]
+    # cache: same object back
+    assert export.load_model(d)[0] is fn
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import checkpoint
+
+    state = {"params": {"w": jnp.asarray([1.0, 2.0])},
+             "step": jnp.asarray(5, jnp.int32)}
+    ckpt = checkpoint.Checkpointer(str(tmp_path / "ckpt"), chief=True,
+                                   max_to_keep=2)
+    assert ckpt.save(5, state)
+    ckpt.wait()
+    assert ckpt.latest_step() == 5
+    like = {"params": {"w": jnp.zeros((2,))}, "step": jnp.asarray(0, jnp.int32)}
+    restored = ckpt.restore(like)
+    assert np.allclose(restored["params"]["w"], [1.0, 2.0])
+    assert int(restored["step"]) == 5
+    ckpt.close()
+
+    nonchief = checkpoint.Checkpointer(str(tmp_path / "ckpt2"), chief=False)
+    assert nonchief.save(1, state) is False
+    nonchief.close()
+
+
+def test_estimator_fit_transform(sc, tmp_path):
+    """fit trains y = 2x via the cluster; transform serves predictions."""
+    from tensorflowonspark_tpu import pipeline
+
+    export_dir = str(tmp_path / "model_export")
+
+    def train_fn(args, ctx):
+        import jax
+        import jax.numpy as jnp
+
+        from tensorflowonspark_tpu import export
+
+        feed = ctx.get_data_feed(train_mode=True)
+        w = jnp.zeros(())
+
+        @jax.jit
+        def step(w, x, y):
+            g = jax.grad(lambda w: jnp.mean((w * x - y) ** 2))(w)
+            return w - 0.3 * g
+
+        while not feed.should_stop():
+            batch = feed.next_batch(args.batch_size)
+            if not batch:
+                continue
+            x = jnp.asarray([r[0] for r in batch])
+            y = jnp.asarray([r[1] for r in batch])
+            w = step(w, x, y)
+
+        if ctx.job_name == "chief":
+            def apply_fn(variables, batch):
+                return {"pred": batch["features"] * variables["w"]}
+
+            export.save_model(args.export_dir, apply_fn,
+                              {"w": jax.device_get(w)},
+                              signature={"inputs": ["features"],
+                                         "outputs": ["pred"]})
+
+    rows = [{"x": float(i % 8) / 8.0, "y": 2.0 * (i % 8) / 8.0}
+            for i in range(256)]
+    df = sc.createDataFrame(rows, num_slices=4)
+
+    est = (pipeline.TFEstimator(train_fn)
+           .setClusterSize(2)
+           .setBatchSize(16)
+           .setEpochs(4)
+           .setExportDir(export_dir)
+           .setInputMapping({"x": "x", "y": "y"}))
+    model = est.fit(df)
+    assert os.path.isdir(export_dir)
+
+    model.setInputMapping({"x": "features"}) \
+         .setOutputMapping({"pred": "prediction"}) \
+         .setBatchSize(32)
+    preds = model.transform(df.select("x")).collect()
+    assert len(preds) == 256
+    xs = [r["x"] for r in df.collect()]
+    for row, x in zip(preds, xs):
+        assert abs(row["prediction"] - 2.0 * x) < 0.15, (row, x)
+
+
+def test_params_accessors():
+    from tensorflowonspark_tpu import pipeline
+
+    est = pipeline.TFEstimator(lambda a, c: None, {"lr": 0.5})
+    est.setBatchSize(42).setModelDir("/tmp/m")
+    assert est.getBatchSize() == 42
+    assert est.getModelDir() == "/tmp/m"
+    merged = est.merged_args()
+    assert merged.batch_size == 42 and merged.lr == 0.5
+    assert merged.epochs == 1  # default
+    with pytest.raises(AttributeError):
+        est.setNoSuchParam(1)

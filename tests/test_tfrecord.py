@@ -100,6 +100,34 @@ def test_corruption_detected(tmp_path):
         list(tfrecord.tfrecord_iterator(path))
 
 
+def test_dfutil_string_arrays_and_empty_parts(tmp_path, request):
+    """array<string> round-trips; empty part files don't break schema
+    inference; variable-length under scalar dtype raises."""
+    from tensorflowonspark_tpu import dfutil
+    from tensorflowonspark_tpu.engine import Context
+
+    sc = Context(num_executors=2, work_root=str(tmp_path / "eng2"))
+    request.addfinalizer(sc.stop)
+    rows = [{"toks": ["a", "b-%d" % i], "n": i} for i in range(6)]
+    df = sc.createDataFrame(rows, num_slices=2)
+    out = str(tmp_path / "recs")
+    assert dfutil.saveAsTFRecords(df, out) == 6
+    # prepend an empty part file: schema inference must skip it
+    open(out + "/part-00000a", "wb").close()
+    import os
+    os.rename(out + "/part-00000a", out + "/part-.empty")
+    got = sorted(dfutil.loadTFRecords(sc, out).collect(),
+                 key=lambda r: r["n"])
+    assert got[3]["toks"] == ["a", "b-3"]
+
+    # scalar-inferred column fed variable-length data -> explicit error
+    conv = dfutil.fromTFExample(schema=[("v", "int64")])
+    from tensorflowonspark_tpu import tfrecord as tfr
+    bad = tfr.encode_example({"v": [1, 2]})
+    with pytest.raises(ValueError, match="array<>"):
+        list(conv([bad]))
+
+
 def test_dfutil_roundtrip(tmp_path, request):
     from tensorflowonspark_tpu import dfutil
     from tensorflowonspark_tpu.engine import Context

@@ -29,3 +29,16 @@ processes) — so nothing here may import jax at module scope.
 __version__ = "0.1.0"
 
 from tensorflowonspark_tpu.marker import EndFeed, EndPartition, Marker  # noqa: F401
+
+
+def __getattr__(name):
+    """Lazy submodule access (``tensorflowonspark_tpu.cluster`` etc.)
+    without importing the heavier layers at package-import time."""
+    import importlib
+
+    if name.startswith("_"):
+        raise AttributeError(name)
+    try:
+        return importlib.import_module("tensorflowonspark_tpu." + name)
+    except ModuleNotFoundError:
+        raise AttributeError(name)

@@ -54,16 +54,26 @@ class TFCluster(object):
     # -- training --------------------------------------------------------
 
     def train(self, dataRDD, num_epochs=0, feed_timeout=600, qname="input"):
-        """Feed an RDD to the cluster for training (``InputMode.SPARK``).
+        """Feed an RDD (or a DStream, for continuous training) to the
+        cluster (``InputMode.SPARK``).
 
         Epochs are implemented exactly as the reference does (SURVEY.md
         §3.2): ``sc.union([dataRDD] * num_epochs)`` — partition order is
-        preserved, so every epoch replays the same data stream.
+        preserved, so every epoch replays the same data stream. A DStream
+        registers a per-micro-batch feed instead (reference: Spark
+        Streaming support in ``TFCluster.train``).
         """
-        logger.info("training over %d partitions, %d epoch(s)",
-                    dataRDD.getNumPartitions(), max(num_epochs, 1))
         assert self.input_mode == InputMode.SPARK, \
             "train() requires InputMode.SPARK"
+        if hasattr(dataRDD, "foreachRDD"):  # DStream
+            logger.info("continuous training from stream")
+            dataRDD.foreachRDD(
+                lambda rdd: rdd.foreachPartition(
+                    node.train(self.cluster_info, self.cluster_meta,
+                               feed_timeout=feed_timeout, qname=qname)))
+            return
+        logger.info("training over %d partitions, %d epoch(s)",
+                    dataRDD.getNumPartitions(), max(num_epochs, 1))
         if num_epochs > 1:
             dataRDD = self.sc.union([dataRDD] * num_epochs)
         dataRDD.foreachPartition(
@@ -91,10 +101,16 @@ class TFCluster(object):
         background trainers; waits for the async bootstrap job; stops the
         reservation server; errors surface as a raised ``RuntimeError``.
         """
-        if ssc is not None:
-            ssc.stop()
-
         shutdown_error = None
+        stream_error = None
+        if ssc is not None:
+            # A failed micro-batch must not short-circuit the teardown —
+            # trainers would hang on the input queue and the real error
+            # (surfaced by node.shutdown below) would be masked.
+            try:
+                ssc.stop()
+            except Exception as e:  # noqa: BLE001 - re-raised after cleanup
+                stream_error = e
         if self.input_mode == InputMode.SPARK:
             workers = self.sc.parallelize(range(self.num_executors),
                                           self.num_executors)
@@ -140,6 +156,9 @@ class TFCluster(object):
         if bootstrap_error is not None:
             raise RuntimeError(
                 "cluster node failed") from bootstrap_error
+        if stream_error is not None:
+            raise RuntimeError(
+                "streaming feed failed") from stream_error
         logger.info("cluster shut down cleanly")
 
     def tensorboard_url(self):

@@ -19,6 +19,7 @@ TPU-native differences:
 """
 
 import logging
+import time
 
 import numpy as np
 
@@ -48,6 +49,9 @@ class DataFeed(object):
         self._queue_in = mgr.get_queue(qname_in)
         self._queue_out = None if train_mode else mgr.get_queue(qname_out)
         self._pending = []  # remainder of a partially-consumed chunk
+        # feed-plane visibility the reference lacked (SURVEY.md §5
+        # tracing): how long the consumer sat blocked on the queue.
+        self._stats = {"records": 0, "chunks": 0, "wait_s": 0.0}
 
     def next_batch(self, batch_size):
         """Next batch of up to ``batch_size`` records.
@@ -69,7 +73,9 @@ class DataFeed(object):
                 continue
             if self.done_feeding:
                 break
+            t0 = time.monotonic()
             item = self._queue_in.get(block=True)
+            self._stats["wait_s"] += time.monotonic() - t0
             if isinstance(item, Marker):
                 self._queue_in.task_done()
                 if isinstance(item, EndFeed):
@@ -81,6 +87,8 @@ class DataFeed(object):
                 continue  # EndPartition with empty batch: keep reading
             chunk = item if isinstance(item, list) else [item]
             self._pending.extend(chunk)
+            self._stats["records"] += len(chunk)
+            self._stats["chunks"] += 1
             self._queue_in.task_done()
         if self.input_tensors is None:
             return batch
@@ -112,6 +120,10 @@ class DataFeed(object):
             if size == 0:
                 continue
             yield batch
+
+    def stats(self):
+        """{records, chunks, wait_s}: consumer-side feed-plane counters."""
+        return dict(self._stats)
 
     def should_stop(self):
         """True once the feed has ended (reference: ``DataFeed.should_stop``)."""

@@ -1,0 +1,49 @@
+"""User-facing node helpers — the reference's ``TFNode`` module surface.
+
+Reference: ``tensorflowonspark/TFNode.py`` (SURVEY.md §2 "Executor user
+API"): ``DataFeed`` (re-exported from :mod:`datafeed` here),
+``hdfs_path``, ``start_cluster_server``, ``export_saved_model``. Kept as a
+module so reference-style user code ports with an import swap::
+
+    from tensorflowonspark_tpu import tfnode as TFNode
+    feed = TFNode.DataFeed(ctx.mgr, train_mode=True)
+"""
+
+import logging
+
+from tensorflowonspark_tpu.datafeed import DataFeed  # noqa: F401
+
+logger = logging.getLogger(__name__)
+
+
+def hdfs_path(ctx, path):
+    """Absolutize a user path against the cluster's default FS/working dir.
+
+    Reference: ``TFNode.hdfs_path(ctx, path)``.
+    """
+    return ctx.absolute_path(path)
+
+
+def start_cluster_server(ctx, num_devices=1, protocol=None):
+    """Join the device collective; returns the local jax devices.
+
+    Reference: TF1-era ``TFNode.start_cluster_server(ctx, num_gpus, rdma)``
+    built a ``tf.train.Server`` (grpc / grpc+verbs). On TPU the transport
+    is ICI/DCN managed by the runtime — ``protocol`` is accepted and
+    ignored for parity — and 'starting the server' is
+    ``jax.distributed.initialize`` via :meth:`NodeContext.initialize_jax`.
+    """
+    if protocol not in (None, "grpc"):
+        logger.warning("protocol=%r has no TPU analog (ICI/DCN is runtime-"
+                       "managed); ignoring", protocol)
+    return ctx.initialize_jax()
+
+
+def export_saved_model(export_dir, apply_fn, variables, signature=None):
+    """Chief-side model export (reference: ``TFNode.export_saved_model``).
+
+    Thin delegate to :func:`tensorflowonspark_tpu.export.save_model`.
+    """
+    from tensorflowonspark_tpu import export
+
+    export.save_model(export_dir, apply_fn, variables, signature)

@@ -1,0 +1,108 @@
+"""Tracing / profiling / metrics hookup.
+
+Reference posture (SURVEY.md §5 "Tracing/profiling"): the reference only
+wires TensorBoard (subprocess on one node) and leaves summaries to user
+code; its own plumbing is unobservable. Here the framework exposes:
+
+- :func:`start_profiler_server` — per-host ``jax.profiler`` server, so
+  TensorBoard's profile plugin (or ``xprof``) can capture device traces.
+- :func:`trace` — context manager around ``jax.profiler.trace`` for
+  programmatic capture windows.
+- :class:`SummaryWriter` — scalar/text summaries for TensorBoard, backed
+  by the installed TF's ``tf.summary`` (CPU TF is in the image); no-ops
+  cleanly when TF is absent.
+- :func:`metrics_hook` — a ``Trainer.train_loop`` hook writing loss +
+  step rate, the part the reference couldn't see (queue-fed step timing).
+"""
+
+import logging
+import time
+
+logger = logging.getLogger(__name__)
+
+
+def start_profiler_server(port=9012):
+    """Start the jax profiler gRPC server on this host (idempotent-ish)."""
+    import jax
+
+    try:
+        jax.profiler.start_server(port)
+        logger.info("jax profiler server on port %d", port)
+        return port
+    except Exception as e:  # noqa: BLE001 - profiling is best-effort
+        logger.warning("profiler server failed to start: %s", e)
+        return None
+
+
+class trace(object):
+    """``with tracing.trace(log_dir):`` captures a device trace window."""
+
+    def __init__(self, log_dir):
+        self.log_dir = log_dir
+
+    def __enter__(self):
+        import jax
+
+        jax.profiler.start_trace(self.log_dir)
+        return self
+
+    def __exit__(self, *exc):
+        import jax
+
+        jax.profiler.stop_trace()
+
+
+class SummaryWriter(object):
+    """TensorBoard scalar writer (tf.summary backend, graceful no-op)."""
+
+    def __init__(self, log_dir):
+        self.log_dir = log_dir
+        try:
+            import tensorflow as tf
+
+            self._writer = tf.summary.create_file_writer(log_dir)
+            self._tf = tf
+        except Exception:  # noqa: BLE001
+            logger.warning("tensorflow unavailable: summaries disabled")
+            self._writer = None
+
+    def scalar(self, tag, value, step):
+        if self._writer is None:
+            return
+        with self._writer.as_default():
+            self._tf.summary.scalar(tag, float(value), step=int(step))
+
+    def text(self, tag, value, step):
+        if self._writer is None:
+            return
+        with self._writer.as_default():
+            self._tf.summary.text(tag, str(value), step=int(step))
+
+    def flush(self):
+        if self._writer is not None:
+            self._writer.flush()
+
+    def close(self):
+        if self._writer is not None:
+            self._writer.close()
+
+
+def metrics_hook(writer, every_steps=10, examples_per_step=None):
+    """train_loop hook: loss + steps/sec (+ examples/sec) to TensorBoard."""
+    state = {"t0": time.monotonic(), "last": 0}
+
+    def _hook(step_no, train_state, metrics):
+        if step_no % every_steps:
+            return
+        now = time.monotonic()
+        dsteps = step_no - state["last"]
+        dt = max(now - state["t0"], 1e-9)
+        writer.scalar("train/loss", float(metrics["loss"]), step_no)
+        writer.scalar("train/steps_per_sec", dsteps / dt, step_no)
+        if examples_per_step:
+            writer.scalar("train/examples_per_sec",
+                          dsteps * examples_per_step / dt, step_no)
+        writer.flush()
+        state["t0"], state["last"] = now, step_no
+
+    return _hook

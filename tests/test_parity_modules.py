@@ -1,0 +1,149 @@
+"""Tests for the reference-surface parity modules: TFParallel analog,
+streaming DStreams, device_info, compat, tfnode."""
+
+import os
+import queue
+
+import pytest
+
+from tensorflowonspark_tpu.engine import Context
+from tensorflowonspark_tpu.engine.streaming import StreamingContext
+
+
+@pytest.fixture()
+def sc(tmp_path):
+    ctx = Context(num_executors=2, work_root=str(tmp_path / "engine"))
+    yield ctx
+    ctx.stop()
+
+
+def test_parallel_runner(sc):
+    from tensorflowonspark_tpu import parallel_runner
+
+    def map_fn(args, index):
+        import jax
+        import jax.numpy as jnp
+
+        return {"index": index,
+                "n_devices": len(jax.devices()),
+                "value": float(jnp.square(jnp.asarray(args["base"] + index)))}
+
+    results = parallel_runner.run(sc, map_fn, {"base": 3}, num_executors=2)
+    results = sorted(results, key=lambda r: r["index"])
+    assert [r["value"] for r in results] == [9.0, 16.0]
+    assert all(r["n_devices"] == 8 for r in results)
+
+
+def test_parallel_runner_error(sc):
+    from tensorflowonspark_tpu import parallel_runner
+
+    def boom(args, index):
+        raise ValueError("worker boom %d" % index)
+
+    with pytest.raises(Exception, match="boom"):
+        parallel_runner.run(sc, boom, {}, num_executors=2)
+
+
+def test_streaming_queue_stream(sc):
+    seen = []
+    ssc = StreamingContext(sc, batch_interval=0.05)
+    q = queue.Queue()
+    stream = ssc.queueStream(q)
+    stream.foreachRDD(lambda rdd: seen.append(sorted(rdd.collect())))
+    ssc.start()
+    q.put(sc.parallelize([1, 2, 3], 2))
+    q.put(sc.parallelize([4, 5], 1))
+    import time
+    deadline = time.monotonic() + 10
+    while len(seen) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    ssc.stop()
+    assert seen[:2] == [[1, 2, 3], [4, 5]]
+
+
+def test_streaming_text_file_stream(sc, tmp_path):
+    d = tmp_path / "incoming"
+    d.mkdir()
+    seen = []
+    ssc = StreamingContext(sc, batch_interval=0.05)
+    ssc.textFileStream(str(d), num_slices=1).foreachRDD(
+        lambda rdd: seen.extend(rdd.collect()))
+    ssc.start()
+    (d / "a.txt").write_text("one\ntwo\n")
+    import time
+    deadline = time.monotonic() + 10
+    while len(seen) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    ssc.stop()
+    assert seen == ["one", "two"]
+
+
+def test_streaming_cluster_train(sc):
+    """The reference DStream path: continuous queue-fed training."""
+    import json
+
+    from tensorflowonspark_tpu import cluster
+
+    out = {}
+
+    def map_fun(args, ctx):
+        feed = ctx.get_data_feed(train_mode=True)
+        total = 0
+        while not feed.should_stop():
+            total += sum(feed.next_batch(16))
+        with open(os.path.join(args["dir"], "sum-%d" % ctx.executor_id),
+                  "w") as f:
+            f.write(json.dumps(total))
+
+    workdir = sc.work_root
+    tfc = cluster.run(sc, map_fun, {"dir": workdir}, num_executors=2,
+                      input_mode=cluster.InputMode.SPARK)
+    ssc = StreamingContext(sc, batch_interval=0.05)
+    q = queue.Queue()
+    tfc.train(ssc.queueStream(q))
+    ssc.start()
+    q.put(sc.parallelize(range(10), 2))
+    q.put(sc.parallelize(range(10, 20), 2))
+    import time
+    time.sleep(1.0)
+    tfc.shutdown(ssc)
+    sums = []
+    for name in os.listdir(workdir):
+        if name.startswith("sum-"):
+            sums.append(json.loads(open(os.path.join(workdir, name)).read()))
+    assert sum(sums) == sum(range(20))
+
+
+def test_device_info_and_compat():
+    from tensorflowonspark_tpu import compat, device_info
+
+    # In this image the axon/TPU posture env is present in the outer env,
+    # but tests scrub it — either way these must not crash and must agree.
+    avail = device_info.is_tpu_available()
+    assert isinstance(avail, bool)
+    assert compat.is_tpu_available() == avail
+    if avail:
+        assert device_info.get_devices()
+    assert isinstance(device_info.topology_env(), dict)
+    assert compat.disable_auto_shard(options={"x": 1}) == {"x": 1}
+
+
+def test_tfnode_module(tmp_path):
+    import numpy as np
+
+    from tensorflowonspark_tpu import tfnode
+
+    class FakeCtx(object):
+        def absolute_path(self, p):
+            return "/abs/" + p
+
+    assert tfnode.hdfs_path(FakeCtx(), "model") == "/abs/model"
+    assert tfnode.DataFeed is not None
+
+    d = str(tmp_path / "exp")
+    tfnode.export_saved_model(
+        d, lambda v, b: {"y": b["x"] + v["c"]}, {"c": np.asarray(1.0)},
+        signature={"inputs": ["x"], "outputs": ["y"]})
+    from tensorflowonspark_tpu import export
+    fn, variables, sig = export.load_model(d)
+    assert float(fn(variables, {"x": np.asarray([2.0])})["y"][0]) == 3.0

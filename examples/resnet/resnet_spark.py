@@ -1,0 +1,94 @@
+"""Distributed ResNet training — the reference's ``examples/resnet`` analog
+(Keras multi-worker ResNet-CIFAR port; also covers BASELINE config #2's
+ResNet-50 shape with ``--imagenet``).
+
+Synthetic data by default (zero-egress environment); the data path and
+input pipeline match what a real CIFAR/ImageNet feed would use
+(InputMode.TENSORFLOW: each worker reads its shard; batches prefetched
+and sharded over the mesh).
+
+CPU dev run::
+
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= TFOS_TPU_DISTRIBUTED=0 \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/resnet/resnet_spark.py --cluster_size 2 --steps 10
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from tensorflowonspark_tpu import cluster  # noqa: E402
+from tensorflowonspark_tpu.engine import Context  # noqa: E402
+
+
+def map_fun(args, ctx):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import infeed, training
+    from tensorflowonspark_tpu.models.resnet import ResNet, ResNet50
+
+    ctx.initialize_jax()
+    mesh = ctx.mesh()
+    if args["imagenet"]:
+        model, image, classes = ResNet50(), 224, 1000
+    else:
+        model = ResNet(stage_sizes=[2, 2, 2], num_classes=10, width=16)
+        image, classes = 32, 10
+
+    trainer = training.Trainer(
+        model, optax.sgd(args["lr"], momentum=0.9), mesh)
+    rng = np.random.RandomState(ctx.task_index)
+
+    def batches():
+        for _ in range(args["steps"]):
+            yield {"x": rng.rand(args["batch_size"], image, image, 3)
+                   .astype(np.float32),
+                   "y": rng.randint(0, classes, args["batch_size"])}
+
+    state = trainer.init(jax.random.PRNGKey(0),
+                         np.zeros((8, image, image, 3), np.float32))
+    state, steps, rate = trainer.train_loop(
+        state, infeed.sharded_batches(batches(), mesh), log_every=10)
+    if ctx.job_name == "chief":
+        out = ctx.absolute_path(args["model_dir"])
+        os.makedirs(out, exist_ok=True)
+        with open(os.path.join(out, "train_stats.json"), "w") as f:
+            json.dump({"steps": steps, "images_per_sec": rate,
+                       "images_per_sec_per_device": rate / len(jax.devices())},
+                      f)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cluster_size", type=int, default=2)
+    ap.add_argument("--batch_size", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--imagenet", action="store_true",
+                    help="ResNet-50/224px/1000-class (BASELINE config #2)")
+    ap.add_argument("--model_dir", default=".scratch/resnet_model")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level="INFO")
+
+    sc = Context(num_executors=args.cluster_size)
+    try:
+        tfc = cluster.run(sc, map_fun, vars(args),
+                          num_executors=args.cluster_size,
+                          input_mode=cluster.InputMode.TENSORFLOW)
+        tfc.shutdown()
+    finally:
+        sc.stop()
+    print("resnet training complete; stats in",
+          os.path.join(args.model_dir, "train_stats.json"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,165 @@
+"""BERT encoder family (BERT-base default) + QA head for SQuAD-style
+fine-tuning — the BASELINE.json config #3 model.
+
+Written MXU-first: attention and FFN matmuls in bfloat16 with float32
+params and float32 LayerNorm/softmax (the numerically-sensitive parts),
+head dims at lane multiples, static shapes, no python control flow in the
+forward. Attention is expressed with einsum so the sequence-parallel
+variant (parallel/ring_attention.py) can swap in per-shard blockwise
+computation without touching the module tree.
+"""
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BertConfig:
+    """Hyperparameters (defaults = BERT-base uncased)."""
+
+    def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072, max_position=512,
+                 type_vocab_size=2, dropout_rate=0.1, dtype=jnp.bfloat16):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position = max_position
+        self.type_vocab_size = type_vocab_size
+        self.dropout_rate = dropout_rate
+        self.dtype = dtype
+
+
+def bert_base():
+    return BertConfig()
+
+
+def bert_tiny(vocab_size=1024):
+    """Test-sized config: same code path, minutes-not-hours to run."""
+    return BertConfig(vocab_size=vocab_size, hidden_size=64, num_layers=2,
+                      num_heads=2, intermediate_size=128, max_position=128)
+
+
+class SelfAttention(nn.Module):
+    config: Any
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic=True):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+        dense = partial(nn.DenseGeneral, dtype=cfg.dtype,
+                        features=(cfg.num_heads, head_dim), axis=-1)
+        # [B, S, H] -> [B, S, N, D]
+        q = dense(name="query")(x)
+        k = dense(name="key")(x)
+        v = dense(name="value")(x)
+
+        scale = head_dim ** -0.5
+        # [B, N, S, S]; accumulate logits in f32 for a stable softmax
+        logits = jnp.einsum("bsnd,btnd->bnst", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        if mask is not None:
+            big_neg = jnp.finfo(jnp.float32).min
+            logits = jnp.where(mask[:, None, None, :], logits, big_neg)
+        probs = nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        probs = nn.Dropout(cfg.dropout_rate)(probs,
+                                             deterministic=deterministic)
+        ctx_ = jnp.einsum("bnst,btnd->bsnd", probs, v)
+        out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1),
+                              dtype=cfg.dtype, name="out")(ctx_)
+        return out
+
+
+class TransformerLayer(nn.Module):
+    config: Any
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic=True):
+        cfg = self.config
+        attn = SelfAttention(cfg, name="attention")(x, mask, deterministic)
+        attn = nn.Dropout(cfg.dropout_rate)(attn, deterministic=deterministic)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + attn)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                     name="ffn_in")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="ffn_out")(h)
+        h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+        return nn.LayerNorm(dtype=jnp.float32, name="ln_ffn")(x + h)
+
+
+class BertEncoder(nn.Module):
+    """Token/position/type embeddings + N transformer layers."""
+
+    config: Any
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        b, s = input_ids.shape
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        embed = partial(nn.Embed, features=cfg.hidden_size,
+                        dtype=cfg.dtype)
+        x = embed(cfg.vocab_size, name="word_embeddings")(input_ids)
+        x = x + embed(cfg.max_position, name="position_embeddings")(
+            jnp.arange(s)[None, :])
+        x = x + embed(cfg.type_vocab_size, name="type_embeddings")(
+            token_type_ids)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_embed")(x)
+        x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
+        mask = attention_mask if attention_mask is not None else \
+            jnp.ones((b, s), jnp.bool_)
+        mask = mask.astype(jnp.bool_)
+        for i in range(cfg.num_layers):
+            x = TransformerLayer(cfg, name="layer_%d" % i)(
+                x, mask, deterministic)
+        return x
+
+
+class BertForQuestionAnswering(nn.Module):
+    """Encoder + span head: (start_logits, end_logits) for SQuAD."""
+
+    config: Any
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        x = BertEncoder(self.config, name="bert")(
+            input_ids, attention_mask, token_type_ids, deterministic)
+        logits = nn.Dense(2, dtype=jnp.float32, name="qa_outputs")(x)
+        start, end = jnp.split(logits, 2, axis=-1)
+        return start.squeeze(-1), end.squeeze(-1)
+
+
+class BertForSequenceClassification(nn.Module):
+    """Encoder + [CLS] pooler + classifier head."""
+
+    config: Any
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 deterministic=True):
+        cfg = self.config
+        x = BertEncoder(cfg, name="bert")(
+            input_ids, attention_mask, token_type_ids, deterministic)
+        pooled = nn.tanh(nn.Dense(cfg.hidden_size, dtype=jnp.float32,
+                                  name="pooler")(x[:, 0]))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="classifier")(pooled)
+
+
+def qa_span_loss(logits, batch):
+    """Mean start+end cross-entropy; batch carries start/end positions."""
+    import optax
+
+    start_logits, end_logits = logits
+    start_loss = optax.softmax_cross_entropy_with_integer_labels(
+        start_logits, batch["start_positions"]).mean()
+    end_loss = optax.softmax_cross_entropy_with_integer_labels(
+        end_logits, batch["end_positions"]).mean()
+    return (start_loss + end_loss) / 2.0

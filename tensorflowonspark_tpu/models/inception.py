@@ -1,0 +1,159 @@
+"""Inception-v3 — BASELINE.json config #5 (batch inference).
+
+Reference analog: ``examples/imagenet/inception`` (the TF models port the
+reference shipped for distributed train/eval/export, SURVEY.md §2.1).
+Architecture follows the public Inception-v3 layout (stem, 3x block-A,
+1x grid-reduction, 4x block-B, 1x grid-reduction, 2x block-C, pool/head)
+with the TPU conventions used across this zoo: NHWC, bfloat16 compute,
+float32 BatchNorm/logits, all-static shapes. Input is [B, 299, 299, 3].
+"""
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: tuple
+    strides: int = 1
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.Conv(self.features, self.kernel,
+                    strides=(self.strides, self.strides),
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9997,
+                         epsilon=1e-3, dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        conv = partial(ConvBN, dtype=self.dtype)
+        b1 = conv(64, (1, 1))(x, train)
+        b2 = conv(48, (1, 1))(x, train)
+        b2 = conv(64, (5, 5))(b2, train)
+        b3 = conv(64, (1, 1))(x, train)
+        b3 = conv(96, (3, 3))(b3, train)
+        b3 = conv(96, (3, 3))(b3, train)
+        b4 = conv(self.pool_features, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionA(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        conv = partial(ConvBN, dtype=self.dtype)
+        b1 = conv(384, (3, 3), strides=2, padding="VALID")(x, train)
+        b2 = conv(64, (1, 1))(x, train)
+        b2 = conv(96, (3, 3))(b2, train)
+        b2 = conv(96, (3, 3), strides=2, padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionB(nn.Module):
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        conv = partial(ConvBN, dtype=self.dtype)
+        c = self.channels_7x7
+        b1 = conv(192, (1, 1))(x, train)
+        b2 = conv(c, (1, 1))(x, train)
+        b2 = conv(c, (1, 7))(b2, train)
+        b2 = conv(192, (7, 1))(b2, train)
+        b3 = conv(c, (1, 1))(x, train)
+        b3 = conv(c, (7, 1))(b3, train)
+        b3 = conv(c, (1, 7))(b3, train)
+        b3 = conv(c, (7, 1))(b3, train)
+        b3 = conv(192, (1, 7))(b3, train)
+        b4 = conv(192, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionB(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        conv = partial(ConvBN, dtype=self.dtype)
+        b1 = conv(192, (1, 1))(x, train)
+        b1 = conv(320, (3, 3), strides=2, padding="VALID")(b1, train)
+        b2 = conv(192, (1, 1))(x, train)
+        b2 = conv(192, (1, 7))(b2, train)
+        b2 = conv(192, (7, 1))(b2, train)
+        b2 = conv(192, (3, 3), strides=2, padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        conv = partial(ConvBN, dtype=self.dtype)
+        b1 = conv(320, (1, 1))(x, train)
+        b2 = conv(384, (1, 1))(x, train)
+        b2 = jnp.concatenate([conv(384, (1, 3))(b2, train),
+                              conv(384, (3, 1))(b2, train)], axis=-1)
+        b3 = conv(448, (1, 1))(x, train)
+        b3 = conv(384, (3, 3))(b3, train)
+        b3 = jnp.concatenate([conv(384, (1, 3))(b3, train),
+                              conv(384, (3, 1))(b3, train)], axis=-1)
+        b4 = conv(192, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    dropout_rate: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        conv = partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # stem: 299x299x3 -> 35x35x192
+        x = conv(32, (3, 3), strides=2, padding="VALID")(x, train)
+        x = conv(32, (3, 3), padding="VALID")(x, train)
+        x = conv(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = conv(80, (1, 1), padding="VALID")(x, train)
+        x = conv(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # inception blocks
+        x = InceptionA(32, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = InceptionA(64, self.dtype)(x, train)
+        x = ReductionA(self.dtype)(x, train)
+        x = InceptionB(128, self.dtype)(x, train)
+        x = InceptionB(160, self.dtype)(x, train)
+        x = InceptionB(160, self.dtype)(x, train)
+        x = InceptionB(192, self.dtype)(x, train)
+        x = ReductionB(self.dtype)(x, train)
+        x = InceptionC(self.dtype)(x, train)
+        x = InceptionC(self.dtype)(x, train)
+        # head
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate)(x, deterministic=not train)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="logits")(x)

@@ -1,0 +1,77 @@
+"""Wide & Deep recommender (Criteo-shaped) — BASELINE.json config #4.
+
+Input convention (Criteo display-ads): ``dense`` [B, 13] float features,
+``cat`` [B, 26] integer ids already hashed into ``hash_buckets`` (the ETL
+step — examples/criteo — does the hashing host-side, so the device graph
+stays integer-gather + matmul only).
+
+TPU-first choices: one fused embedding table for all categorical slots
+(single large gather instead of 26 small ones — gathers coalesce and the
+table shards cleanly over the ``model`` axis if grown), bfloat16 MLP with
+float32 logits, wide part as a second 1-dim embedding on the same ids.
+"""
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class WideDeep(nn.Module):
+    num_dense: int = 13
+    num_cat: int = 26
+    hash_buckets: int = 100_000
+    embed_dim: int = 32
+    mlp_sizes: Sequence[int] = (256, 128, 64)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, dense, cat):
+        # cat ids are per-slot; offset each slot into its own region of the
+        # fused table so slots don't collide.
+        offsets = jnp.arange(self.num_cat, dtype=cat.dtype) * self.hash_buckets
+        ids = cat + offsets[None, :]
+        table_size = self.hash_buckets * self.num_cat
+
+        # deep: [B, 26, E] -> concat with dense -> MLP
+        deep_emb = nn.Embed(table_size, self.embed_dim, dtype=self.dtype,
+                            name="deep_embeddings")(ids)
+        deep_in = jnp.concatenate(
+            [deep_emb.reshape(deep_emb.shape[0], -1),
+             dense.astype(self.dtype)], axis=-1)
+        h = deep_in
+        for i, width in enumerate(self.mlp_sizes):
+            h = nn.Dense(width, dtype=self.dtype, name="mlp_%d" % i)(h)
+            h = nn.relu(h)
+        deep_logit = nn.Dense(1, dtype=jnp.float32, name="deep_head")(h)
+
+        # wide: linear over the same categorical ids + dense features
+        wide_emb = nn.Embed(table_size, 1, dtype=jnp.float32,
+                            name="wide_embeddings")(ids)
+        wide_logit = wide_emb.sum(axis=(1, 2), keepdims=False)[:, None]
+        wide_logit = wide_logit + nn.Dense(
+            1, dtype=jnp.float32, name="wide_dense")(dense)
+
+        return (deep_logit + wide_logit).squeeze(-1)  # [B] logits
+
+
+def ctr_loss(logits, batch):
+    """Sigmoid cross-entropy against batch['label'] in {0,1}."""
+    import optax
+
+    return optax.sigmoid_binary_cross_entropy(
+        logits, batch["label"].astype(jnp.float32)).mean()
+
+
+def hash_categorical(values, buckets):
+    """Host-side (ETL) stable string/int -> bucket hashing for the 26
+    Criteo slots. crc32 (zlib, C speed) per value — stable across runs
+    and processes, cheap enough for dump-scale ETL."""
+    import zlib
+
+    import numpy as np
+
+    out = np.empty(len(values), np.int64)
+    for i, v in enumerate(values):
+        out[i] = zlib.crc32(str(v).encode("utf-8")) % buckets
+    return out

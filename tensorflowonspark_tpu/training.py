@@ -50,7 +50,7 @@ class Trainer(object):
 
     def __init__(self, model, optimizer, mesh, loss_fn=softmax_xent,
                  data_axis="data", donate_state=True, train_mode_kwarg="auto",
-                 dropout_rng=False):
+                 dropout_rng=False, input_keys=("x",)):
         import inspect
 
         import jax
@@ -62,19 +62,48 @@ class Trainer(object):
         self.loss_fn = loss_fn
         self.data_axis = data_axis
         self.dropout_rng = dropout_rng
+        #: batch keys passed positionally to the model, in this order
+        #: (e.g. ("input_ids", "attention_mask") for BERT); keys absent
+        #: from a batch are skipped, so optional inputs stay optional.
+        self.input_keys = tuple(input_keys)
         self.replicated = NamedSharding(mesh, PartitionSpec())
         self.batch_sharding = NamedSharding(mesh, PartitionSpec(data_axis))
         if train_mode_kwarg == "auto":
-            # Models with train-dependent layers (BatchNorm, Dropout) take
-            # a `train` kwarg; plain ones (LeNet) don't.
+            # Two conventions in the zoo: `train=True` (BatchNorm models)
+            # and `deterministic=False` (Dropout/transformer models);
+            # plain models (LeNet) take neither.
             sig = inspect.signature(type(model).__call__)
-            self._train_kwargs = {"train": True} if "train" in sig.parameters \
-                else {}
+            if "train" in sig.parameters:
+                self._train_kwargs = {"train": True}
+            elif "deterministic" in sig.parameters:
+                self._train_kwargs = {"deterministic": False}
+            else:
+                self._train_kwargs = {}
         else:
             self._train_kwargs = (
                 {train_mode_kwarg: True} if train_mode_kwarg else {})
         self._donate = donate_state
         self._jit_step = None  # built lazily: needs init()'s aux-state info
+
+    def _inputs(self, batch):
+        if not isinstance(batch, dict):
+            return (batch,)
+        # Positional binding: only TRAILING keys may be absent — a missing
+        # middle key would silently shift later arrays into the wrong
+        # model argument (e.g. token_type_ids landing in attention_mask).
+        values = []
+        missing = None
+        for k in self.input_keys:
+            if k in batch:
+                if missing is not None:
+                    raise KeyError(
+                        "batch is missing input key {!r} but provides the "
+                        "later key {!r}; positional binding would be "
+                        "corrupted".format(missing, k))
+                values.append(batch[k])
+            elif missing is None:
+                missing = k
+        return tuple(values)
 
     def _apply(self, params, extra, batch, rngs=None):
         variables = dict(extra)
@@ -83,10 +112,11 @@ class Trainer(object):
         kwargs = dict(self._train_kwargs)
         if rngs:
             kwargs["rngs"] = rngs
+        inputs = self._inputs(batch)
         if mutable:
-            return self.model.apply(variables, batch["x"], mutable=mutable,
+            return self.model.apply(variables, *inputs, mutable=mutable,
                                     **kwargs)
-        return self.model.apply(variables, batch["x"], **kwargs), {}
+        return self.model.apply(variables, *inputs, **kwargs), {}
 
     def _build_step(self):
         import jax
@@ -120,24 +150,30 @@ class Trainer(object):
             out_shardings=(self.replicated, self.replicated),
             donate_argnums=(0,) if self._donate else ())
 
-    def init(self, rng, sample_x):
+    def init(self, rng, sample):
         """Replicated train state: {params, extra, opt_state, step}.
 
-        ``extra`` holds non-param variable collections (e.g. BatchNorm's
-        ``batch_stats``) threaded through the step as explicit state —
-        the functional analog of TF's stateful update ops.
+        ``sample``: an input array, or a batch dict read via
+        ``input_keys``. ``extra`` holds non-param variable collections
+        (e.g. BatchNorm's ``batch_stats``) threaded through the step as
+        explicit state — the functional analog of TF's stateful update ops.
         """
         import jax
         import jax.numpy as jnp
 
-        def _init(r):
-            variables = self.model.init(r, jnp.asarray(sample_x))
+        inputs = tuple(jnp.asarray(x) for x in self._inputs(sample))
+        rngs = {"params": rng}
+        if self.dropout_rng:
+            rngs["dropout"] = jax.random.fold_in(rng, 1)
+
+        def _init(rngs):
+            variables = self.model.init(rngs, *inputs)
             params = variables.pop("params")
             return {"params": params, "extra": dict(variables),
                     "opt_state": self.optimizer.init(params),
                     "step": jnp.zeros((), dtype=jnp.int32)}
 
-        return jax.jit(_init, out_shardings=self.replicated)(rng)
+        return jax.jit(_init, out_shardings=self.replicated)(rngs)
 
     def step(self, state, batch):
         """One jitted DP step; batch must be sharded/shardable over data."""

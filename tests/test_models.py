@@ -1,0 +1,111 @@
+"""Model-zoo shape/gradient sanity on the 8-device CPU mesh (tiny sizes)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def jax():
+    import jax
+    return jax
+
+
+def test_bert_qa_forward_and_train(jax):
+    import optax
+
+    from tensorflowonspark_tpu import training
+    from tensorflowonspark_tpu.models import bert
+    from tensorflowonspark_tpu.parallel import build_mesh
+
+    cfg = bert.bert_tiny()
+    model = bert.BertForQuestionAnswering(cfg)
+    B, S = 8, 32
+    rng = np.random.RandomState(0)
+    batch = {
+        "input_ids": rng.randint(0, cfg.vocab_size, (B, S)),
+        "attention_mask": np.ones((B, S), bool),
+        "start_positions": rng.randint(0, S, (B,)),
+        "end_positions": rng.randint(0, S, (B,)),
+    }
+    mesh = build_mesh()
+    trainer = training.Trainer(
+        model, optax.adamw(1e-3), mesh, loss_fn=bert.qa_span_loss,
+        input_keys=("input_ids", "attention_mask"), dropout_rng=True)
+    state = trainer.init(jax.random.PRNGKey(0), batch)
+    losses = []
+    for _ in range(3):
+        state, metrics = trainer.step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[2] < losses[0]  # memorizing one batch must descend
+    variables = dict(state["extra"])
+    variables["params"] = state["params"]
+    start, end = model.apply(variables, batch["input_ids"],
+                             batch["attention_mask"], deterministic=True)
+    assert start.shape == (B, S) and end.shape == (B, S)
+
+
+def test_bert_classifier_shape(jax):
+    from tensorflowonspark_tpu.models import bert
+
+    cfg = bert.bert_tiny()
+    model = bert.BertForSequenceClassification(cfg, num_classes=3)
+    ids = np.zeros((2, 16), np.int32)
+    variables = model.init(jax.random.PRNGKey(0), ids)
+    out = model.apply(variables, ids)
+    assert out.shape == (2, 3)
+
+
+def test_widedeep_train(jax):
+    import optax
+
+    from tensorflowonspark_tpu import training
+    from tensorflowonspark_tpu.models import widedeep
+    from tensorflowonspark_tpu.parallel import build_mesh
+
+    model = widedeep.WideDeep(hash_buckets=50, embed_dim=8,
+                              mlp_sizes=(32, 16))
+    rng = np.random.RandomState(0)
+    B = 16
+    batch = {
+        "dense": rng.rand(B, 13).astype(np.float32),
+        "cat": rng.randint(0, 50, (B, 26)),
+        "label": (rng.rand(B) > 0.5).astype(np.int32),
+    }
+    mesh = build_mesh()
+    trainer = training.Trainer(model, optax.adam(1e-2), mesh,
+                               loss_fn=widedeep.ctr_loss,
+                               input_keys=("dense", "cat"))
+    state = trainer.init(jax.random.PRNGKey(0), batch)
+    losses = []
+    for _ in range(5):
+        state, metrics = trainer.step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+
+
+def test_widedeep_hashing():
+    from tensorflowonspark_tpu.models.widedeep import hash_categorical
+
+    a = hash_categorical(["x", "y", "x"], 1000)
+    assert a[0] == a[2] and a[0] != a[1]
+    assert (a >= 0).all() and (a < 1000).all()
+
+
+def test_inception_forward(jax):
+    from tensorflowonspark_tpu.models.inception import InceptionV3
+
+    model = InceptionV3(num_classes=10)
+    x = np.zeros((2, 299, 299, 3), np.float32)
+
+    def init_and_apply():
+        variables = model.init(jax.random.PRNGKey(0), x)
+        return model.apply(variables, x), variables
+
+    out, variables = jax.eval_shape(init_and_apply)
+    assert out.shape == (2, 10)
+    n_params = sum(int(np.prod(l.shape)) for l in
+                   jax.tree.leaves(variables["params"]))
+    # Inception-v3 has ~23.8M params (1000-class head ~2M of it; ours is
+    # 10-class here, so ~21.8M): sanity-check the architecture size.
+    assert 20_000_000 < n_params < 26_000_000, n_params

@@ -44,6 +44,26 @@ def tokenize(text, vocab=VOCAB):
     return ids
 
 
+def rows_from_text(path, seed=0):
+    """Real-text path: tokenize each line (the DataFrame ETL step) and
+    plant a marker answer span the head must learn to locate."""
+    rng = np.random.RandomState(seed)
+    rows = []
+    for line in open(path).read().splitlines():
+        ids = tokenize(line)[:SEQ]
+        if len(ids) < 8:
+            continue
+        start = rng.randint(0, len(ids) - 3)
+        span = rng.randint(2, 4)
+        for j in range(start, min(start + span, len(ids))):
+            ids[j] = MARKER
+        rows.append({"input_ids": ids, "start": int(start),
+                     "end": int(min(start + span, len(ids)) - 1)})
+    if not rows:
+        raise ValueError("no usable lines in " + path)
+    return rows
+
+
 def synthetic_rows(n, seed=0):
     rng = np.random.RandomState(seed)
     rows = []
@@ -116,6 +136,9 @@ def main(argv=None):
     ap.add_argument("--lr", type=float, default=1e-3)
     ap.add_argument("--full_size", action="store_true",
                     help="BERT-base (default: tiny config, same code path)")
+    ap.add_argument("--text_file", default=None,
+                    help="tokenize real text lines instead of synthetic "
+                         "pre-tokenized rows")
     ap.add_argument("--model_dir", default=".scratch/bert_model")
     args = ap.parse_args(argv)
     logging.basicConfig(level="INFO")
@@ -126,8 +149,9 @@ def main(argv=None):
                           num_executors=args.cluster_size,
                           input_mode=cluster.InputMode.SPARK)
         # DataFrame ETL: tokenized rows -> (ids, start, end) feed tuples
-        df = sc.createDataFrame(synthetic_rows(args.num_examples),
-                                num_slices=args.cluster_size * 2)
+        rows = (rows_from_text(args.text_file) if args.text_file
+                else synthetic_rows(args.num_examples))
+        df = sc.createDataFrame(rows, num_slices=args.cluster_size * 2)
         rdd = df.rdd.map(lambda r: (r["input_ids"], r["start"], r["end"]))
         tfc.train(rdd, num_epochs=args.epochs)
         tfc.shutdown()

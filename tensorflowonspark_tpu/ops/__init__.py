@@ -1,0 +1,9 @@
+"""Custom TPU kernels (Pallas).
+
+The reference delegated all device kernels to TensorFlow/cuDNN
+(SURVEY.md §2.2); here the hot ops the XLA autofuser doesn't already win
+on are hand-written Pallas kernels, with XLA reference implementations as
+both fallback (non-TPU platforms) and correctness oracles in tests.
+"""
+
+from tensorflowonspark_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,105 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Long-context capability the reference lacks entirely (SURVEY.md §5
+"Long-context"): sequences sharded over a ``seq`` mesh axis, with KV
+blocks rotating around the ring (``jax.lax.ppermute`` — XLA lowers it to
+ICI neighbor exchanges) while each device accumulates attention for its
+resident Q shard using the online-softmax (flash) recurrence. Peak memory
+is O(S/P) per device and the KV transfer overlaps the block matmuls, so
+context length scales linearly with the ring size.
+
+Layout contract: q/k/v are [batch, seq, heads, head_dim] global arrays,
+sharded PartitionSpec(None, seq_axis, None, None). Causal masking uses
+global positions, so it is exact regardless of ring placement.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def reference_attention(q, k, v, causal=False, scale=None):
+    """Plain full-sequence attention (the correctness oracle)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqnd,bknd->bnqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
+        logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnqk,bknd->bqnd", probs, v)
+
+
+def _block_update(q, k, v, m, l, o, q_offset, kv_offset, causal, scale):
+    """One online-softmax accumulation step against a KV block."""
+    s = jnp.einsum("bqnd,bknd->bnqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        q_pos = q_offset + jnp.arange(s_q)
+        k_pos = kv_offset + jnp.arange(s_k)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    m_block = jnp.max(s, axis=-1)                       # [b, n, q]
+    m_new = jnp.maximum(m, m_block)
+    # fully-masked rows (causal, early q vs late kv): keep them inert
+    safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(jnp.isneginf(s), 0.0, p)
+    corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = (o * corr[..., None] +
+             jnp.einsum("bnqk,bknd->bnqd", p.astype(v.dtype), v)
+             .astype(jnp.float32))
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False, scale=None):
+    """Sequence-parallel attention over ``mesh[seq_axis]``.
+
+    Returns an array shaped/sharded like ``q``. Works under jit; the
+    per-step ``ppermute`` rotations are emitted as XLA collective-permutes
+    riding ICI neighbor links.
+    """
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    axis_size = mesh.shape[seq_axis]
+    spec = P(None, seq_axis, None, None)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False)
+    def _ring(q_blk, k_blk, v_blk):
+        rank = jax.lax.axis_index(seq_axis)
+        s_local = q_blk.shape[1]
+        b, _, n, d = q_blk.shape
+        m = jnp.full((b, n, s_local), -jnp.inf, jnp.float32)
+        l = jnp.zeros((b, n, s_local), jnp.float32)
+        o = jnp.zeros((b, n, s_local, d), jnp.float32)
+        q_offset = rank * s_local
+
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+        def step(t, carry):
+            m, l, o, k_cur, v_cur = carry
+            src_rank = (rank - t) % axis_size
+            kv_offset = src_rank * s_local
+            m, l, o = _block_update(q_blk, k_cur, v_cur, m, l, o,
+                                    q_offset, kv_offset, causal, scale)
+            # rotate KV to the next rank (skippable on the last step, but
+            # a static rotate keeps the loop body uniform for XLA)
+            k_nxt = jax.lax.ppermute(k_cur, seq_axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, seq_axis, perm)
+            return m, l, o, k_nxt, v_nxt
+
+        m, l, o, _, _ = jax.lax.fori_loop(
+            0, axis_size, step, (m, l, o, k_blk, v_blk))
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
+        out = (o / l[..., None]).astype(q_blk.dtype)
+        return jnp.einsum("bnqd->bqnd", out)
+
+    return _ring(q, k, v)

@@ -1,0 +1,94 @@
+"""Tensor-parallel sharding rules: name-pattern -> PartitionSpec.
+
+TP is "free" on TPU in the sense SURVEY.md §2.3 describes: annotate the
+weight matrices with a ``model`` mesh axis and XLA emits the
+all-gather/reduce-scatter pattern over ICI. What the framework supplies
+is the annotation machinery: regex rules over the flattened param path,
+applied to a pytree, yielding a sharding tree for ``jax.jit``'s
+in_shardings / ``jax.device_put``.
+
+The megatron-style pairing to follow in rules: shard the UP projection's
+output dim and the DOWN projection's input dim, so the intervening
+activation stays sharded and only one collective pair per block is
+needed (e.g. for models/bert.py: ``ffn_in/kernel`` on its last dim,
+``ffn_out/kernel`` on its first; attention qkv DenseGeneral on the heads
+dim, ``out/kernel`` on the heads dim).
+"""
+
+import logging
+import re
+
+logger = logging.getLogger(__name__)
+
+
+def param_path_specs(params, rules, default=None):
+    """{path: PartitionSpec} for every leaf; first matching rule wins.
+
+    Args:
+      params: pytree of arrays.
+      rules: ordered [(regex, spec_template)], where spec_template is a
+        tuple of axis names / None with length <= leaf ndim (padded with
+        None on the left to match, the flax convention of sharding the
+        trailing dims).
+      default: spec for unmatched leaves (None = replicate).
+    """
+    import jax
+    from jax.sharding import PartitionSpec
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    out = {}
+    for path, leaf in flat:
+        name = "/".join(_key_str(k) for k in path)
+        spec = None
+        for pattern, template in rules:
+            if re.search(pattern, name):
+                pad = leaf.ndim - len(template)
+                if pad < 0:
+                    raise ValueError(
+                        "rule {} template {} longer than param {} ndim {}"
+                        .format(pattern, template, name, leaf.ndim))
+                spec = PartitionSpec(*((None,) * pad + tuple(template)))
+                break
+        if spec is None:
+            spec = default or PartitionSpec()
+        out[name] = spec
+    return out
+
+
+def tree_shardings(params, mesh, rules, default=None):
+    """Pytree of NamedShardings shaped like ``params`` (for jit/device_put)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    by_path = param_path_specs(params, rules, default)
+
+    def _lookup(path, leaf):
+        name = "/".join(_key_str(k) for k in path)
+        return NamedSharding(mesh, by_path[name])
+
+    return jax.tree_util.tree_map_with_path(_lookup, params)
+
+
+def _key_str(key):
+    for attr in ("key", "name", "idx"):
+        if hasattr(key, attr):
+            return str(getattr(key, attr))
+    return str(key)
+
+
+#: Megatron-style TP rules for the bert.py module tree (model axis).
+BERT_TP_RULES = (
+    (r"attention/(query|key|value)/kernel", ("model", None)),  # [H, N, D]
+    (r"attention/(query|key|value)/bias", ("model", None)),
+    (r"attention/out/kernel", ("model", None, None)),          # [N, D, H]
+    (r"ffn_in/kernel", (None, "model")),
+    (r"ffn_in/bias", ("model",)),
+    (r"ffn_out/kernel", ("model", None)),
+    (r"word_embeddings/embedding", (None, "model")),
+)
+
+#: TP rules for models/resnet.py (shard the widest convs' output channels).
+RESNET_TP_RULES = (
+    (r"Conv_\d+/kernel", (None, None, None, "model")),
+    (r"Dense_\d+/kernel", (None, "model")),
+)

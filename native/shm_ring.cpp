@@ -1,0 +1,207 @@
+// Shared-memory SPSC ring buffer — the native transport of the feed plane.
+//
+// Role in the framework (SURVEY.md §2.4 plane 2, §7.3 "Feed throughput"):
+// moves serialized record chunks from the feeder (executor) process into
+// the trainer (TPU-owning) process through one mmap'd region, replacing a
+// TCP round trip through the multiprocessing manager proxy per chunk with
+// two memcpys and an atomic pointer bump. Single producer, single consumer
+// (the executor feeds its own node's trainer — exactly the framework's
+// process layout), bounded capacity = natural backpressure.
+//
+// Layout: 128B header (cache-line-separated head/tail counters) + data.
+// Messages are [u32 length][payload] written circularly. head/tail are
+// monotonically increasing byte counters; (head - tail) is the fill.
+//
+// Build: g++ -O2 -shared -fPIC -o libshmring.so shm_ring.cpp -lrt
+// (tensorflowonspark_tpu/shm.py builds this on demand and binds via ctypes.)
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x54464F5352494E47ULL;  // "TFOSRING"
+
+struct Header {
+  std::atomic<uint64_t> head;  // bytes ever written (producer-owned)
+  char pad1[56];
+  std::atomic<uint64_t> tail;  // bytes ever consumed (consumer-owned)
+  char pad2[56];
+  uint64_t capacity;           // data-region size in bytes
+  uint64_t magic;
+  char pad3[112];              // header = 240B + 16 -> round to 256
+};
+static_assert(sizeof(Header) == 256, "header must be 256 bytes");
+
+struct Handle {
+  Header* hdr;
+  uint8_t* data;
+  uint64_t map_size;
+};
+
+inline uint64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+inline void backoff(int spin) {
+  if (spin < 64) return;                       // busy spin first
+  struct timespec ts = {0, spin < 1024 ? 1000L : 100000L};  // 1us then 100us
+  nanosleep(&ts, nullptr);
+}
+
+// circular copy helpers -----------------------------------------------------
+
+void ring_write_bytes(Handle* h, uint64_t pos, const uint8_t* src,
+                      uint64_t len) {
+  uint64_t cap = h->hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = len < cap - off ? len : cap - off;
+  memcpy(h->data + off, src, first);
+  if (len > first) memcpy(h->data, src + first, len - first);
+}
+
+void ring_read_bytes(Handle* h, uint64_t pos, uint8_t* dst, uint64_t len) {
+  uint64_t cap = h->hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = len < cap - off ? len : cap - off;
+  memcpy(dst, h->data + off, first);
+  if (len > first) memcpy(dst + first, h->data, len - first);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle or nullptr. capacity is the data-region size.
+void* shmring_create(const char* name, uint64_t capacity) {
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t total = sizeof(Header) + capacity;
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* hdr = static_cast<Header*>(mem);
+  hdr->head.store(0, std::memory_order_relaxed);
+  hdr->tail.store(0, std::memory_order_relaxed);
+  hdr->capacity = capacity;
+  std::atomic_thread_fence(std::memory_order_release);
+  hdr->magic = kMagic;
+  auto* h = new Handle{hdr, reinterpret_cast<uint8_t*>(mem) + sizeof(Header),
+                       total};
+  return h;
+}
+
+void* shmring_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < (off_t)sizeof(Header)) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, static_cast<uint64_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* hdr = static_cast<Header*>(mem);
+  if (hdr->magic != kMagic) {
+    munmap(mem, static_cast<uint64_t>(st.st_size));
+    return nullptr;
+  }
+  auto* h = new Handle{hdr, reinterpret_cast<uint8_t*>(mem) + sizeof(Header),
+                       static_cast<uint64_t>(st.st_size)};
+  return h;
+}
+
+// 0 on success, -1 timeout, -2 message larger than the ring.
+int shmring_write(void* handle, const void* buf, uint64_t len,
+                  int timeout_ms) {
+  auto* h = static_cast<Handle*>(handle);
+  uint64_t need = len + 4;
+  uint64_t cap = h->hdr->capacity;
+  if (need > cap) return -2;
+  uint64_t deadline = now_ms() + static_cast<uint64_t>(timeout_ms);
+  uint64_t head = h->hdr->head.load(std::memory_order_relaxed);
+  int spin = 0;
+  while (cap - (head - h->hdr->tail.load(std::memory_order_acquire)) < need) {
+    if (timeout_ms >= 0 && now_ms() > deadline) return -1;
+    backoff(++spin);
+  }
+  uint32_t len32 = static_cast<uint32_t>(len);
+  ring_write_bytes(h, head, reinterpret_cast<const uint8_t*>(&len32), 4);
+  ring_write_bytes(h, head + 4, static_cast<const uint8_t*>(buf), len);
+  h->hdr->head.store(head + need, std::memory_order_release);
+  return 0;
+}
+
+// Next message length, or -1 timeout. Does not consume.
+int64_t shmring_peek_len(void* handle, int timeout_ms) {
+  auto* h = static_cast<Handle*>(handle);
+  uint64_t deadline = now_ms() + static_cast<uint64_t>(timeout_ms);
+  uint64_t tail = h->hdr->tail.load(std::memory_order_relaxed);
+  int spin = 0;
+  while (h->hdr->head.load(std::memory_order_acquire) - tail < 4) {
+    if (timeout_ms >= 0 && now_ms() > deadline) return -1;
+    backoff(++spin);
+  }
+  uint32_t len32;
+  ring_read_bytes(h, tail, reinterpret_cast<uint8_t*>(&len32), 4);
+  return static_cast<int64_t>(len32);
+}
+
+// Bytes read into buf, -1 timeout, -3 buffer too small (message intact).
+int64_t shmring_read(void* handle, void* buf, uint64_t buflen,
+                     int timeout_ms) {
+  auto* h = static_cast<Handle*>(handle);
+  int64_t len = shmring_peek_len(handle, timeout_ms);
+  if (len < 0) return len;
+  if (static_cast<uint64_t>(len) > buflen) return -3;
+  uint64_t tail = h->hdr->tail.load(std::memory_order_relaxed);
+  uint64_t deadline = now_ms() + static_cast<uint64_t>(timeout_ms);
+  int spin = 0;
+  while (h->hdr->head.load(std::memory_order_acquire) - tail <
+         4 + static_cast<uint64_t>(len)) {
+    if (timeout_ms >= 0 && now_ms() > deadline) return -1;
+    backoff(++spin);
+  }
+  ring_read_bytes(h, tail + 4, static_cast<uint8_t*>(buf),
+                  static_cast<uint64_t>(len));
+  h->hdr->tail.store(tail + 4 + static_cast<uint64_t>(len),
+                     std::memory_order_release);
+  return len;
+}
+
+// Unconsumed bytes currently in the ring (0 == drained).
+uint64_t shmring_pending(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  return h->hdr->head.load(std::memory_order_acquire) -
+         h->hdr->tail.load(std::memory_order_acquire);
+}
+
+void shmring_close(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  munmap(h->hdr, h->map_size);
+  delete h;
+}
+
+int shmring_unlink(const char* name) { return shm_unlink(name); }
+
+}  // extern "C"

@@ -46,7 +46,20 @@ class DataFeed(object):
         self.input_mapping = dict(input_mapping) if input_mapping else None
         self.input_tensors = list(input_mapping.values()) if input_mapping else None
         self.done_feeding = False
-        self._queue_in = mgr.get_queue(qname_in)
+        # Fast path: when the node created a native shm ring for the feed
+        # (TFOS_FEED_TRANSPORT=shm), chunks arrive there — one mmap copy
+        # instead of a manager-proxy TCP round trip per chunk. The queue
+        # stays the control/results channel.
+        self._ring = None
+        ring_name = None
+        try:
+            ring_name = mgr.get("shm_name")
+        except Exception:  # noqa: BLE001 - kv store may be gone at teardown
+            pass
+        if ring_name and qname_in == "input":
+            from tensorflowonspark_tpu import shm
+            self._ring = shm.ShmRing.open(ring_name)
+        self._queue_in = None if self._ring else mgr.get_queue(qname_in)
         self._queue_out = None if train_mode else mgr.get_queue(qname_out)
         self._pending = []  # remainder of a partially-consumed chunk
         # feed-plane visibility the reference lacked (SURVEY.md §5
@@ -74,10 +87,10 @@ class DataFeed(object):
             if self.done_feeding:
                 break
             t0 = time.monotonic()
-            item = self._queue_in.get(block=True)
+            item = self._next_item()
             self._stats["wait_s"] += time.monotonic() - t0
             if isinstance(item, Marker):
-                self._queue_in.task_done()
+                self._item_done()
                 if isinstance(item, EndFeed):
                     self.done_feeding = True
                 if isinstance(item, (EndPartition, EndFeed)) and batch:
@@ -89,10 +102,23 @@ class DataFeed(object):
             self._pending.extend(chunk)
             self._stats["records"] += len(chunk)
             self._stats["chunks"] += 1
-            self._queue_in.task_done()
+            self._item_done()
         if self.input_tensors is None:
             return batch
         return self._stack_columns(batch)
+
+    def _next_item(self):
+        """Blocking read of the next feed item (chunk list or Marker)."""
+        if self._ring is not None:
+            while True:
+                obj = self._ring.read_obj(timeout=5.0)
+                if obj is not None:
+                    return obj
+        return self._queue_in.get(block=True)
+
+    def _item_done(self):
+        if self._queue_in is not None:
+            self._queue_in.task_done()
 
     def _stack_columns(self, batch):
         """Stack records column-wise into {mapped_name: np.ndarray}."""
@@ -146,16 +172,20 @@ class DataFeed(object):
         Reference: ``DataFeed.terminate`` — sets state='terminating' and
         consumes (with ``task_done``) whatever the feeders already queued.
         """
-        logger.info("DataFeed terminating: draining input queue")
+        logger.info("DataFeed terminating: draining input feed")
         self.mgr.set("state", "terminating")
         self.done_feeding = True
         import queue as _queue
         count = 0
-        while True:
-            try:
-                self._queue_in.get(block=True, timeout=1.0)
-                self._queue_in.task_done()
+        if self._ring is not None:
+            while self._ring.read(timeout=1.0) is not None:
                 count += 1
-            except _queue.Empty:
-                break
+        else:
+            while True:
+                try:
+                    self._queue_in.get(block=True, timeout=1.0)
+                    self._queue_in.task_done()
+                    count += 1
+                except _queue.Empty:
+                    break
         logger.info("DataFeed terminate drained %d items", count)

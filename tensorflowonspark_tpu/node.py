@@ -216,6 +216,21 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                             mode=cluster_meta.get("manager_mode", "local"),
                             host=host)
 
+        # 1b. optional native shm ring for the feed fast path
+        ring = None
+        if os.environ.get("TFOS_FEED_TRANSPORT") == "shm":
+            from tensorflowonspark_tpu import shm
+            if shm.available():
+                ring_name = "/tfos-{}-{}".format(
+                    cluster_meta["id"][-10:], executor_id)
+                shm._load().shmring_unlink(ring_name.encode())  # clear stale
+                ring = shm.ShmRing.create(ring_name)
+                mgr.set("shm_name", ring_name)
+                logger.info("feed fast path: shm ring %s", ring_name)
+            else:
+                logger.warning("TFOS_FEED_TRANSPORT=shm requested but the "
+                               "native ring is unavailable; using queues")
+
         # 2. reserve the port this node serves on (chief's doubles as the
         # jax.distributed coordinator address)
         port = int(os.environ.get("TFOS_SERVER_PORT", 0)) or util.find_free_port()
@@ -247,7 +262,7 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
 
         _NODE_STATE.update(cluster_id=cluster_meta["id"], mgr=mgr,
                            executor_id=executor_id, ctx=ctx,
-                           trainer_proc=None, tb_pid=tb_pid)
+                           trainer_proc=None, tb_pid=tb_pid, shm_ring=ring)
 
         if background:
             # InputMode.SPARK: the trainer runs in a child process (it will
@@ -437,25 +452,58 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
     return _train
 
 
+def _feed_ring(qname):
+    """The node's shm ring, when the fast path is active for this queue."""
+    if qname == "input":
+        return _NODE_STATE.get("shm_ring")
+    return None
+
+
 def _feed_partition(iterator, mgr, qname, feed_timeout):
     """Push one partition into ``qname`` as chunks + EndPartition; returns
-    the record count. Shared by the train and inference feed closures."""
-    q = mgr.get_queue(qname)
+    the record count. Shared by the train and inference feed closures.
+    Transport is the shm ring when active (node bootstrap created it),
+    else the manager queue."""
+    ring = _feed_ring(qname)
+    q = None if ring is not None else mgr.get_queue(qname)
+
+    def put(obj, deadline):
+        if ring is not None:
+            _ring_put(ring, obj, mgr, deadline)
+        else:
+            _bounded_put(q, obj, mgr, deadline)
+
     deadline = time.monotonic() + feed_timeout
     chunk = []
     count = 0
     for item in iterator:
         chunk.append(item)
         if len(chunk) >= FEED_CHUNK:
-            _put_chunk(q, chunk, mgr, deadline)
+            put(list(chunk), deadline)
             count += len(chunk)
             chunk = []
             deadline = time.monotonic() + feed_timeout
     if chunk:
-        _put_chunk(q, chunk, mgr, deadline)
+        put(list(chunk), deadline)
         count += len(chunk)
-    _bounded_put(q, marker.EndPartition(), mgr, deadline)
+    put(marker.EndPartition(), deadline)
     return count
+
+
+def _ring_put(ring, obj, mgr, deadline):
+    """shm-ring analog of _bounded_put: bounded writes + state checks."""
+    import pickle
+
+    data = pickle.dumps(obj, protocol=5)
+    while True:
+        try:
+            ring.write(data, timeout=1.0)
+            return
+        except TimeoutError:
+            if mgr.get("state") in ("terminating", "stopped", "error"):
+                raise RuntimeError("feed aborted: node is terminating")
+            if time.monotonic() > deadline:
+                raise RuntimeError("feed timeout exceeded")
 
 
 def _join_feed(mgr, qname, feed_timeout, on_error="return"):
@@ -467,8 +515,18 @@ def _join_feed(mgr, qname, feed_timeout, on_error="return"):
     (train path — the real traceback surfaces at ``shutdown()``) or raises
     (inference path — results can never arrive); feed_timeout still raises.
     """
+    ring = _feed_ring(qname)
+
+    def _drained():
+        if ring is not None:
+            if ring.pending() == 0:
+                return True
+            time.sleep(0.05)
+            return False
+        return mgr.join_queue(qname, 1.0)
+
     deadline = time.monotonic() + feed_timeout
-    while not mgr.join_queue(qname, 1.0):
+    while not _drained():
         state = mgr.get("state")
         if state in ("error", "terminating", "stopped"):
             if on_error == "raise":
@@ -562,12 +620,16 @@ def shutdown(cluster_info, cluster_meta, queues=("input",), grace_secs=0):
             pass
         mgr = _get_manager(cluster_info, cluster_meta, _local_executor_id())
         # End-of-feed marker unblocks DataFeed.next_batch deterministically.
-        # Bounded put: a full queue means the trainer stopped consuming —
+        # Bounded put: a full channel means the trainer stopped consuming —
         # it will see the state flip below instead.
         for qname in queues:
+            ring = _feed_ring(qname)
             try:
-                mgr.get_queue(qname).put(marker.EndFeed(), block=True,
-                                         timeout=5.0)
+                if ring is not None:
+                    ring.write_obj(marker.EndFeed(), timeout=5.0)
+                else:
+                    mgr.get_queue(qname).put(marker.EndFeed(), block=True,
+                                             timeout=5.0)
             except Exception:
                 pass
         if mgr.get("state") == "running":
@@ -589,6 +651,10 @@ def shutdown(cluster_info, cluster_meta, queues=("input",), grace_secs=0):
                 os.kill(tb_pid, 15)
             except OSError:
                 pass
+        ring = _NODE_STATE.pop("shm_ring", None)
+        if ring is not None:
+            ring.unlink()
+            ring.close()
         _NODE_STATE.pop("cluster_id", None)
 
         # Error surfacing: anything on the error queue fails this task.

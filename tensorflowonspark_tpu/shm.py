@@ -1,0 +1,156 @@
+"""Python binding for the native shared-memory feed ring (native/shm_ring.cpp).
+
+The fast path of the feed plane: the manager queue (manager.py) remains
+the control channel, while bulk record chunks can ride this SPSC ring —
+one mmap'd copy instead of a pickled TCP round trip through a manager
+proxy thread per chunk. Enabled per cluster with
+``TFOS_FEED_TRANSPORT=shm`` (see node.py); the queue path stays the
+default and the semantics (EndPartition/EndFeed markers, join-on-consume,
+state aborts) are identical.
+
+The .so builds on first use with the toolchain baked into the image
+(g++); the build is cached next to this file. Everything degrades
+gracefully: ``available()`` is False where g++ or POSIX shm is missing.
+"""
+
+import ctypes
+import logging
+import os
+import pickle
+import subprocess
+import threading
+
+logger = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "native", "shm_ring.cpp")
+_SO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "_libshmring.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build():
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _SO + ".tmp",
+           _SRC, "-lrt", "-pthread"]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(_SO + ".tmp", _SO)
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO) or (
+                os.path.exists(_SRC) and
+                os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+            _build()
+        lib = ctypes.CDLL(_SO)
+        lib.shmring_create.restype = ctypes.c_void_p
+        lib.shmring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.shmring_open.restype = ctypes.c_void_p
+        lib.shmring_open.argtypes = [ctypes.c_char_p]
+        lib.shmring_write.restype = ctypes.c_int
+        lib.shmring_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint64, ctypes.c_int]
+        lib.shmring_peek_len.restype = ctypes.c_int64
+        lib.shmring_peek_len.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.shmring_read.restype = ctypes.c_int64
+        lib.shmring_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_uint64, ctypes.c_int]
+        lib.shmring_pending.restype = ctypes.c_uint64
+        lib.shmring_pending.argtypes = [ctypes.c_void_p]
+        lib.shmring_close.argtypes = [ctypes.c_void_p]
+        lib.shmring_unlink.argtypes = [ctypes.c_char_p]
+        _lib = lib
+        return lib
+
+
+def available():
+    """True if the native ring can be built/loaded on this host."""
+    try:
+        _load()
+        return True
+    except Exception as e:  # noqa: BLE001
+        logger.info("native shm ring unavailable: %s", e)
+        return False
+
+
+class ShmRing(object):
+    """One SPSC byte-message ring. create() on the producer-side host
+    process; open() from the consumer. Not thread-safe per side."""
+
+    DEFAULT_CAPACITY = 64 * 1024 * 1024
+
+    def __init__(self, handle, name, owner):
+        self._h = handle
+        self.name = name
+        self._owner = owner
+
+    @classmethod
+    def create(cls, name, capacity=DEFAULT_CAPACITY):
+        lib = _load()
+        handle = lib.shmring_create(name.encode(), capacity)
+        if not handle:
+            raise OSError("shmring_create failed for {!r}".format(name))
+        return cls(handle, name, owner=True)
+
+    @classmethod
+    def open(cls, name):
+        lib = _load()
+        handle = lib.shmring_open(name.encode())
+        if not handle:
+            raise OSError("shmring_open failed for {!r}".format(name))
+        return cls(handle, name, owner=False)
+
+    def write(self, data, timeout=None):
+        """Write one message; raises TimeoutError/ValueError."""
+        rc = _load().shmring_write(
+            self._h, bytes(data), len(data),
+            -1 if timeout is None else int(timeout * 1000))
+        if rc == -1:
+            raise TimeoutError("shm ring full")
+        if rc == -2:
+            raise ValueError("message larger than ring capacity")
+
+    def read(self, timeout=None):
+        """Read one message; returns bytes or None on timeout."""
+        lib = _load()
+        t = -1 if timeout is None else int(timeout * 1000)
+        n = lib.shmring_peek_len(self._h, t)
+        if n < 0:
+            return None
+        buf = ctypes.create_string_buffer(int(n))
+        got = lib.shmring_read(self._h, buf, int(n), t)
+        if got < 0:
+            return None
+        return buf.raw[:got]
+
+    def pending(self):
+        """Unconsumed bytes (0 == fully drained)."""
+        return int(_load().shmring_pending(self._h))
+
+    def write_obj(self, obj, timeout=None):
+        self.write(pickle.dumps(obj, protocol=5), timeout)
+
+    def read_obj(self, timeout=None):
+        data = self.read(timeout)
+        return None if data is None else pickle.loads(data)
+
+    def close(self):
+        if self._h:
+            _load().shmring_close(self._h)
+            self._h = None
+
+    def unlink(self):
+        try:
+            _load().shmring_unlink(self.name.encode())
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.close()
+        except Exception:
+            pass

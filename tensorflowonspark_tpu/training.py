@@ -50,7 +50,7 @@ class Trainer(object):
 
     def __init__(self, model, optimizer, mesh, loss_fn=softmax_xent,
                  data_axis="data", donate_state=True, train_mode_kwarg="auto",
-                 dropout_rng=False, input_keys=("x",)):
+                 dropout_rng=False, input_keys=("x",), constrain_state=True):
         import inspect
 
         import jax
@@ -83,6 +83,7 @@ class Trainer(object):
             self._train_kwargs = (
                 {train_mode_kwarg: True} if train_mode_kwarg else {})
         self._donate = donate_state
+        self._constrain_state = constrain_state
         self._jit_step = None  # built lazily: needs init()'s aux-state info
 
     def _inputs(self, batch):
@@ -143,11 +144,20 @@ class Trainer(object):
 
         # Sharding-annotated jit: XLA inserts the gradient all-reduce over
         # the data axis because batch inputs are split and params/outputs
-        # are required replicated.
+        # are required replicated. With constrain_state=False (TP/hybrid
+        # runs) the state keeps whatever layout the caller placed it in
+        # (e.g. megatron rules from parallel/sharding.py) and the step
+        # preserves it.
+        if self._constrain_state:
+            state_in, state_out = self.replicated, self.replicated
+            metrics_out = self.replicated
+            out_shardings = (state_out, metrics_out)
+        else:
+            state_in, out_shardings = None, None
         self._jit_step = jax.jit(
             _step,
-            in_shardings=(self.replicated, self.batch_sharding),
-            out_shardings=(self.replicated, self.replicated),
+            in_shardings=(state_in, self.batch_sharding),
+            out_shardings=out_shardings,
             donate_argnums=(0,) if self._donate else ())
 
     def init(self, rng, sample):

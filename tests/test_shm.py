@@ -1,0 +1,141 @@
+"""Native shm feed-ring tests: unit, cross-process, cluster e2e, and a
+throughput sanity check vs the manager-queue path."""
+
+import json
+import multiprocessing
+import os
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import shm
+
+pytestmark = pytest.mark.skipif(not shm.available(),
+                                reason="native shm ring unavailable")
+
+
+def test_ring_roundtrip_and_wraparound():
+    ring = shm.ShmRing.create("/tfos-test-rt", capacity=1 << 16)
+    try:
+        msgs = [os.urandom(5000) for _ in range(40)]  # > capacity total
+        got = []
+        for i, m in enumerate(msgs):
+            ring.write(m, timeout=1.0)
+            got.append(ring.read(timeout=1.0))  # consume as we go -> wraps
+        assert got == msgs
+        assert ring.pending() == 0
+    finally:
+        ring.unlink()
+        ring.close()
+
+
+def test_ring_backpressure_and_timeout():
+    ring = shm.ShmRing.create("/tfos-test-bp", capacity=1 << 12)
+    try:
+        ring.write(b"x" * 3000, timeout=1.0)
+        with pytest.raises(TimeoutError):
+            ring.write(b"y" * 3000, timeout=0.2)  # full: must time out
+        with pytest.raises(ValueError):
+            ring.write(b"z" * 5000)  # bigger than the ring
+        assert ring.read(timeout=1.0) == b"x" * 3000
+        ring.write(b"y" * 3000, timeout=1.0)  # now fits
+        assert ring.read(timeout=1.0) == b"y" * 3000
+        assert ring.read(timeout=0.1) is None  # empty: timeout -> None
+    finally:
+        ring.unlink()
+        ring.close()
+
+
+def _producer(name, n, size):
+    r = shm.ShmRing.open(name)
+    for i in range(n):
+        r.write_obj({"i": i, "payload": b"p" * size})
+    r.close()
+
+
+def test_ring_cross_process():
+    ring = shm.ShmRing.create("/tfos-test-xp", capacity=1 << 20)
+    try:
+        proc = multiprocessing.get_context("fork").Process(
+            target=_producer, args=(ring.name, 200, 2048))
+        proc.start()
+        seen = [ring.read_obj(timeout=10.0)["i"] for _ in range(200)]
+        proc.join(timeout=10)
+        assert seen == list(range(200))
+    finally:
+        ring.unlink()
+        ring.close()
+
+
+def test_cluster_shm_feed_roundtrip(tmp_path):
+    """Full queue-fed training e2e with TFOS_FEED_TRANSPORT=shm."""
+    from tensorflowonspark_tpu import cluster
+    from tensorflowonspark_tpu.engine import Context
+
+    os.environ["TFOS_FEED_TRANSPORT"] = "shm"
+    sc = Context(num_executors=2, work_root=str(tmp_path / "engine"),
+                 executor_env={"TFOS_FEED_TRANSPORT": "shm"})
+    try:
+        out_dir = str(tmp_path / "sums")
+        os.makedirs(out_dir)
+
+        def map_fun(args, ctx):
+            feed = ctx.get_data_feed(train_mode=True)
+            total, count = 0, 0
+            while not feed.should_stop():
+                batch = feed.next_batch(16)
+                total += sum(batch)
+                count += len(batch)
+            with open(os.path.join(args["out"],
+                                   "node-%d.json" % ctx.executor_id),
+                      "w") as f:
+                json.dump({"total": total, "count": count,
+                           "stats": feed.stats()}, f)
+
+        tfc = cluster.run(sc, map_fun, {"out": out_dir}, num_executors=2,
+                          input_mode=cluster.InputMode.SPARK)
+        tfc.train(sc.parallelize(range(300), 4), num_epochs=2)
+        tfc.shutdown()
+
+        stats = [json.load(open(os.path.join(out_dir, f)))
+                 for f in sorted(os.listdir(out_dir))]
+        assert sum(s["total"] for s in stats) == sum(range(300)) * 2
+        assert sum(s["count"] for s in stats) == 600
+        assert sum(s["stats"]["records"] for s in stats) == 600
+    finally:
+        os.environ.pop("TFOS_FEED_TRANSPORT", None)
+        sc.stop()
+
+
+def test_ring_faster_than_queue_for_bulk():
+    """The native ring must beat a manager-proxy queue on bulk chunks
+    (the whole point of the fast path); generous 1.5x margin to avoid
+    flakiness on a loaded 1-core box."""
+    from tensorflowonspark_tpu import manager
+
+    payload = [b"x" * 1024] * 256  # one chunk of 256 KB-ish records
+    n = 50
+
+    mgr = manager.start(b"benchkey", ["input"], maxsize=8)
+    q = mgr.get_queue("input")
+    t0 = time.monotonic()
+    for _ in range(n):
+        q.put(payload)
+        q.get()
+        q.task_done()
+    t_queue = time.monotonic() - t0
+
+    ring = shm.ShmRing.create("/tfos-test-bench", capacity=1 << 24)
+    try:
+        t0 = time.monotonic()
+        for _ in range(n):
+            ring.write_obj(payload)
+            ring.read_obj()
+        t_ring = time.monotonic() - t0
+    finally:
+        ring.unlink()
+        ring.close()
+
+    print("queue: %.1f ms  ring: %.1f ms  (%.1fx)" % (
+        t_queue * 1e3, t_ring * 1e3, t_queue / t_ring))
+    assert t_ring * 1.5 < t_queue, (t_ring, t_queue)

@@ -152,10 +152,12 @@ class TFCluster(object):
 
         if shutdown_error is not None:
             raise RuntimeError(
-                "cluster shutdown surfaced a trainer error") from shutdown_error
+                "cluster shutdown surfaced a trainer error:\n{}".format(
+                    shutdown_error)) from shutdown_error
         if bootstrap_error is not None:
             raise RuntimeError(
-                "cluster node failed") from bootstrap_error
+                "cluster node failed:\n{}".format(
+                    bootstrap_error)) from bootstrap_error
         if stream_error is not None:
             raise RuntimeError(
                 "streaming feed failed") from stream_error
@@ -176,13 +178,21 @@ def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
         manager_mode="local"):
     """Start a cluster: one node per executor, roles per the template.
 
-    Reference: ``TFCluster.run`` (SURVEY.md §3.1). ``num_ps`` and
-    ``driver_ps_nodes`` are accepted for API parity but parameter-server
-    roles are not meaningful on TPU (SURVEY.md §2.3: async-PS DP is not
-    idiomatic — DP is synchronous allreduce via XLA collectives); passing
-    ``num_ps > 0`` still creates ps-role nodes for program compatibility,
-    and their fns simply see ``ctx.job_name == 'ps'``.
+    Reference: ``TFCluster.run`` (SURVEY.md §3.1). ``num_ps`` is accepted
+    for API parity but parameter-server roles are not meaningful on TPU
+    (SURVEY.md §2.3: async-PS DP is not idiomatic — DP is synchronous
+    allreduce via XLA collectives); passing ``num_ps > 0`` still creates
+    ps-role nodes for program compatibility, and their fns simply see
+    ``ctx.job_name == 'ps'``. ``driver_ps_nodes`` (reference: run ps tasks
+    as driver-side threads) raises: silently ignoring it would change
+    where a migrated program's ps fns execute.
     """
+    if driver_ps_nodes:
+        raise NotImplementedError(
+            "driver_ps_nodes is not supported: async parameter-server DP "
+            "is not idiomatic on TPU (SURVEY.md §2.3) so ps fns run as "
+            "ordinary ps-role cluster nodes; pass num_ps>0 for that, or "
+            "drop driver_ps_nodes from the migrated program.")
     # 1. executor -> role template (reference: cluster_template build).
     needed = num_ps + 1 + (1 if eval_node else 0)
     if needed > num_executors:

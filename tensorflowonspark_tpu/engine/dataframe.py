@@ -40,11 +40,24 @@ def infer_schema_from_row(row):
 
 
 class DataFrame(object):
-    """A row RDD + schema. Construct via ``Context.createDataFrame``."""
+    """A row RDD + schema. Construct via ``Context.createDataFrame``.
+
+    ``schema`` may be a ``[(name, dtype)]`` list or a zero-arg callable
+    returning one — the callable is resolved on first access, so a
+    producer whose dtypes are only knowable by computing data (e.g.
+    ``TFModel.transform``) can stay lazy.
+    """
 
     def __init__(self, rdd, schema):
         self.rdd = rdd
-        self.schema = list(schema)
+        self._schema = None if callable(schema) else list(schema)
+        self._schema_fn = schema if callable(schema) else None
+
+    @property
+    def schema(self):
+        if self._schema is None:
+            self._schema = list(self._schema_fn())
+        return self._schema
 
     @property
     def columns(self):

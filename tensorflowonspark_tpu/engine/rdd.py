@@ -93,13 +93,36 @@ class RDD(object):
         return sum(self.ctx.run_job(self, _count_partition).get())
 
     def take(self, n):
+        """First n records, computing as few partitions as possible.
+
+        Spark-shaped scan: try 1 partition, then geometrically larger
+        batches (x4) until n records are gathered — a take(1) on a
+        many-partition RDD costs one task, not a full job.
+        """
         out = []
-        # naive but sufficient: partitions evaluate lazily driver-side order
-        for part in self.ctx.run_job(self, _collect_partition).get():
-            out.extend(part)
-            if len(out) >= n:
-                break
+        i = 0
+        width = 1
+        while i < len(self._partitions) and len(out) < n:
+            batch = self._partitions[i:i + width]
+            # In-task limit: tasks return at most the records still
+            # needed, never the whole partition (Spark's runJob shape).
+            need = n - len(out)
+            results = self.ctx.run_job(
+                RDD(self.ctx, batch),
+                lambda it, _k=need: list(itertools.islice(it, _k))).get()
+            for part in results:
+                out.extend(part)
+                if len(out) >= n:
+                    break
+            i += len(batch)
+            width *= 4
         return out[:n]
+
+    def first(self):
+        got = self.take(1)
+        if not got:
+            raise ValueError("RDD is empty")
+        return got[0]
 
     def foreachPartition(self, f):
         """Run f over every partition; blocks; re-raises executor errors."""

@@ -50,6 +50,33 @@ FEED_CHUNK = 256
 _NODE_STATE = {}
 
 
+def _state():
+    """The live per-process node state dict — ALWAYS use this in closures.
+
+    The closures returned by ``run``/``train``/``inference``/``shutdown``
+    are nested functions, so cloudpickle ships them to executors BY VALUE
+    and copies referenced module globals (including the ``_NODE_STATE``
+    dict) into a private ``__globals__``. A bare ``_NODE_STATE[...]``
+    inside such a closure therefore reads/writes a dead per-closure copy
+    on the executor, while module-level helpers (pickled by reference)
+    read the real module dict — a split-brain. Module *functions* are
+    pickled by reference, so routing every access through this accessor
+    keeps all parties on the one true dict. Resolved via ``sys.modules``
+    for belt-and-braces against any by-value fallback.
+    """
+    import sys
+    return sys.modules[__name__]._NODE_STATE
+
+
+def _cleanup_ring(ring_name):
+    """atexit hook: never leak a /dev/shm ring from an aborted run."""
+    try:
+        from tensorflowonspark_tpu import shm
+        shm._load().shmring_unlink(ring_name.encode())
+    except Exception:  # noqa: BLE001 - best effort at interpreter exit
+        pass
+
+
 class NodeContext(object):
     """Handed to the user ``map_fun`` as its second argument.
 
@@ -145,6 +172,17 @@ class NodeContext(object):
         if (len(participants) > 1 and self.executor_id in ids
                 and _jax_distributed_enabled()):
             import jax
+
+            # Cross-process collectives on the CPU backend need a host
+            # transport; gloo ships with jaxlib. No-op for TPU (ICI/DCN
+            # collectives are XLA-native), but it makes the CPU-device
+            # harness (SURVEY.md §4's local-cluster analog) a faithful
+            # multi-process rehearsal of the pod path.
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:  # noqa: BLE001 - older/newer jaxlib naming
+                pass
             jax.distributed.initialize(
                 coordinator_address=self.coordinator_address(),
                 num_processes=len(participants),
@@ -200,7 +238,7 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
 
         # Duplicate-bootstrap guard (reference: cluster-id check in
         # TFSparkNode.run for retried tasks).
-        if _NODE_STATE.get("cluster_id") == cluster_meta["id"]:
+        if _state().get("cluster_id") == cluster_meta["id"]:
             logger.warning("executor %s already bootstrapped for cluster %s; "
                            "skipping duplicate node task", executor_id,
                            cluster_meta["id"])
@@ -226,6 +264,8 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                 shm._load().shmring_unlink(ring_name.encode())  # clear stale
                 ring = shm.ShmRing.create(ring_name)
                 mgr.set("shm_name", ring_name)
+                import atexit
+                atexit.register(_cleanup_ring, ring_name)
                 logger.info("feed fast path: shm ring %s", ring_name)
             else:
                 logger.warning("TFOS_FEED_TRANSPORT=shm requested but the "
@@ -260,9 +300,9 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                           cluster_meta, mgr_addr=mgr.address,
                           mgr_authkey=authkey, mgr=mgr)
 
-        _NODE_STATE.update(cluster_id=cluster_meta["id"], mgr=mgr,
-                           executor_id=executor_id, ctx=ctx,
-                           trainer_proc=None, tb_pid=tb_pid, shm_ring=ring)
+        _state().update(cluster_id=cluster_meta["id"], mgr=mgr,
+                        executor_id=executor_id, ctx=ctx,
+                        trainer_proc=None, tb_pid=tb_pid, shm_ring=ring)
 
         if background:
             # InputMode.SPARK: the trainer runs in a child process (it will
@@ -292,7 +332,7 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                     name="tfos-trainer-%s" % executor_id)
             proc.daemon = True
             proc.start()
-            _NODE_STATE["trainer_proc"] = proc
+            _state()["trainer_proc"] = proc
             logger.info("spawned background trainer pid %d", proc.pid)
 
             # Watchdog: a trainer killed without running its exception
@@ -409,8 +449,9 @@ def _get_manager(cluster_info, cluster_meta, executor_id):
     the node's advertised mgr_addr in cluster_info and connect with the
     cluster authkey from cluster_meta.
     """
-    if _NODE_STATE.get("executor_id") == executor_id and "mgr" in _NODE_STATE:
-        return _NODE_STATE["mgr"]
+    st = _state()
+    if st.get("executor_id") == executor_id and "mgr" in st:
+        return st["mgr"]
     for node in cluster_info:
         if node["executor_id"] == executor_id:
             authkey = bytes.fromhex(cluster_meta["authkey"])
@@ -455,7 +496,7 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
 def _feed_ring(qname):
     """The node's shm ring, when the fast path is active for this queue."""
     if qname == "input":
-        return _NODE_STATE.get("shm_ring")
+        return _state().get("shm_ring")
     return None
 
 
@@ -635,7 +676,8 @@ def shutdown(cluster_info, cluster_meta, queues=("input",), grace_secs=0):
         if mgr.get("state") == "running":
             mgr.set("state", "terminating")
 
-        proc = _NODE_STATE.get("trainer_proc")
+        st = _state()
+        proc = st.get("trainer_proc")
         we_terminated = False
         if proc is not None:
             proc.join(timeout=max(grace_secs, 60))
@@ -645,17 +687,17 @@ def shutdown(cluster_info, cluster_meta, queues=("input",), grace_secs=0):
                 we_terminated = True
                 proc.terminate()
                 proc.join(timeout=10)
-        tb_pid = _NODE_STATE.get("tb_pid")
+        tb_pid = st.get("tb_pid")
         if tb_pid:
             try:
                 os.kill(tb_pid, 15)
             except OSError:
                 pass
-        ring = _NODE_STATE.pop("shm_ring", None)
+        ring = st.pop("shm_ring", None)
         if ring is not None:
             ring.unlink()
             ring.close()
-        _NODE_STATE.pop("cluster_id", None)
+        st.pop("cluster_id", None)
 
         # Error surfacing: anything on the error queue fails this task.
         errors = []

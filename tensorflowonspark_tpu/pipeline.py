@@ -216,9 +216,13 @@ class TFModel(_ParamsBase):
             outputs = out_mapping or {
                 c: c for c in signature.get("outputs", [])}
 
-            rows = list(iterator)
-            for start in range(0, len(rows), batch_size):
-                chunk = rows[start:start + batch_size]
+            import itertools
+
+            it = iter(iterator)
+            while True:
+                chunk = list(itertools.islice(it, batch_size))
+                if not chunk:
+                    break
                 batch = {feed: np.asarray([row[col] for row in chunk])
                          for col, feed in inputs.items()}
                 result = apply_fn(variables, batch)
@@ -233,6 +237,21 @@ class TFModel(_ParamsBase):
                             if value.ndim > 0 else value.item()
                     yield out_row
 
-        out_cols = list((out_mapping or {"output": "output"}).values())
-        schema = [(c, "float32") for c in out_cols]  # dtype refined on read
-        return DataFrame(df.rdd.mapPartitions(_run_model), schema)
+        result_rdd = df.rdd.mapPartitions(_run_model)
+
+        # Honest output schema, lazily: dtypes come from the first real
+        # result row (the way dfutil infers from the first Example) but
+        # only if/when the schema is actually read — take(1) then costs a
+        # single one-row task, and the loaded model stays cached on that
+        # executor for the full pass. Empty input falls back to the
+        # declared columns as float32.
+        def _infer_output_schema():
+            first = result_rdd.take(1)
+            if first:
+                from tensorflowonspark_tpu.engine.dataframe import (
+                    _infer_dtype)
+                return [(c, _infer_dtype(v)) for c, v in first[0].items()]
+            out_cols = list((out_mapping or {"output": "output"}).values())
+            return [(c, "float32") for c in out_cols]
+
+        return DataFrame(result_rdd, _infer_output_schema)

@@ -105,3 +105,25 @@ def test_executor_crash_fails_pending_tasks_not_hangs(tmp_path):
             ctx.parallelize([1, 2], 2).mapPartitions(die).collect()
     finally:
         ctx.stop()
+
+
+def test_take_computes_minimal_partitions(sc):
+    """weak #8: take(1) must not evaluate every partition."""
+    import os
+    import tempfile
+
+    marker_dir = tempfile.mkdtemp(prefix="take-probe-")
+
+    def touch(idx, it):
+        items = list(it)
+        with open(os.path.join(marker_dir, "part-%d" % idx), "w") as f:
+            f.write(str(len(items)))
+        return iter(items)
+
+    rdd = sc.parallelize(range(100), 10).mapPartitionsWithIndex(touch)
+    assert rdd.take(3) == [0, 1, 2]
+    computed = len(os.listdir(marker_dir))
+    assert computed <= 5, "take(3) computed {} of 10 partitions".format(
+        computed)
+    assert rdd.first() == 0
+    assert sc.parallelize([], 4).take(2) == []

@@ -1,0 +1,131 @@
+"""Regression tests for the _NODE_STATE cloudpickle split-brain.
+
+The closures node.run/train/inference/shutdown return are nested
+functions: cloudpickle ships them to executors BY VALUE, copying
+referenced module globals into a private ``__globals__``. Direct access
+to the ``_NODE_STATE`` module global inside them therefore used to write
+a dead per-closure copy while module-level helpers (pickled by
+reference) read the real, empty module dict — so the shm fast path
+stalled, the dup-bootstrap guard was dead code, and shutdown never
+joined the trainer. These tests pickle-roundtrip every closure (exactly
+what the engine's serializer does) and assert all parties share the one
+live module dict.
+"""
+
+import glob
+import os
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import node, reservation, shm, util
+from tensorflowonspark_tpu.engine import serializer
+
+
+def _ship(fn):
+    """Simulate shipping a closure to an executor (cloudpickle by value)."""
+    return serializer.loads(serializer.dumps(fn))
+
+
+@pytest.fixture
+def node_env(tmp_path, monkeypatch):
+    """A clean in-process 'executor': empty state, tmp cwd, ordinal 0."""
+    monkeypatch.chdir(tmp_path)
+    util.write_executor_id(0)
+    node._NODE_STATE.clear()
+    yield
+    proc = node._NODE_STATE.get("trainer_proc")
+    if proc is not None and proc.is_alive():
+        proc.terminate()
+        proc.join(5)
+    ring = node._NODE_STATE.get("shm_ring")
+    if ring is not None:
+        ring.unlink()
+        ring.close()
+    node._NODE_STATE.clear()
+
+
+def _cluster_meta(server_addr, cluster_id="split-brain-test"):
+    return {
+        "id": cluster_id,
+        "cluster_template": {"chief": [0]},
+        "server_addr": list(server_addr),
+        "authkey": os.urandom(20).hex(),
+        "default_fs": "file://",
+        "working_dir": os.getcwd(),
+        "num_executors": 1,
+        "master_node": "chief",
+        "manager_mode": "local",
+        "reservation_timeout": 30,
+    }
+
+
+def _feed_until_stop(args, ctx):
+    feed = ctx.get_data_feed(train_mode=True)
+    while not feed.should_stop():
+        feed.next_batch(8)
+
+
+def test_shipped_closures_share_live_module_state(node_env):
+    server = reservation.Server(1)
+    meta = _cluster_meta(server.start())
+    try:
+        mapfn = _ship(node.run(_feed_until_stop, {}, meta, background=True))
+        mapfn(iter([0]))
+
+        # THE split-brain assertion: the bootstrap must have written the
+        # real module dict, not a pickled copy inside the closure.
+        st = node._NODE_STATE
+        assert st.get("cluster_id") == meta["id"]
+        assert st.get("executor_id") == 0
+        assert st.get("mgr") is not None
+        proc = st.get("trainer_proc")
+        assert proc is not None and proc.is_alive()
+
+        # Dup-bootstrap guard must now actually fire: a retried node task
+        # is a fast no-op (it would otherwise hang re-registering with the
+        # already-full reservation barrier).
+        t0 = time.monotonic()
+        mapfn(iter([0]))
+        assert time.monotonic() - t0 < 5.0
+        assert st.get("trainer_proc") is proc  # not respawned
+
+        # Shutdown (also shipped by value) must find the trainer, join it,
+        # and clear the cluster binding.
+        info = st["ctx"].cluster_info
+        shut = _ship(node.shutdown(info, meta))
+        shut(iter(()))
+        assert proc.exitcode == 0
+        assert "cluster_id" not in st
+    finally:
+        server.stop()
+
+
+@pytest.mark.skipif(not shm.available(),
+                    reason="native shm ring unavailable")
+def test_shm_ring_registered_in_live_state_and_unlinked(node_env,
+                                                        monkeypatch):
+    monkeypatch.setenv("TFOS_FEED_TRANSPORT", "shm")
+    server = reservation.Server(1)
+    meta = _cluster_meta(server.start(), cluster_id="shm-state-test")
+    try:
+        mapfn = _ship(node.run(_feed_until_stop, {}, meta, background=True))
+        mapfn(iter([0]))
+        st = node._NODE_STATE
+        ring = st.get("shm_ring")
+        assert ring is not None, "bootstrap must record the ring feeders use"
+        assert st["mgr"].get("shm_name") == ring.name
+        # _feed_ring (module-level, by-reference) must see the same ring
+        # the (by-value) bootstrap closure created.
+        assert node._feed_ring("input") is ring
+        shm_file = "/dev/shm/" + ring.name.lstrip("/")
+        assert os.path.exists(shm_file)
+
+        info = st["ctx"].cluster_info
+        _ship(node.shutdown(info, meta))(iter(()))
+        assert st.get("trainer_proc").exitcode == 0
+        # weak #4: no /dev/shm leak after shutdown.
+        assert not os.path.exists(shm_file)
+        assert not glob.glob("/dev/shm/tfos-*-test*")
+    finally:
+        server.stop()

@@ -134,3 +134,43 @@ def test_params_accessors():
     assert merged.epochs == 1  # default
     with pytest.raises(AttributeError):
         est.setNoSuchParam(1)
+
+
+def test_transform_schema_inferred_from_results(sc, tmp_path):
+    """weak #6: output schema must reflect the model's real dtypes —
+    int predictions used to get a lying float32 schema."""
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import export, pipeline
+
+    def apply_fn(variables, batch):
+        return {"label": jnp.argmax(batch["x"][:, None] * variables["w"],
+                                    axis=1),
+                "score": batch["x"] * 2.0}
+
+    d = str(tmp_path / "intmodel")
+    export.save_model(d, apply_fn, {"w": jnp.asarray([1.0, 2.0])},
+                      signature={"inputs": ["x"],
+                                 "outputs": ["label", "score"]})
+
+    df = sc.createDataFrame([{"x": float(i)} for i in range(8)],
+                            num_slices=2)
+    model = (pipeline.TFModel({"export_dir": d})
+             .setInputMapping({"x": "x"})
+             .setOutputMapping({"label": "label", "score": "score"})
+             .setBatchSize(4))
+    out = model.transform(df)
+    assert dict(out.schema)["label"] == "int64", out.schema
+    assert dict(out.schema)["score"] == "float32", out.schema
+    rows = out.collect()
+    assert len(rows) == 8
+    assert all(isinstance(r["label"], int) for r in rows)
+
+
+def test_driver_ps_nodes_fails_loudly(sc):
+    """weak #5: driver_ps_nodes was accepted and silently ignored."""
+    from tensorflowonspark_tpu import cluster
+
+    with pytest.raises(NotImplementedError, match="driver_ps_nodes"):
+        cluster.run(sc, lambda a, c: None, {}, num_executors=2,
+                    num_ps=1, driver_ps_nodes=True)

@@ -59,7 +59,7 @@ def test_ring_cross_process():
         proc = multiprocessing.get_context("fork").Process(
             target=_producer, args=(ring.name, 200, 2048))
         proc.start()
-        seen = [ring.read_obj(timeout=10.0)["i"] for _ in range(200)]
+        seen = [ring.read_obj(timeout=30.0)["i"] for _ in range(200)]
         proc.join(timeout=10)
         assert seen == list(range(200))
     finally:

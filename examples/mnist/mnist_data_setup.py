@@ -8,9 +8,16 @@ egress, so the source chain is:
 2. sklearn's bundled ``load_digits`` (1797 real 8x8 handwritten digits)
    bilinearly upscaled to 28x28 and repeated to the requested size.
 
-Output: ``<out>/{train,test}/part-*.csv`` where each row is
-``label,p0,p1,...,p783`` with pixels in [0, 255] — the same row shape the
-reference's CSV path feeds through ``DataFeed``.
+Output (``--format csv|tfrecord|both``, default both — reference wrote
+both copies):
+
+- ``<out>/{train,test}/part-*.csv`` — rows ``label,p0,...,p783`` with
+  pixels in [0, 255], the shape the reference's CSV path feeds through
+  ``DataFeed``.
+- ``<out>/{train,test}-tfr/part-*`` — TFRecord shards written through the
+  engine with ``dfutil.saveAsTFRecords`` (the
+  ``saveAsNewAPIHadoopFile`` analog); each Example has an ``image``
+  bytes feature (raw uint8, 784 long) and an ``int64`` ``label``.
 """
 
 import argparse
@@ -74,21 +81,61 @@ def write_csv(x, y, out_dir, num_parts):
                         ",".join(str(int(v)) for v in flat[i]) + "\n")
 
 
+def write_tfrecords(x, y, out_dir, num_parts, sc=None):
+    """TFRecord shards via the engine + dfutil (the Spark-write analog).
+
+    Reference: ``mnist_data_setup.py`` wrote TFRecord copies through
+    ``saveAsNewAPIHadoopFile``; here the same DataFrame->TFRecord
+    path is ``dfutil.saveAsTFRecords``. ``sc``: reuse a Context, else a
+    temporary 2-executor one is spun up.
+    """
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+    from tensorflowonspark_tpu import dfutil
+    from tensorflowonspark_tpu.engine import Context
+
+    flat = x.reshape(len(x), -1)
+    rows = [{"image": flat[i].tobytes(), "label": int(y[i])}
+            for i in range(len(x))]
+    own = sc is None
+    if own:
+        sc = Context(num_executors=2)
+    try:
+        df = sc.createDataFrame(rows, schema=[("image", "binary"),
+                                              ("label", "int64")],
+                                num_slices=num_parts)
+        count = dfutil.saveAsTFRecords(df, out_dir)
+    finally:
+        if own:
+            sc.stop()
+    return count
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--output", default="data/mnist")
     ap.add_argument("--num-train", type=int, default=6000)
     ap.add_argument("--num-test", type=int, default=1000)
     ap.add_argument("--num-partitions", type=int, default=4)
+    ap.add_argument("--format", choices=("csv", "tfrecord", "both"),
+                    default="both")
     args = ap.parse_args(argv)
 
     x_tr, y_tr, x_te, y_te = load_mnist_like(args.num_train, args.num_test)
-    write_csv(x_tr, y_tr, os.path.join(args.output, "train"),
-              args.num_partitions)
-    write_csv(x_te, y_te, os.path.join(args.output, "test"),
-              args.num_partitions)
-    print("wrote {} train / {} test rows under {}".format(
-        len(x_tr), len(x_te), args.output))
+    if args.format in ("csv", "both"):
+        write_csv(x_tr, y_tr, os.path.join(args.output, "train"),
+                  args.num_partitions)
+        write_csv(x_te, y_te, os.path.join(args.output, "test"),
+                  args.num_partitions)
+    if args.format in ("tfrecord", "both"):
+        write_tfrecords(x_tr, y_tr, os.path.join(args.output, "train-tfr"),
+                        args.num_partitions)
+        write_tfrecords(x_te, y_te, os.path.join(args.output, "test-tfr"),
+                        args.num_partitions)
+    print("wrote {} train / {} test rows under {} ({})".format(
+        len(x_tr), len(x_te), args.output, args.format))
 
 
 if __name__ == "__main__":

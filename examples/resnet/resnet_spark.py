@@ -2,16 +2,22 @@
 (Keras multi-worker ResNet-CIFAR port; also covers BASELINE config #2's
 ResNet-50 shape with ``--imagenet``).
 
-Synthetic data by default (zero-egress environment); the data path and
-input pipeline match what a real CIFAR/ImageNet feed would use
-(InputMode.TENSORFLOW: each worker reads its shard; batches prefetched
-and sharded over the mesh).
+Input pipeline (InputMode.TENSORFLOW — each worker reads its own shard,
+reference: ``examples/mnist/tf`` direct file reads):
 
-CPU dev run::
+- ``--data_dir DIR``: read TFRecord shards (``image`` raw-uint8 bytes +
+  ``label`` int64 Examples, the format ``mnist_data_setup``/
+  ``--make_data`` write); files are sharded across workers, decoded with
+  the first-party codec, normalized on device. Reader throughput is
+  recorded in train_stats.json.
+- default: synthetic arrays (zero-egress environment).
+
+Write synthetic shards then train from them (CPU dev run)::
 
     JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= TFOS_TPU_DISTRIBUTED=0 \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-    python examples/resnet/resnet_spark.py --cluster_size 2 --steps 10
+    python examples/resnet/resnet_spark.py --cluster_size 2 --steps 10 \
+        --make_data 2048 --data_dir .scratch/data/cifar-tfr
 """
 
 import argparse
@@ -27,12 +33,36 @@ from tensorflowonspark_tpu import cluster  # noqa: E402
 from tensorflowonspark_tpu.engine import Context  # noqa: E402
 
 
+def make_synthetic_tfrecords(data_dir, n, image, classes, shards=4):
+    """Synthetic CIFAR/ImageNet-shaped TFRecord shards (raw uint8 images)."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import tfrecord
+
+    os.makedirs(data_dir, exist_ok=True)
+    rng = np.random.RandomState(0)
+    per = -(-n // shards)
+    written = 0
+    for s in range(shards):
+        path = os.path.join(data_dir, "part-%05d" % s)
+        with tfrecord.TFRecordWriter(path) as w:
+            for _ in range(min(per, n - written)):
+                img = rng.randint(0, 255, (image, image, 3), dtype=np.uint8)
+                w.write(tfrecord.encode_example(
+                    {"image": [img.tobytes()],
+                     "label": [int(rng.randint(classes))]}))
+                written += 1
+    return written
+
+
 def map_fun(args, ctx):
+    import time
+
     import jax
     import numpy as np
     import optax
 
-    from tensorflowonspark_tpu import infeed, training
+    from tensorflowonspark_tpu import infeed, tfrecord, training
     from tensorflowonspark_tpu.models.resnet import ResNet, ResNet50
 
     ctx.initialize_jax()
@@ -46,12 +76,49 @@ def map_fun(args, ctx):
     trainer = training.Trainer(
         model, optax.sgd(args["lr"], momentum=0.9), mesh)
     rng = np.random.RandomState(ctx.task_index)
+    reader_rate = None
 
-    def batches():
-        for _ in range(args["steps"]):
-            yield {"x": rng.rand(args["batch_size"], image, image, 3)
-                   .astype(np.float32),
-                   "y": rng.randint(0, classes, args["batch_size"])}
+    if args.get("data_dir"):
+        # BASELINE config #2's input mode: every worker reads its own
+        # shard of TFRecord files with the first-party codec; images ship
+        # as raw uint8 and normalize on device (model casts).
+        files = tfrecord.list_tfrecord_files(ctx.absolute_path(
+            args["data_dir"]))
+        my_files = files[ctx.task_sorted_index()::max(ctx.num_workers, 1)]
+        if not my_files:
+            raise ValueError("fewer TFRecord shards than workers; "
+                             "re-shard the input")
+
+        # reader-throughput probe: one pass over this worker's shard
+        t0 = time.monotonic()
+        probe = 0
+        for path in my_files:
+            for _ in tfrecord.tfrecord_iterator(path):
+                probe += 1
+        reader_rate = probe / max(time.monotonic() - t0, 1e-9)
+
+        def record_stream():
+            while True:  # epoch loop
+                for path in my_files:
+                    for rec in tfrecord.tfrecord_iterator(path):
+                        ex = tfrecord.parse_example(rec)
+                        img = np.frombuffer(ex["image"][1][0], np.uint8)
+                        yield (img.reshape(image, image, 3),
+                               int(ex["label"][1][0]))
+
+        stream = record_stream()
+
+        def batches():
+            for _ in range(args["steps"]):
+                pairs = [next(stream) for _ in range(args["batch_size"])]
+                yield {"x": np.stack([p[0] for p in pairs]),
+                       "y": np.asarray([p[1] for p in pairs], np.int64)}
+    else:
+        def batches():
+            for _ in range(args["steps"]):
+                yield {"x": rng.rand(args["batch_size"], image, image, 3)
+                       .astype(np.float32),
+                       "y": rng.randint(0, classes, args["batch_size"])}
 
     state = trainer.init(jax.random.PRNGKey(0),
                          np.zeros((8, image, image, 3), np.float32))
@@ -62,8 +129,10 @@ def map_fun(args, ctx):
         os.makedirs(out, exist_ok=True)
         with open(os.path.join(out, "train_stats.json"), "w") as f:
             json.dump({"steps": steps, "images_per_sec": rate,
-                       "images_per_sec_per_device": rate / len(jax.devices())},
-                      f)
+                       "images_per_sec_per_device": rate / len(jax.devices()),
+                       "reader_records_per_sec": reader_rate,
+                       "input": "tfrecord" if args.get("data_dir")
+                       else "synthetic"}, f)
 
 
 def main(argv=None):
@@ -75,8 +144,22 @@ def main(argv=None):
     ap.add_argument("--imagenet", action="store_true",
                     help="ResNet-50/224px/1000-class (BASELINE config #2)")
     ap.add_argument("--model_dir", default=".scratch/resnet_model")
+    ap.add_argument("--data_dir", default=None,
+                    help="TFRecord shard dir (InputMode.TENSORFLOW reads)")
+    ap.add_argument("--make_data", type=int, default=0, metavar="N",
+                    help="first write N synthetic TFRecord examples to "
+                         "--data_dir")
     args = ap.parse_args(argv)
     logging.basicConfig(level="INFO")
+
+    if args.make_data:
+        if not args.data_dir:
+            ap.error("--make_data requires --data_dir")
+        image, classes = (224, 1000) if args.imagenet else (32, 10)
+        n = make_synthetic_tfrecords(args.data_dir, args.make_data, image,
+                                     classes,
+                                     shards=max(args.cluster_size * 2, 4))
+        print("wrote {} examples to {}".format(n, args.data_dir))
 
     sc = Context(num_executors=args.cluster_size)
     try:

@@ -4,13 +4,29 @@
 // moves serialized record chunks from the feeder (executor) process into
 // the trainer (TPU-owning) process through one mmap'd region, replacing a
 // TCP round trip through the multiprocessing manager proxy per chunk with
-// two memcpys and an atomic pointer bump. Single producer, single consumer
+// memcpys and an atomic pointer bump. Single producer, single consumer
 // (the executor feeds its own node's trainer — exactly the framework's
 // process layout), bounded capacity = natural backpressure.
 //
-// Layout: 128B header (cache-line-separated head/tail counters) + data.
-// Messages are [u32 length][payload] written circularly. head/tail are
-// monotonically increasing byte counters; (head - tail) is the fill.
+// v2 design notes (single-core hosts are the common case for the feeder +
+// trainer pair, so the v1 spin-wait was a throughput disaster — a spinning
+// consumer steals the only core from the producer it is waiting on):
+//
+// - Blocking is futex-based: each side publishes a sequence counter
+//   (data_seq bumped by the producer, space_seq by the consumer) and the
+//   waiter sleeps in FUTEX_WAIT on the peer's counter after a short spin.
+//   No polling, no stolen timeslices.
+// - Messages are CONTIGUOUS in the mapping: a message that would wrap is
+//   preceded by a pad marker (length 0xFFFFFFFF) and starts at offset 0.
+//   That enables shmring_read_ptr(): the consumer reads payloads in place
+//   (numpy frombuffer over the mapping, zero copy) and releases the slot
+//   with shmring_advance() when done.
+// - shmring_write_gather() writes one message from N scattered buffers
+//   (frame header + raw column arrays) with no caller-side concatenation.
+//
+// Layout: 256B header (cache-line-separated counters) + data region.
+// head/tail are monotonically increasing byte counters; (head - tail) is
+// the fill. Messages are [u32 length][payload], padded as above.
 //
 // Build: g++ -O2 -shared -fPIC -o libshmring.so shm_ring.cpp -lrt
 // (tensorflowonspark_tpu/shm.py builds this on demand and binds via ctypes.)
@@ -22,22 +38,27 @@
 #include <ctime>
 
 #include <fcntl.h>
+#include <linux/futex.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/syscall.h>
 #include <unistd.h>
 
 namespace {
 
-constexpr uint64_t kMagic = 0x54464F5352494E47ULL;  // "TFOSRING"
+constexpr uint64_t kMagic = 0x54464F5352494E32ULL;  // "TFOSRIN2"
+constexpr uint32_t kPadMarker = 0xFFFFFFFFu;
 
 struct Header {
-  std::atomic<uint64_t> head;  // bytes ever written (producer-owned)
-  char pad1[56];
-  std::atomic<uint64_t> tail;  // bytes ever consumed (consumer-owned)
-  char pad2[56];
-  uint64_t capacity;           // data-region size in bytes
+  std::atomic<uint64_t> head;      // bytes ever written (producer-owned)
+  std::atomic<uint32_t> data_seq;  // bumped+woken by producer after write
+  char pad1[52];
+  std::atomic<uint64_t> tail;      // bytes ever consumed (consumer-owned)
+  std::atomic<uint32_t> space_seq; // bumped+woken by consumer after read
+  char pad2[52];
+  uint64_t capacity;               // data-region size in bytes
   uint64_t magic;
-  char pad3[112];              // header = 240B + 16 -> round to 256
+  char pad3[112];
 };
 static_assert(sizeof(Header) == 256, "header must be 256 bytes");
 
@@ -53,29 +74,53 @@ inline uint64_t now_ms() {
   return static_cast<uint64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
 }
 
-inline void backoff(int spin) {
-  if (spin < 64) return;                       // busy spin first
-  struct timespec ts = {0, spin < 1024 ? 1000L : 100000L};  // 1us then 100us
-  nanosleep(&ts, nullptr);
+inline int futex_wait(std::atomic<uint32_t>* addr, uint32_t expect,
+                      uint64_t wait_ms) {
+  struct timespec ts;
+  ts.tv_sec = static_cast<time_t>(wait_ms / 1000);
+  ts.tv_nsec = static_cast<long>((wait_ms % 1000) * 1000000);
+  // FUTEX_WAIT (shared, not PRIVATE): the ring crosses processes.
+  return static_cast<int>(syscall(SYS_futex,
+                                  reinterpret_cast<uint32_t*>(addr),
+                                  FUTEX_WAIT, expect, &ts, nullptr, 0));
 }
 
-// circular copy helpers -----------------------------------------------------
-
-void ring_write_bytes(Handle* h, uint64_t pos, const uint8_t* src,
-                      uint64_t len) {
-  uint64_t cap = h->hdr->capacity;
-  uint64_t off = pos % cap;
-  uint64_t first = len < cap - off ? len : cap - off;
-  memcpy(h->data + off, src, first);
-  if (len > first) memcpy(h->data, src + first, len - first);
+inline void futex_wake(std::atomic<uint32_t>* addr) {
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(addr), FUTEX_WAKE, 1,
+          nullptr, nullptr, 0);
 }
 
-void ring_read_bytes(Handle* h, uint64_t pos, uint8_t* dst, uint64_t len) {
-  uint64_t cap = h->hdr->capacity;
+// Wait until pred() is true, sleeping on *seq between checks.
+// Returns false on timeout. The seq-value snapshot before the re-check
+// makes the sleep race-free: the peer bumps seq *before* futex_wake, so a
+// bump between our check and our FUTEX_WAIT fails the wait immediately.
+template <typename Pred>
+bool wait_for(std::atomic<uint32_t>* seq, int timeout_ms, Pred pred) {
+  for (int spin = 0; spin < 64; ++spin) {
+    if (pred()) return true;
+  }
+  uint64_t deadline = timeout_ms >= 0 ? now_ms() + timeout_ms : 0;
+  while (true) {
+    uint32_t s = seq->load(std::memory_order_acquire);
+    if (pred()) return true;
+    uint64_t slice = 100;  // bounded sleep: robust to a dead peer
+    if (timeout_ms >= 0) {
+      uint64_t now = now_ms();
+      if (now >= deadline) return false;
+      if (deadline - now < slice) slice = deadline - now;
+    }
+    futex_wait(seq, s, slice);
+  }
+}
+
+// Pad handling: a message of len bytes placed at head occupies
+// pad_before(head, len) + 4 + len bytes, where the pad (if any) jumps the
+// write position to the next capacity boundary so [u32 len][payload] is
+// contiguous in the mapping.
+inline uint64_t pad_before(uint64_t pos, uint64_t len, uint64_t cap) {
   uint64_t off = pos % cap;
-  uint64_t first = len < cap - off ? len : cap - off;
-  memcpy(dst, h->data + off, first);
-  if (len > first) memcpy(dst + first, h->data, len - first);
+  if (off + 4 + len <= cap) return 0;
+  return cap - off;  // skip to the boundary
 }
 
 }  // namespace
@@ -101,6 +146,8 @@ void* shmring_create(const char* name, uint64_t capacity) {
   auto* hdr = static_cast<Header*>(mem);
   hdr->head.store(0, std::memory_order_relaxed);
   hdr->tail.store(0, std::memory_order_relaxed);
+  hdr->data_seq.store(0, std::memory_order_relaxed);
+  hdr->space_seq.store(0, std::memory_order_relaxed);
   hdr->capacity = capacity;
   std::atomic_thread_fence(std::memory_order_release);
   hdr->magic = kMagic;
@@ -131,62 +178,123 @@ void* shmring_open(const char* name) {
   return h;
 }
 
-// 0 on success, -1 timeout, -2 message larger than the ring.
-int shmring_write(void* handle, const void* buf, uint64_t len,
-                  int timeout_ms) {
+// One message from n scattered buffers. 0 success, -1 timeout, -2 too big.
+int shmring_write_gather(void* handle, const void* const* bufs,
+                         const uint64_t* lens, int n, int timeout_ms) {
   auto* h = static_cast<Handle*>(handle);
-  uint64_t need = len + 4;
   uint64_t cap = h->hdr->capacity;
-  if (need > cap) return -2;
-  uint64_t deadline = now_ms() + static_cast<uint64_t>(timeout_ms);
+  uint64_t len = 0;
+  for (int i = 0; i < n; ++i) len += lens[i];
+  // Max message = half the capacity: with contiguous placement a message
+  // may need its own length in leading pad (pad < 4 + len whenever pad is
+  // nonzero), so len <= cap/2 - 4 guarantees pad + 4 + len <= cap and the
+  // write always eventually succeeds. Also keeps the u32 length header
+  // (and the 0xFFFFFFFF pad marker) unambiguous.
+  if (4 + len > cap / 2 || len >= 0xFFFFFFFFull) return -2;
   uint64_t head = h->hdr->head.load(std::memory_order_relaxed);
-  int spin = 0;
-  while (cap - (head - h->hdr->tail.load(std::memory_order_acquire)) < need) {
-    if (timeout_ms >= 0 && now_ms() > deadline) return -1;
-    backoff(++spin);
+  uint64_t pad = pad_before(head, len, cap);
+  uint64_t need = pad + 4 + len;
+  bool ok = wait_for(&h->hdr->space_seq, timeout_ms, [&] {
+    return cap - (head - h->hdr->tail.load(std::memory_order_acquire)) >= need;
+  });
+  if (!ok) return -1;
+  uint64_t off = head % cap;
+  if (pad) {
+    if (cap - off >= 4) {
+      uint32_t marker = kPadMarker;
+      memcpy(h->data + off, &marker, 4);
+    }
+    // fewer than 4 bytes to the boundary: consumer skips implicitly
+    head += pad;
+    off = 0;
   }
   uint32_t len32 = static_cast<uint32_t>(len);
-  ring_write_bytes(h, head, reinterpret_cast<const uint8_t*>(&len32), 4);
-  ring_write_bytes(h, head + 4, static_cast<const uint8_t*>(buf), len);
-  h->hdr->head.store(head + need, std::memory_order_release);
+  memcpy(h->data + off, &len32, 4);
+  uint64_t wpos = off + 4;
+  for (int i = 0; i < n; ++i) {
+    memcpy(h->data + wpos, bufs[i], lens[i]);
+    wpos += lens[i];
+  }
+  h->hdr->head.store(head + 4 + len, std::memory_order_release);
+  h->hdr->data_seq.fetch_add(1, std::memory_order_release);
+  futex_wake(&h->hdr->data_seq);
   return 0;
 }
 
-// Next message length, or -1 timeout. Does not consume.
-int64_t shmring_peek_len(void* handle, int timeout_ms) {
-  auto* h = static_cast<Handle*>(handle);
-  uint64_t deadline = now_ms() + static_cast<uint64_t>(timeout_ms);
-  uint64_t tail = h->hdr->tail.load(std::memory_order_relaxed);
-  int spin = 0;
-  while (h->hdr->head.load(std::memory_order_acquire) - tail < 4) {
-    if (timeout_ms >= 0 && now_ms() > deadline) return -1;
-    backoff(++spin);
-  }
-  uint32_t len32;
-  ring_read_bytes(h, tail, reinterpret_cast<uint8_t*>(&len32), 4);
-  return static_cast<int64_t>(len32);
+// 0 on success, -1 timeout, -2 message larger than the ring.
+int shmring_write(void* handle, const char* buf, uint64_t len,
+                  int timeout_ms) {
+  const void* bufs[1] = {buf};
+  uint64_t lens[1] = {len};
+  return shmring_write_gather(handle, bufs, lens, 1, timeout_ms);
 }
 
-// Bytes read into buf, -1 timeout, -3 buffer too small (message intact).
+// Wait for the next message; on success *out_len is its length and the
+// returned pointer addresses the CONTIGUOUS payload inside the mapping
+// (valid until shmring_advance). nullptr on timeout. Skips pads.
+const void* shmring_read_ptr(void* handle, int timeout_ms,
+                             uint64_t* out_len) {
+  auto* h = static_cast<Handle*>(handle);
+  uint64_t cap = h->hdr->capacity;
+  while (true) {
+    uint64_t tail = h->hdr->tail.load(std::memory_order_relaxed);
+    bool ok = wait_for(&h->hdr->data_seq, timeout_ms, [&] {
+      return h->hdr->head.load(std::memory_order_acquire) - tail >= 4;
+    });
+    if (!ok) return nullptr;
+    uint64_t off = tail % cap;
+    if (cap - off < 4) {  // implicit pad: no room for a length at the end
+      h->hdr->tail.store(tail + (cap - off), std::memory_order_release);
+      h->hdr->space_seq.fetch_add(1, std::memory_order_release);
+      futex_wake(&h->hdr->space_seq);
+      continue;
+    }
+    uint32_t len32;
+    memcpy(&len32, h->data + off, 4);
+    if (len32 == kPadMarker) {  // explicit pad marker: skip to boundary
+      h->hdr->tail.store(tail + (cap - off), std::memory_order_release);
+      h->hdr->space_seq.fetch_add(1, std::memory_order_release);
+      futex_wake(&h->hdr->space_seq);
+      continue;
+    }
+    uint64_t len = len32;
+    ok = wait_for(&h->hdr->data_seq, timeout_ms, [&] {
+      return h->hdr->head.load(std::memory_order_acquire) - tail >= 4 + len;
+    });
+    if (!ok) return nullptr;
+    *out_len = len;
+    return h->data + off + 4;
+  }
+}
+
+// Release the message last returned by shmring_read_ptr (length len).
+void shmring_advance(void* handle, uint64_t len) {
+  auto* h = static_cast<Handle*>(handle);
+  uint64_t tail = h->hdr->tail.load(std::memory_order_relaxed);
+  h->hdr->tail.store(tail + 4 + len, std::memory_order_release);
+  h->hdr->space_seq.fetch_add(1, std::memory_order_release);
+  futex_wake(&h->hdr->space_seq);
+}
+
+// Copying read (legacy API): bytes read into buf, -1 timeout, -3 buffer
+// too small (message left intact).
 int64_t shmring_read(void* handle, void* buf, uint64_t buflen,
                      int timeout_ms) {
-  auto* h = static_cast<Handle*>(handle);
-  int64_t len = shmring_peek_len(handle, timeout_ms);
-  if (len < 0) return len;
-  if (static_cast<uint64_t>(len) > buflen) return -3;
-  uint64_t tail = h->hdr->tail.load(std::memory_order_relaxed);
-  uint64_t deadline = now_ms() + static_cast<uint64_t>(timeout_ms);
-  int spin = 0;
-  while (h->hdr->head.load(std::memory_order_acquire) - tail <
-         4 + static_cast<uint64_t>(len)) {
-    if (timeout_ms >= 0 && now_ms() > deadline) return -1;
-    backoff(++spin);
-  }
-  ring_read_bytes(h, tail + 4, static_cast<uint8_t*>(buf),
-                  static_cast<uint64_t>(len));
-  h->hdr->tail.store(tail + 4 + static_cast<uint64_t>(len),
-                     std::memory_order_release);
-  return len;
+  uint64_t len = 0;
+  const void* p = shmring_read_ptr(handle, timeout_ms, &len);
+  if (p == nullptr) return -1;
+  if (len > buflen) return -3;
+  memcpy(buf, p, len);
+  shmring_advance(handle, len);
+  return static_cast<int64_t>(len);
+}
+
+// Next message length without consuming, or -1 on timeout.
+int64_t shmring_peek_len(void* handle, int timeout_ms) {
+  uint64_t len = 0;
+  const void* p = shmring_read_ptr(handle, timeout_ms, &len);
+  if (p == nullptr) return -1;
+  return static_cast<int64_t>(len);
 }
 
 // Unconsumed bytes currently in the ring (0 == drained).

@@ -7,12 +7,16 @@ observe end-of-feed.
 
 TPU-native differences:
 
-- Queue items are *chunks* (lists of records) assembled feeder-side, not
-  single records — see manager.py. ``next_batch`` re-slices chunks to the
-  requested batch size, buffering remainders, so user-visible semantics are
-  unchanged (batches never straddle an ``EndPartition``).
-- With ``input_mapping``, ``next_batch`` returns columns stacked as numpy
-  arrays (ready for ``jax.device_put``), not python lists.
+- Queue items are *chunks* assembled feeder-side, not single records —
+  preferably :class:`~tensorflowonspark_tpu.frames.ColumnarChunk` (records
+  stacked into contiguous per-column arrays; see frames.py), with plain
+  record lists as the fallback for ragged/object records.
+  ``next_batch`` re-slices chunks to the requested batch size — column
+  slices are views, so re-slicing moves no data — and batches never
+  straddle an ``EndPartition``.
+- With ``input_mapping``, ``next_batch`` returns columns as numpy arrays
+  (ready for ``jax.device_put``), not python lists. When the feeder sent
+  columnar chunks, the arrays pass through with zero per-record work.
 - ``numpy_batches()`` is an infinite-batch generator suitable for wrapping
   in a prefetching infeed (see infeed.py) — the analog of the reference's
   ``tf.data.Dataset.from_generator(DataFeed...)`` idiom.
@@ -23,9 +27,26 @@ import time
 
 import numpy as np
 
+from tensorflowonspark_tpu.frames import ColumnarChunk, concat
 from tensorflowonspark_tpu.marker import EndFeed, EndPartition, Marker
 
 logger = logging.getLogger(__name__)
+
+
+def _seg_len(seg):
+    return len(seg)
+
+
+def _seg_slice(seg, start, stop):
+    if isinstance(seg, ColumnarChunk):
+        return seg.slice(start, stop)
+    return seg[start:stop]
+
+
+def _seg_rows(seg):
+    if isinstance(seg, ColumnarChunk):
+        return seg.records()
+    return list(seg)
 
 
 class DataFeed(object):
@@ -33,8 +54,8 @@ class DataFeed(object):
 
     Args mirror the reference: ``mgr`` (a ``ManagerClient``), ``train_mode``
     (True = no output queue), ``qname_in``/``qname_out``, ``input_mapping``
-    (ordered {record_field -> name}; when set, batches are dicts of stacked
-    numpy arrays keyed by the mapped names).
+    (ordered {record_field -> name}; when set, batches are dicts of numpy
+    arrays keyed by the mapped names).
     """
 
     def __init__(self, mgr, train_mode=True, qname_in="input", qname_out="output",
@@ -47,9 +68,10 @@ class DataFeed(object):
         self.input_tensors = list(input_mapping.values()) if input_mapping else None
         self.done_feeding = False
         # Fast path: when the node created a native shm ring for the feed
-        # (TFOS_FEED_TRANSPORT=shm), chunks arrive there — one mmap copy
-        # instead of a manager-proxy TCP round trip per chunk. The queue
-        # stays the control/results channel.
+        # (the default for a local broker — see node.py), chunks arrive
+        # there: a gather-memcpy into the mapping instead of a manager-proxy
+        # TCP round trip per chunk. The queue stays the control/results
+        # channel.
         self._ring = None
         ring_name = None
         try:
@@ -61,7 +83,7 @@ class DataFeed(object):
             self._ring = shm.ShmRing.open(ring_name)
         self._queue_in = None if self._ring else mgr.get_queue(qname_in)
         self._queue_out = None if train_mode else mgr.get_queue(qname_out)
-        self._pending = []  # remainder of a partially-consumed chunk
+        self._pending = []  # segments: ColumnarChunk | list of records
         # feed-plane visibility the reference lacked (SURVEY.md §5
         # tracing): how long the consumer sat blocked on the queue.
         self._stats = {"records": 0, "chunks": 0, "wait_s": 0.0}
@@ -77,12 +99,21 @@ class DataFeed(object):
         ``task_done`` accounting per queue item so the feeder's
         ``queue.join()`` unblocks once the partition is consumed.
         """
-        batch = []
-        while len(batch) < batch_size:
-            take = batch_size - len(batch)
+        segs = []
+        count = 0
+        while count < batch_size:
+            take = batch_size - count
             if self._pending:
-                batch.extend(self._pending[:take])
-                self._pending = self._pending[take:]
+                seg = self._pending[0]
+                n = _seg_len(seg)
+                if n <= take:
+                    segs.append(seg)
+                    count += n
+                    self._pending.pop(0)
+                else:
+                    segs.append(_seg_slice(seg, 0, take))
+                    self._pending[0] = _seg_slice(seg, take, n)
+                    count += take
                 continue
             if self.done_feeding:
                 break
@@ -93,35 +124,73 @@ class DataFeed(object):
                 self._item_done()
                 if isinstance(item, EndFeed):
                     self.done_feeding = True
-                if isinstance(item, (EndPartition, EndFeed)) and batch:
+                if isinstance(item, (EndPartition, EndFeed)) and count:
                     break
                 if isinstance(item, EndFeed):
                     break
                 continue  # EndPartition with empty batch: keep reading
-            chunk = item if isinstance(item, list) else [item]
-            self._pending.extend(chunk)
-            self._stats["records"] += len(chunk)
+            if isinstance(item, ColumnarChunk):
+                seg = item
+            else:
+                seg = item if isinstance(item, list) else [item]
+            self._pending.append(seg)
+            self._stats["records"] += _seg_len(seg)
             self._stats["chunks"] += 1
             self._item_done()
+        return self._combine(segs)
+
+    def _combine(self, segs):
+        """Assemble consumed segments into the user-facing batch shape."""
         if self.input_tensors is None:
-            return batch
-        return self._stack_columns(batch)
+            rows = []
+            for seg in segs:
+                rows.extend(_seg_rows(seg))
+            return rows
+        cols_only = segs and all(
+            isinstance(s, ColumnarChunk) for s in segs)
+        if cols_only:
+            ch = concat(segs)
+            if ch.names is not None:
+                fields = list(self.input_mapping.keys())
+                cols = [ch.cols[ch.names.index(f)] for f in fields]
+            else:
+                cols = ch.cols
+            return {name: col
+                    for name, col in zip(self.input_tensors, cols)}
+        rows = []
+        for seg in segs:
+            rows.extend(_seg_rows(seg))
+        return self._stack_columns(rows)
 
     def _next_item(self):
-        """Blocking read of the next feed item (chunk list or Marker)."""
-        if self._ring is not None:
-            while True:
+        """Blocking read of the next feed item (chunk or Marker).
+
+        Bounded waits with state checks between them: a consumer blocked on
+        a feed whose producer side died (state flipped to 'error'/'stopped'
+        by the watchdog or driver) must raise, not hang forever.
+        """
+        import queue as _queue
+        while True:
+            if self._ring is not None:
                 obj = self._ring.read_obj(timeout=5.0)
                 if obj is not None:
                     return obj
-        return self._queue_in.get(block=True)
+            else:
+                try:
+                    return self._queue_in.get(block=True, timeout=5.0)
+                except _queue.Empty:
+                    pass
+            state = self.mgr.get("state")
+            if state in ("error", "stopped"):
+                raise RuntimeError(
+                    "feed aborted: node state is {!r}".format(state))
 
     def _item_done(self):
         if self._queue_in is not None:
             self._queue_in.task_done()
 
     def _stack_columns(self, batch):
-        """Stack records column-wise into {mapped_name: np.ndarray}."""
+        """Stack row records column-wise into {mapped_name: np.ndarray}."""
         cols = {name: [] for name in self.input_tensors}
         fields = list(self.input_mapping.keys())
         for rec in batch:

@@ -1,0 +1,146 @@
+"""Columnar feed frames: the wire format of the data plane.
+
+The reference ships feed data as pickled lists of per-record tuples
+through a multiprocessing manager proxy (``TFSparkNode._train`` →
+``TFManager`` queues; SURVEY.md §3.2 hot path) — every byte is pickled,
+TCP-framed, and unpickled per hop, and the consumer re-stacks records one
+by one. On a host whose CPU budget is shared with the accelerator runtime
+(the common TPU-VM shape), those copies ARE the feed ceiling.
+
+Here the feeder stacks records into contiguous per-column arrays once,
+and the frame codec moves them as raw bytes:
+
+- :class:`ColumnarChunk` — a batch of N records as column arrays
+  (positional or named), sliceable without touching the data.
+- :func:`encode` — object → list of buffers ``[u32 hdrlen][hdr pickle]
+  [col bytes]...``; column payloads are raw array memory, never pickled.
+  Arbitrary objects (markers, legacy record lists) embed in the header.
+- :func:`decode` — memoryview → object; column arrays come back as
+  ZERO-COPY views into the source buffer (callers that outlive the
+  buffer must ``.materialize()``).
+
+Used by the shm ring transport (shm.py) where the buffers land in the
+mmap with a single gather-memcpy; the manager-queue transport pickles
+:class:`ColumnarChunk` whole (protocol 5 moves the column arrays as
+single out-of-band buffers, so even that path stacks exactly once).
+"""
+
+import pickle
+import struct
+
+import numpy as np
+
+_LEN = struct.Struct("<I")
+
+
+class ColumnarChunk(object):
+    """N records stacked column-wise.
+
+    ``cols``: list of arrays, each with leading dim N (record index).
+    ``names``: optional tuple of field names (dict-shaped records);
+    positional (tuple-shaped records) when None.
+    """
+
+    __slots__ = ("cols", "names", "scalar")
+
+    def __init__(self, cols, names=None, scalar=False):
+        self.cols = list(cols)
+        self.names = tuple(names) if names is not None else None
+        self.scalar = scalar  # records were bare values, not tuples/dicts
+
+    def __len__(self):
+        return 0 if not self.cols else int(self.cols[0].shape[0])
+
+    def slice(self, start, stop):
+        """View of records [start:stop) — no data movement."""
+        return ColumnarChunk([c[start:stop] for c in self.cols], self.names,
+                             self.scalar)
+
+    def materialize(self):
+        """Own the memory (copy out of any transient buffer)."""
+        self.cols = [np.ascontiguousarray(c) for c in self.cols]
+        return self
+
+    def record(self, i):
+        """Record ``i`` in the original row shape (value, tuple, or dict)."""
+        if self.scalar:
+            return self.cols[0][i]
+        vals = [c[i] for c in self.cols]
+        if self.names is None:
+            return tuple(vals)
+        return dict(zip(self.names, vals))
+
+    def records(self):
+        """Back to row-major records (compat path, copies)."""
+        return [self.record(i) for i in range(len(self))]
+
+    @classmethod
+    def from_records(cls, records, names=None):
+        """Stack row records (bare values, tuples, or dicts) into columns.
+
+        Raises TypeError/ValueError for ragged or non-array-able records —
+        callers fall back to the object frame.
+        """
+        if not records:
+            return cls([], names)
+        first = records[0]
+        if isinstance(first, dict):
+            names = tuple(first.keys()) if names is None else tuple(names)
+            cols = [np.stack([np.asarray(r[k]) for r in records])
+                    for k in names]
+            return cls(cols, names)
+        if isinstance(first, (tuple, list)):
+            width = len(first)
+            cols = [np.stack([np.asarray(r[i]) for r in records])
+                    for i in range(width)]
+            return cls(cols, None)
+        return cls([np.stack([np.asarray(r) for r in records])], None,
+                   scalar=True)
+
+
+def concat(chunks):
+    """Concatenate ColumnarChunks (one copy; used for batch re-slicing)."""
+    chunks = [c for c in chunks if len(c)]
+    if len(chunks) == 1:
+        return chunks[0]
+    names = chunks[0].names
+    width = len(chunks[0].cols)
+    cols = [np.concatenate([c.cols[i] for c in chunks]) for i in range(width)]
+    return ColumnarChunk(cols, names)
+
+
+def encode(obj):
+    """object → list of byte-like buffers forming one frame."""
+    if isinstance(obj, ColumnarChunk):
+        cols = [np.ascontiguousarray(c) for c in obj.cols]
+        hdr = pickle.dumps({
+            "k": "cols",
+            "names": obj.names,
+            "scalar": obj.scalar,
+            "meta": [(c.dtype.str, c.shape) for c in cols],
+        }, protocol=5)
+        return [_LEN.pack(len(hdr)), hdr] + [memoryview(c).cast("B")
+                                             for c in cols]
+    hdr = pickle.dumps({"k": "obj", "obj": obj}, protocol=5)
+    return [_LEN.pack(len(hdr)), hdr]
+
+
+def decode(view):
+    """One frame (memoryview/bytes) → object.
+
+    ColumnarChunk columns are zero-copy views into ``view``.
+    """
+    view = memoryview(view)
+    (hdrlen,) = _LEN.unpack_from(view, 0)
+    hdr = pickle.loads(view[4:4 + hdrlen])
+    if hdr["k"] == "obj":
+        return hdr["obj"]
+    off = 4 + hdrlen
+    cols = []
+    for dtype_str, shape in hdr["meta"]:
+        dt = np.dtype(dtype_str)
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        arr = np.frombuffer(view, dtype=dt, count=n, offset=off)
+        cols.append(arr.reshape(shape))
+        off += n * dt.itemsize
+    return ColumnarChunk(cols, hdr["names"], hdr.get("scalar", False))

@@ -17,6 +17,7 @@ reproduced. The manager proxy then costs one round trip per *chunk*, and
 """
 
 import logging
+import os
 import queue as _queue
 import threading
 from multiprocessing.managers import BaseManager
@@ -84,7 +85,7 @@ class ManagerClient(object):
     *operation*, not per lookup.
     """
 
-    def __init__(self, mgr, address, authkey):
+    def __init__(self, mgr, address, authkey, local=None):
         self._mgr = mgr
         self.address = tuple(address)
         self.authkey = authkey
@@ -92,8 +93,24 @@ class ManagerClient(object):
         self._control = None
         self._qcache = {}
         self._lock = threading.Lock()
+        # In-process fast path: when the broker server runs in THIS process
+        # (manager.start), ``local`` carries the real (qdict, kv, control)
+        # objects and every operation is a direct call — no proxy pickling,
+        # no TCP round trip. The reference pays a manager-proxy hop even
+        # for same-process access (TFManager 'local' mode); on a feed plane
+        # moving tens of MB per chunk that hop is measurable, so it's gone.
+        # The fork-safety note: a forked child inherits a COPY of these
+        # objects, so children must never reuse an inherited client —
+        # node.py's trainer always reconnects via (address, authkey).
+        self._local_pid = os.getpid() if local else None
+        self._local = local
+
+    def _use_local(self):
+        return self._local is not None and os.getpid() == self._local_pid
 
     def get_queue(self, qname):
+        if self._use_local():
+            return self._local[0][qname]
         with self._lock:
             if qname not in self._qcache:
                 self._qcache[qname] = self._mgr.get_queue(qname)
@@ -106,13 +123,19 @@ class ManagerClient(object):
             return self._kv
 
     def get(self, key):
+        if self._use_local():
+            return self._local[1].get(key)
         return self._kv_proxy().get(key)
 
     def set(self, key, value):
+        if self._use_local():
+            return self._local[1].set(key, value)
         return self._kv_proxy().set(key, value)
 
     def join_queue(self, qname, timeout):
         """Bounded-wait queue join; True if fully consumed (see _Control)."""
+        if self._use_local():
+            return self._local[2].join(qname, timeout)
         with self._lock:
             if self._control is None:
                 self._control = self._mgr.get_control()
@@ -167,17 +190,20 @@ def start(authkey, queues, mode="local", host=None, maxsize=QUEUE_MAXSIZE):
     threading.Thread(target=server.serve_forever, name="tfmanager-server",
                      daemon=True).start()
     # get_server() binds immediately, so server.address is final here.
-    client = connect(server.address, authkey)
+    client = connect(server.address, authkey,
+                     local=(qdict, kv, control))
     logger.info("queue broker listening at %s (mode=%s)", server.address, mode)
     return client
 
 
-def connect(address, authkey):
+def connect(address, authkey, local=None):
     """Connect to a broker from a sibling process.
 
     Reference: ``TFManager.connect(addr, authkey)``. Callers in freshly
     spawned processes must first set
     ``multiprocessing.current_process().authkey`` (the node runtime does).
+    ``local`` is manager.start's same-process fast path — see
+    :class:`ManagerClient`.
     """
 
     class _Client(_ManagerBase):
@@ -188,4 +214,4 @@ def connect(address, authkey):
     _Client.register("get_control")
     mgr = _Client(address=tuple(address), authkey=authkey)
     mgr.connect()
-    return ManagerClient(mgr, address, authkey)
+    return ManagerClient(mgr, address, authkey, local=local)

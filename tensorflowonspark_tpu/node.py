@@ -33,6 +33,7 @@ import os
 import queue as _queue
 import subprocess
 import sys
+import threading
 import time
 
 from tensorflowonspark_tpu import manager, marker, reservation, util
@@ -254,21 +255,32 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                             mode=cluster_meta.get("manager_mode", "local"),
                             host=host)
 
-        # 1b. optional native shm ring for the feed fast path
+        # 1b. native shm ring: the default feed transport when the broker
+        # is local (feeder and trainer share this host — always true for
+        # the fork/spawn trainer below). TFOS_FEED_TRANSPORT=queue opts
+        # out; remote-mode brokers stay on queues (the ring is host-local).
         ring = None
-        if os.environ.get("TFOS_FEED_TRANSPORT") == "shm":
+        transport = os.environ.get("TFOS_FEED_TRANSPORT")
+        if transport is None:
+            transport = ("shm" if cluster_meta.get("manager_mode", "local")
+                         == "local" else "queue")
+        if transport == "shm":
             from tensorflowonspark_tpu import shm
             if shm.available():
                 ring_name = "/tfos-{}-{}".format(
                     cluster_meta["id"][-10:], executor_id)
                 shm._load().shmring_unlink(ring_name.encode())  # clear stale
-                ring = shm.ShmRing.create(ring_name)
-                mgr.set("shm_name", ring_name)
-                import atexit
-                atexit.register(_cleanup_ring, ring_name)
-                logger.info("feed fast path: shm ring %s", ring_name)
+                try:
+                    ring = shm.ShmRing.create(ring_name)
+                except OSError as e:
+                    logger.warning("shm ring disabled (%s); using queues", e)
+                if ring is not None:
+                    mgr.set("shm_name", ring_name)
+                    import atexit
+                    atexit.register(_cleanup_ring, ring_name)
+                    logger.info("feed fast path: shm ring %s", ring_name)
             else:
-                logger.warning("TFOS_FEED_TRANSPORT=shm requested but the "
+                logger.warning("shm feed transport requested but the "
                                "native ring is unavailable; using queues")
 
         # 2. reserve the port this node serves on (chief's doubles as the
@@ -354,7 +366,6 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                     except Exception:
                         pass
 
-            import threading
             threading.Thread(target=_watch, name="trainer-watchdog",
                              daemon=True).start()
         else:
@@ -500,6 +511,39 @@ def _feed_ring(qname):
     return None
 
 
+def _pack_chunk(records):
+    """Stack a chunk of records into a ColumnarChunk when possible.
+
+    Columnar chunks move as raw contiguous bytes (frames.py) and the
+    consumer re-slices them without per-record work — the feed plane's
+    main copy-count lever (SURVEY.md §7.3). Records that don't stack
+    (ragged shapes, object/string payloads) fall back to the plain list
+    chunk with identical semantics.
+    """
+    import numpy as np
+
+    from tensorflowonspark_tpu import frames as frames_lib
+
+    # Only records whose fields are real ndarrays get columnarized: python
+    # scalars / strings / objects must round-trip with their exact types,
+    # and only bulk array payloads benefit from raw-byte framing anyway.
+    first = records[0]
+    if isinstance(first, dict):
+        leaves = list(first.values())
+    elif isinstance(first, (tuple, list)):
+        leaves = list(first)
+    else:
+        leaves = [first]
+    if not leaves or not all(
+            isinstance(v, np.ndarray) and v.dtype.kind in "biufc"
+            for v in leaves):
+        return list(records)
+    try:
+        return frames_lib.ColumnarChunk.from_records(records)
+    except Exception:  # noqa: BLE001 - ragged shapes etc → legacy path
+        return list(records)
+
+
 def _feed_partition(iterator, mgr, qname, feed_timeout):
     """Push one partition into ``qname`` as chunks + EndPartition; returns
     the record count. Shared by the train and inference feed closures.
@@ -520,31 +564,53 @@ def _feed_partition(iterator, mgr, qname, feed_timeout):
     for item in iterator:
         chunk.append(item)
         if len(chunk) >= FEED_CHUNK:
-            put(list(chunk), deadline)
+            put(_pack_chunk(chunk), deadline)
             count += len(chunk)
             chunk = []
             deadline = time.monotonic() + feed_timeout
     if chunk:
-        put(list(chunk), deadline)
+        put(_pack_chunk(chunk), deadline)
         count += len(chunk)
     put(marker.EndPartition(), deadline)
     return count
 
 
-def _ring_put(ring, obj, mgr, deadline):
-    """shm-ring analog of _bounded_put: bounded writes + state checks."""
-    import pickle
+#: serializes same-process ring writers: the ring is SPSC, and an engine
+#: that ever runs two feed tasks concurrently in one executor process
+#: must not interleave gather-writes (the queue transport was implicitly
+#: thread-safe; this keeps the ring equally safe).
+_RING_WRITE_LOCK = threading.Lock()
 
-    data = pickle.dumps(obj, protocol=5)
+
+def _ring_put(ring, obj, mgr, deadline):
+    """shm-ring analog of _bounded_put: bounded writes + state checks.
+
+    Frame-encodes once; retries move no bytes until space frees. A frame
+    too large for the ring (> capacity/2) is split record-wise and
+    re-sent — semantics are unchanged since DataFeed re-slices chunks
+    anyway."""
+    from tensorflowonspark_tpu import frames as frames_lib
+
+    bufs = frames_lib.encode(obj)
     while True:
         try:
-            ring.write(data, timeout=1.0)
+            with _RING_WRITE_LOCK:
+                ring.write_buffers(bufs, timeout=1.0)
             return
         except TimeoutError:
             if mgr.get("state") in ("terminating", "stopped", "error"):
                 raise RuntimeError("feed aborted: node is terminating")
             if time.monotonic() > deadline:
                 raise RuntimeError("feed timeout exceeded")
+        except ValueError:
+            if isinstance(obj, frames_lib.ColumnarChunk) and len(obj) > 1:
+                half = len(obj) // 2
+                _ring_put(ring, obj.slice(0, half), mgr, deadline)
+                _ring_put(ring, obj.slice(half, len(obj)), mgr, deadline)
+                return
+            raise RuntimeError(
+                "feed record does not fit the shm ring; raise "
+                "TFOS_SHM_CAPACITY or lower FEED_CHUNK")
 
 
 def _join_feed(mgr, qname, feed_timeout, on_error="return"):

@@ -1,12 +1,17 @@
 """Python binding for the native shared-memory feed ring (native/shm_ring.cpp).
 
 The fast path of the feed plane: the manager queue (manager.py) remains
-the control channel, while bulk record chunks can ride this SPSC ring —
-one mmap'd copy instead of a pickled TCP round trip through a manager
-proxy thread per chunk. Enabled per cluster with
-``TFOS_FEED_TRANSPORT=shm`` (see node.py); the queue path stays the
-default and the semantics (EndPartition/EndFeed markers, join-on-consume,
-state aborts) are identical.
+the control channel, while bulk record chunks ride this SPSC ring — a
+gather-memcpy into one mmap'd region instead of pickled TCP round trips
+through a manager proxy per chunk. The v2 ring blocks on futexes (no
+polling — critical on single-core hosts where a spinning consumer starves
+the producer) and keeps messages contiguous, so the consumer can decode
+columnar frames (frames.py) as zero-copy views into the mapping.
+
+Enabled per cluster with ``TFOS_FEED_TRANSPORT=shm`` (the default when the
+broker is local and the ring builds — see node.py); semantics
+(EndPartition/EndFeed markers, drain-on-consume, state aborts) are
+identical to the queue path.
 
 The .so builds on first use with the toolchain baked into the image
 (g++); the build is cached next to this file. Everything degrades
@@ -28,6 +33,11 @@ _SO = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                    "_libshmring.so")
 _lib = None
 _lib_lock = threading.Lock()
+
+_from_memory = ctypes.pythonapi.PyMemoryView_FromMemory
+_from_memory.restype = ctypes.py_object
+_from_memory.argtypes = (ctypes.c_void_p, ctypes.c_ssize_t, ctypes.c_int)
+_PyBUF_READ = 0x100
 
 
 def _build():
@@ -54,6 +64,14 @@ def _load():
         lib.shmring_write.restype = ctypes.c_int
         lib.shmring_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                       ctypes.c_uint64, ctypes.c_int]
+        lib.shmring_write_gather.restype = ctypes.c_int
+        lib.shmring_write_gather.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int, ctypes.c_int]
+        lib.shmring_read_ptr.restype = ctypes.c_void_p
+        lib.shmring_read_ptr.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                         ctypes.POINTER(ctypes.c_uint64)]
+        lib.shmring_advance.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.shmring_peek_len.restype = ctypes.c_int64
         lib.shmring_peek_len.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.shmring_read.restype = ctypes.c_int64
@@ -77,6 +95,33 @@ def available():
         return False
 
 
+#: below this ring size the transport is not worth it (one 256-image
+#: uint8 224px frame is ~38MB and messages are capped at capacity/2)
+MIN_USEFUL_CAPACITY = 64 * 1024 * 1024
+
+
+def default_capacity():
+    """Ring data-region size: enough runway for a few full device batches
+    (a 256-image uint8 224px frame is ~38MB), env-tunable and bounded by
+    half of /dev/shm's free space so a ring never fights the host for it.
+
+    Returns 0 when /dev/shm can't fit a useful ring — callers must fall
+    back to the queue transport (tmpfs pages materialize lazily, so an
+    oversized ring would SIGBUS the producer mid-feed, not fail create).
+    """
+    env = os.environ.get("TFOS_SHM_CAPACITY")
+    if env:
+        return int(env)
+    want = 256 * 1024 * 1024
+    try:
+        st = os.statvfs("/dev/shm")
+        free = st.f_bavail * st.f_frsize
+        want = min(want, free // 2)
+    except OSError:
+        pass
+    return want if want >= MIN_USEFUL_CAPACITY else 0
+
+
 class ShmRing(object):
     """One SPSC byte-message ring. create() on the producer-side host
     process; open() from the consumer. Not thread-safe per side."""
@@ -89,8 +134,13 @@ class ShmRing(object):
         self._owner = owner
 
     @classmethod
-    def create(cls, name, capacity=DEFAULT_CAPACITY):
+    def create(cls, name, capacity=None):
         lib = _load()
+        capacity = capacity or default_capacity()
+        if not capacity:
+            raise OSError("/dev/shm too small for a useful ring "
+                          "(need {}MB free)".format(
+                              2 * MIN_USEFUL_CAPACITY // 2 ** 20))
         handle = lib.shmring_create(name.encode(), capacity)
         if not handle:
             raise OSError("shmring_create failed for {!r}".format(name))
@@ -104,6 +154,8 @@ class ShmRing(object):
             raise OSError("shmring_open failed for {!r}".format(name))
         return cls(handle, name, owner=False)
 
+    # -- raw message API ---------------------------------------------------
+
     def write(self, data, timeout=None):
         """Write one message; raises TimeoutError/ValueError."""
         rc = _load().shmring_write(
@@ -114,29 +166,102 @@ class ShmRing(object):
         if rc == -2:
             raise ValueError("message larger than ring capacity")
 
+    def write_buffers(self, buffers, timeout=None):
+        """One message gathered from several byte-like buffers (no
+        caller-side concat; raw array memory goes straight to the mmap)."""
+        import numpy as np
+
+        n = len(buffers)
+        ptrs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_uint64 * n)()
+        holds = []  # keep buffer owners alive across the call
+        for i, b in enumerate(buffers):
+            if isinstance(b, bytes):
+                ptrs[i] = ctypes.cast(b, ctypes.c_void_p)
+                lens[i] = len(b)
+                holds.append(b)
+                continue
+            # numpy arrays and contiguous byte-likes: zero-copy address
+            a = b if isinstance(b, np.ndarray) else \
+                np.frombuffer(b, dtype=np.uint8)
+            a = np.ascontiguousarray(a)
+            ptrs[i] = a.ctypes.data
+            lens[i] = a.nbytes
+            holds.append(a)
+        rc = _load().shmring_write_gather(
+            self._h, ptrs, lens, n,
+            -1 if timeout is None else int(timeout * 1000))
+        del holds
+        if rc == -1:
+            raise TimeoutError("shm ring full")
+        if rc == -2:
+            raise ValueError("message larger than ring capacity")
+
     def read(self, timeout=None):
         """Read one message; returns bytes or None on timeout."""
         lib = _load()
         t = -1 if timeout is None else int(timeout * 1000)
-        n = lib.shmring_peek_len(self._h, t)
-        if n < 0:
+        out_len = ctypes.c_uint64()
+        ptr = lib.shmring_read_ptr(self._h, t, ctypes.byref(out_len))
+        if not ptr:
             return None
-        buf = ctypes.create_string_buffer(int(n))
-        got = lib.shmring_read(self._h, buf, int(n), t)
-        if got < 0:
-            return None
-        return buf.raw[:got]
+        data = ctypes.string_at(ptr, out_len.value)
+        lib.shmring_advance(self._h, out_len.value)
+        return data
+
+    def read_view(self, timeout=None):
+        """(memoryview, release) of the next message, zero copy.
+
+        The view addresses the ring mapping directly; call ``release()``
+        exactly once when done to free the slot (until then the producer
+        can't reclaim the space).
+        """
+        lib = _load()
+        t = -1 if timeout is None else int(timeout * 1000)
+        out_len = ctypes.c_uint64()
+        ptr = lib.shmring_read_ptr(self._h, t, ctypes.byref(out_len))
+        if not ptr:
+            return None, None
+        view = _from_memory(ptr, out_len.value, _PyBUF_READ)
+        n = out_len.value
+
+        def release(_lib=lib, _h=self._h, _n=n):
+            _lib.shmring_advance(_h, _n)
+
+        return view, release
 
     def pending(self):
         """Unconsumed bytes (0 == fully drained)."""
         return int(_load().shmring_pending(self._h))
 
+    # -- object / frame API ------------------------------------------------
+
     def write_obj(self, obj, timeout=None):
-        self.write(pickle.dumps(obj, protocol=5), timeout)
+        """Frame-encode ``obj`` (frames.py) and write it.
+
+        ColumnarChunks move as raw column bytes; other objects pickle into
+        the frame header.
+        """
+        from tensorflowonspark_tpu import frames
+        self.write_buffers(frames.encode(obj), timeout)
 
     def read_obj(self, timeout=None):
-        data = self.read(timeout)
-        return None if data is None else pickle.loads(data)
+        """Read one frame → object; None on timeout.
+
+        ColumnarChunk columns are copied out of the ring (one memcpy) so
+        the slot frees immediately and the result owns its memory.
+        """
+        from tensorflowonspark_tpu import frames
+        view, release = self.read_view(timeout)
+        if view is None:
+            return None
+        try:
+            obj = frames.decode(view)
+            if isinstance(obj, frames.ColumnarChunk):
+                obj.materialize()
+            return obj
+        finally:
+            release()
 
     def close(self):
         if self._h:

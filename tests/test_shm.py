@@ -30,16 +30,18 @@ def test_ring_roundtrip_and_wraparound():
 
 
 def test_ring_backpressure_and_timeout():
-    ring = shm.ShmRing.create("/tfos-test-bp", capacity=1 << 12)
+    ring = shm.ShmRing.create("/tfos-test-bp", capacity=1 << 13)
     try:
-        ring.write(b"x" * 3000, timeout=1.0)
+        ring.write(b"x" * 4000, timeout=1.0)
+        ring.write(b"y" * 4000, timeout=1.0)
         with pytest.raises(TimeoutError):
-            ring.write(b"y" * 3000, timeout=0.2)  # full: must time out
+            ring.write(b"z" * 4000, timeout=0.2)  # full: must time out
         with pytest.raises(ValueError):
-            ring.write(b"z" * 5000)  # bigger than the ring
-        assert ring.read(timeout=1.0) == b"x" * 3000
-        ring.write(b"y" * 3000, timeout=1.0)  # now fits
-        assert ring.read(timeout=1.0) == b"y" * 3000
+            ring.write(b"z" * 5000)  # over capacity/2: never accepted
+        assert ring.read(timeout=1.0) == b"x" * 4000
+        ring.write(b"z" * 4000, timeout=1.0)  # now fits
+        assert ring.read(timeout=1.0) == b"y" * 4000
+        assert ring.read(timeout=1.0) == b"z" * 4000
         assert ring.read(timeout=0.1) is None  # empty: timeout -> None
     finally:
         ring.unlink()
@@ -110,13 +112,20 @@ def test_cluster_shm_feed_roundtrip(tmp_path):
 def test_ring_faster_than_queue_for_bulk():
     """The native ring must beat a manager-proxy queue on bulk chunks
     (the whole point of the fast path); generous 1.5x margin to avoid
-    flakiness on a loaded 1-core box."""
+    flakiness on a loaded 1-core box.
+
+    The queue side goes through a *proxy* client (manager.connect), not
+    manager.start's in-process fast path — the proxied TCP round trip is
+    what the ring replaces (a trainer reading its feed from the broker in
+    the bootstrap process)."""
     from tensorflowonspark_tpu import manager
 
     payload = [b"x" * 1024] * 256  # one chunk of 256 KB-ish records
     n = 50
 
-    mgr = manager.start(b"benchkey", ["input"], maxsize=8)
+    server = manager.start(b"benchkey", ["input"], maxsize=8)
+    mgr = manager.connect(server.address, b"benchkey")
+    assert not mgr._use_local()
     q = mgr.get_queue("input")
     t0 = time.monotonic()
     for _ in range(n):

@@ -1,14 +1,24 @@
-"""Fused flash attention (Pallas TPU kernel) with XLA fallback.
+"""Fused flash attention (Pallas TPU kernels) with XLA fallback.
 
 Blocked online-softmax attention: Q tiles stream through VMEM while the
 kernel loops over KV tiles, keeping the [S, S] score matrix out of HBM
 entirely — the standard flash recurrence, laid out for the MXU (128-wide
 tiles, bf16 matmuls with f32 accumulators/stats).
 
-``flash_attention`` is differentiable via custom_vjp: the backward pass
-recomputes attention in XLA from the saved inputs (rematerialization —
-trades FLOPs for memory exactly like ``jax.checkpoint`` would; a fused
-backward kernel is a later optimization).
+Both directions are fused:
+
+- forward: online-softmax kernel, also emitting the per-row logsumexp
+  (LSE) needed by the backward.
+- backward: two kernels in the FlashAttention-2 factorization —
+  ``dq`` (grid over Q tiles, loops KV) and ``dk/dv`` (grid over KV
+  tiles, loops Q) — recomputing P tiles from the saved LSE with f32
+  accumulators, so training memory stays O(S) per (batch, head) instead
+  of the O(S²) score matrix the rematerialized-XLA vjp used to build.
+  ``delta = rowsum(dO ⊙ O)`` is precomputed in XLA (one fused
+  elementwise+reduce).
+
+Non-TPU backends take the XLA reference for both directions (and the
+Pallas interpreter validates the kernels on CPU in tests).
 
 Layout: [batch, seq, heads, head_dim], same contract as
 ``parallel.ring_attention`` (whose per-shard block update this kernel can
@@ -31,8 +41,16 @@ def _reference(q, k, v, causal, scale):
     return reference_attention(q, k, v, causal=causal, scale=scale)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k,
-            seq_len):
+def _causal_mask(s, q_offset, k_offset, block_q, block_k):
+    q_pos = q_offset + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = k_offset + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos >= k_pos, s, -jnp.inf)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k, seq_len):
     """One (batch*head, q-block) program: loop KV tiles, online softmax."""
     from jax.experimental import pallas as pl
 
@@ -56,11 +74,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # [BQ, BK]
         if causal:
-            q_pos = q_offset + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kv_i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+            s = _causal_mask(s, q_offset, kv_i * block_k, block_q, block_k)
         m_blk = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, m_blk)
         safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
@@ -77,31 +91,137 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q, block_k,
     # static full loop; causal masking zeroes future tiles (skipping them
     # needs a traced bound — a scheduling optimization for later)
     m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m, l, acc))
-    l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # LSE = m + log(l): the only softmax statistic the backward needs
+    lse_ref[0] = jnp.where(l == 0.0, -jnp.inf, m + jnp.log(l_safe))
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, block_q, block_k, seq_len):
+    """dQ for one (batch*head, q-block): loop KV tiles, recompute P."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
-    b, s, n, d = q.shape
+    q = q_ref[0].astype(jnp.float32) * scale           # [BQ, D]
+    do = do_ref[0].astype(jnp.float32)                 # [BQ, D]
+    lse = lse_ref[0]                                   # [BQ]
+    delta = delta_ref[0]                               # [BQ]
+    lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+    qi = pl.program_id(1)
+    q_offset = qi * block_q
+
+    dq_acc = jnp.zeros_like(q)
+    num_kv = seq_len // block_k
+
+    def body(kv_i, dq_acc):
+        k_blk = k_ref[0, pl.ds(kv_i * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kv_i * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [BQ, BK]
+        if causal:
+            s = _causal_mask(s, q_offset, kv_i * block_k, block_q, block_k)
+        p = jnp.where(jnp.isneginf(s), 0.0,
+                      jnp.exp(s - lse_safe[:, None]))  # [BQ, BK]
+        dp = jax.lax.dot_general(
+            do, v_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [BQ, BK]
+        ds = p * (dp - delta[:, None])
+        return dq_acc + jax.lax.dot_general(
+            ds, k_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [BQ, D]
+
+    dq_acc = jax.lax.fori_loop(0, num_kv, body, dq_acc)
+    dq_ref[0] = (dq_acc * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                seq_len):
+    """dK/dV for one (batch*head, kv-block): loop Q tiles, recompute P."""
+    from jax.experimental import pallas as pl
+
+    k_blk = k_ref[0].astype(jnp.float32)               # [BK, D]
+    v_blk = v_ref[0].astype(jnp.float32)
+    d = k_blk.shape[-1]
+    kv_i = pl.program_id(1)
+    k_offset = kv_i * block_k
+
+    dk_acc = jnp.zeros((block_k, d), jnp.float32)
+    dv_acc = jnp.zeros((block_k, d), jnp.float32)
+    num_q = seq_len // block_q
+
+    def body(qi, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, pl.ds(qi * block_q, block_q), :] \
+            .astype(jnp.float32) * scale               # [BQ, D] (scaled)
+        do_blk = do_ref[0, pl.ds(qi * block_q, block_q), :] \
+            .astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q)]
+        lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+        s = jax.lax.dot_general(
+            q_blk, k_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [BQ, BK]
+        if causal:
+            s = _causal_mask(s, qi * block_q, k_offset, block_q, block_k)
+        p = jnp.where(jnp.isneginf(s), 0.0,
+                      jnp.exp(s - lse_safe[:, None]))
+        dv_new = dv_acc + jax.lax.dot_general(
+            p, do_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [BK, D]
+        dp = jax.lax.dot_general(
+            do_blk, v_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [BQ, BK]
+        ds = p * (dp - delta[:, None])
+        dk_new = dk_acc + jax.lax.dot_general(
+            ds, q_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [BK, D] (has scale)
+        return dk_new, dv_new
+
+    dk_acc, dv_acc = jax.lax.fori_loop(0, num_q, body, (dk_acc, dv_acc))
+    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _fold(x, b, s, n, d):
+    """[B, S, N, D] -> [B*N, S, D]: each program owns one (batch, head)."""
+    return jnp.reshape(jnp.transpose(x, (0, 2, 1, 3)), (b * n, s, d))
+
+
+def _unfold(x, b, s, n, d):
+    return jnp.transpose(jnp.reshape(x, (b, n, s, d)), (0, 2, 1, 3))
+
+
+def _check_blocks(s, block_q, block_k):
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     assert s % block_q == 0 and s % block_k == 0, (
         "seq len {} must be divisible by block sizes ({}, {})"
         .format(s, block_q, block_k))
+    return block_q, block_k
 
-    # [B, S, N, D] -> [B*N, S, D]: each program owns one (batch, head)
-    def fold(x):
-        return jnp.reshape(jnp.transpose(x, (0, 2, 1, 3)), (b * n, s, d))
 
-    qf, kf, vf = fold(q), fold(k), fold(v)
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Returns (out [B,S,N,D], lse [B*N, S])."""
+    from jax.experimental import pallas as pl
+
+    b, s, n, d = q.shape
+    block_q, block_k = _check_blocks(s, block_q, block_k)
+
+    qf, kf, vf = (_fold(x, b, s, n, d) for x in (q, k, v))
     grid = (b * n, s // block_q)
     kernel = functools.partial(
-        _kernel, scale=scale, causal=causal, block_q=block_q,
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, seq_len=s)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -109,30 +229,96 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * n, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b * n, s), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return _unfold(out, b, s, n, d), lse
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+               interpret):
+    """Fused dq/dk/dv. All tensors [B,S,N,D] except lse [B*N,S]."""
+    from jax.experimental import pallas as pl
+
+    b, s, n, d = q.shape
+    block_q, block_k = _check_blocks(s, block_q, block_k)
+
+    qf, kf, vf, of, gf = (_fold(x, b, s, n, d)
+                          for x in (q, k, v, out, g))
+    # delta = rowsum(dO ⊙ O): one fused XLA elementwise+reduce, f32
+    delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1)                            # [B*N, S]
+
+    full = lambda bh, i: (bh, 0, 0)  # noqa: E731
+    full_vec = lambda bh, i: (bh, 0)  # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=s),
+        grid=(b * n, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, s, d), full),
+            pl.BlockSpec((1, s, d), full),
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
+            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * n, s, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf)
-    return jnp.transpose(jnp.reshape(out, (b, n, s, d)), (0, 2, 1, 3))
+    )(qf, kf, vf, gf, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=s),
+        grid=(b * n, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, s, d), full),
+            pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, s, d), full),
+            pl.BlockSpec((1, s), full_vec),
+            pl.BlockSpec((1, s), full_vec),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * n, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b * n, s, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+
+    return (_unfold(dq, b, s, n, d), _unfold(dk, b, s, n, d),
+            _unfold(dv, b, s, n, d))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    # rematerialized backward through the XLA reference (correct + simple;
-    # the flash recurrence's fused backward kernel is a later optimization)
-    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, causal, scale),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q,
+                      block_k, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -143,9 +329,10 @@ def flash_attention(q, k, v, causal=False, scale=None,
                     force_pallas=False, interpret=None):
     """Fused attention. [B, S, N, D] in, [B, S, N, D] out.
 
-    On TPU backends runs the Pallas kernel; elsewhere falls back to the
-    XLA reference (``interpret=True`` forces the kernel through the
-    Pallas interpreter — used by tests to validate kernel logic on CPU).
+    On TPU backends runs the Pallas kernels (both directions); elsewhere
+    falls back to the XLA reference (``interpret=True`` forces the
+    kernels through the Pallas interpreter — used by tests to validate
+    kernel logic on CPU).
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     # allowlist, not denylist: unknown plugin backends must take the XLA

@@ -29,7 +29,12 @@ def test_flash_attention_matches_reference(jax, causal):
                                rtol=2e-4, atol=2e-4)
 
 
-def test_flash_attention_grad(jax):
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grad(jax, causal):
+    """Fused dq/dk/dv kernels (interpret mode) vs XLA reference grads.
+
+    Rectangular blocks (32x16) exercise the BQ != BK tiling in both
+    backward kernels; a non-trivial cotangent exercises delta."""
     from tensorflowonspark_tpu.ops import flash_attention
     from tensorflowonspark_tpu.parallel.ring_attention import (
         reference_attention)
@@ -39,19 +44,52 @@ def test_flash_attention_grad(jax):
     q = rng.randn(B, S, N, D).astype(np.float32)
     k = rng.randn(B, S, N, D).astype(np.float32)
     v = rng.randn(B, S, N, D).astype(np.float32)
+    w = rng.randn(B, S, N, D).astype(np.float32)  # cotangent weights
 
     def loss_flash(q, k, v):
-        return flash_attention(q, k, v, causal=True, block_q=32, block_k=32,
-                               force_pallas=True, interpret=True).sum()
+        return (w * flash_attention(
+            q, k, v, causal=causal, block_q=32, block_k=16,
+            force_pallas=True, interpret=True)).sum()
 
     def loss_ref(q, k, v):
-        return reference_attention(q, k, v, causal=True).sum()
+        return (w * reference_attention(q, k, v, causal=causal)).sum()
 
     g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for gf, gr in zip(g_flash, g_ref):
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
                                    rtol=1e-3, atol=1e-3)
+
+
+def test_flash_attention_grad_bf16(jax):
+    """bf16 inputs: fused backward keeps f32 stats/accumulators."""
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.ops import flash_attention
+    from tensorflowonspark_tpu.parallel.ring_attention import (
+        reference_attention)
+
+    B, S, N, D = 1, 64, 1, 16
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(B, S, N, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, S, N, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, S, N, D), jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=32, block_k=32,
+                               force_pallas=True, interpret=True) \
+            .astype(jnp.float32).sum()
+
+    def loss_ref(q, k, v):
+        return reference_attention(q, k, v, causal=True) \
+            .astype(jnp.float32).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(gf, np.float32), np.asarray(gr, np.float32),
+            rtol=0.1, atol=0.1)
 
 
 def test_flash_attention_cpu_fallback(jax):

@@ -1,0 +1,42 @@
+# Developer/CI gate for the TPU-native framework.
+#
+# `make test` is the merge gate: the full hermetic suite on a virtual
+# 8-device CPU mesh (no TPU needed), per-test timeout so a wedged
+# multi-process test fails instead of hanging CI.
+
+PYTEST_TIMEOUT ?= 300
+PYTHON ?= python
+
+.PHONY: test test-fast bench smoke install lint native clean
+
+install:
+	$(PYTHON) -m pip install -e .
+
+native: tensorflowonspark_tpu/_libshmring.so
+
+tensorflowonspark_tpu/_libshmring.so: native/shm_ring.cpp
+	g++ -O2 -std=c++17 -shared -fPIC -o $@ $< -lrt -pthread
+
+# per-suite wall clock cap via coreutils timeout (pytest-timeout is not a
+# hard dependency); a wedged multi-process test fails CI instead of hanging
+test:
+	timeout $(SUITE_TIMEOUT) $(PYTHON) -m pytest tests/ -q
+
+SUITE_TIMEOUT ?= 900
+
+test-fast:
+	$(PYTHON) -m pytest tests/ -q -x -m "not slow"
+
+# one-line JSON benchmark (real chip when present; CPU smoke elsewhere)
+bench:
+	$(PYTHON) bench.py
+
+# CPU smoke of the full cluster-fed path (~4 min on one core)
+smoke:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= TFOS_TPU_DISTRIBUTED=0 \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) bench.py
+
+clean:
+	rm -f tensorflowonspark_tpu/_libshmring.so
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -273,8 +273,9 @@ def main():
 
     # Fed runs first: the driver has not initialized jax yet, so the
     # trainer subprocesses are the chip's only owners.
+    fed_enabled = os.environ.get("TFOS_BENCH_FED", "1") == "1"
     fed_shm = fed_queue = None
-    if os.environ.get("TFOS_BENCH_FED", "1") == "1":
+    if fed_enabled:
         fed_shm = _cluster_fed_images_per_sec(
             "shm", batch, image, fed_steps, on_tpu)
         fed_queue = _cluster_fed_images_per_sec(
@@ -284,7 +285,6 @@ def main():
 
     best_fed = max((f for f in (fed_shm, fed_queue) if f is not None),
                    default=0.0)
-    fed_enabled = os.environ.get("TFOS_BENCH_FED", "1") == "1"
     if fed_enabled and not best_fed:
         # Both transports broken must NOT masquerade as a healthy fed run.
         print(json.dumps({

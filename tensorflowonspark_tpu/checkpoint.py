@@ -28,7 +28,10 @@ class Checkpointer(object):
     def __init__(self, directory, chief=True, max_to_keep=3):
         import orbax.checkpoint as ocp
 
-        self.directory = os.path.abspath(directory)
+        from tensorflowonspark_tpu import fs
+
+        self.directory = os.path.abspath(
+            fs.require_local(directory, "checkpointing"))
         self.chief = chief
         if chief:
             os.makedirs(self.directory, exist_ok=True)

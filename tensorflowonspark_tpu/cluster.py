@@ -175,7 +175,7 @@ def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
         input_mode=InputMode.SPARK, log_dir=None, driver_ps_nodes=False,
         master_node="chief", reservation_timeout=reservation.DEFAULT_TIMEOUT,
         queues=("input", "output", "error"), eval_node=False,
-        manager_mode="local"):
+        manager_mode="local", filesystems=None):
     """Start a cluster: one node per executor, roles per the template.
 
     Reference: ``TFCluster.run`` (SURVEY.md §3.1). ``num_ps`` is accepted
@@ -186,6 +186,13 @@ def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
     ``ctx.job_name == 'ps'``. ``driver_ps_nodes`` (reference: run ps tasks
     as driver-side threads) raises: silently ignoring it would change
     where a migrated program's ps fns execute.
+
+    ``filesystems``: optional ``{scheme: opener}`` dict registered (via
+    ``fs.register_filesystem``) in every executor AND trainer process —
+    the fs registry is process-local, so driver-side registrations alone
+    never reach workers; this is the supported way to make ``hdfs://``/
+    ``gs://`` paths resolvable cluster-wide. Openers ship by cloudpickle,
+    so module-level functions or closures both work.
     """
     if driver_ps_nodes:
         raise NotImplementedError(
@@ -236,6 +243,8 @@ def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
         # routable IP, for engines whose data tasks may land elsewhere.
         "manager_mode": manager_mode,
         "reservation_timeout": reservation_timeout,
+        # {scheme: opener}; travels inside the cloudpickled node closure
+        "filesystems": dict(filesystems or {}),
     }
 
     # 4. async bootstrap job: one pinned task per executor.

@@ -136,6 +136,9 @@ def saveAsTFRecords(df, output_dir):
     through ``saveAsNewAPIHadoopFile``). Fails if output_dir exists, like
     Hadoop output committers do.
     """
+    from tensorflowonspark_tpu import fs
+
+    output_dir = fs.require_local(output_dir, "saveAsTFRecords")
     os.makedirs(output_dir, exist_ok=False)
     schema = df.schema
     serialized = df.rdd.mapPartitions(toTFExample(schema))

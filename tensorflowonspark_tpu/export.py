@@ -41,6 +41,9 @@ def save_model(export_dir, apply_fn, variables, signature=None):
     import jax
     import orbax.checkpoint as ocp
 
+    from tensorflowonspark_tpu import fs
+
+    export_dir = fs.require_local(export_dir, "model export")
     os.makedirs(export_dir, exist_ok=False)
     # orbax wants fully-materialized host arrays for a portable export
     variables = jax.tree.map(lambda x: jax.device_get(x), variables)
@@ -60,6 +63,9 @@ def load_model(export_dir, cache=True):
 
     Reference: ``pipeline._run_model``'s args-keyed cached SavedModel load.
     """
+    from tensorflowonspark_tpu import fs
+
+    export_dir = fs.require_local(export_dir, "model load")
     key = os.path.abspath(export_dir)
     with _CACHE_LOCK:
         if cache and key in _CACHE:

@@ -1,0 +1,109 @@
+"""Pluggable filesystem registry for remote path schemes.
+
+Reference parity gap, made explicit: the reference leans on TF's
+``tf.io.gfile`` + a Hadoop ``defaultFS`` for ``hdfs://`` model/export
+paths (``TFNode.hdfs_path``, ``TFNodeContext.absolute_path`` —
+SURVEY.md §2 "TFNode" row). This framework bundles no HDFS/GCS client,
+so remote schemes are a *registration point* instead of a silent
+pass-through: callers register ``scheme -> opener`` once (e.g. backed by
+``fsspec``, ``gcsfs``, or a site-local client) and every path consumer
+(``ctx.absolute_path``, TFRecord readers, checkpoint/export helpers)
+resolves through here. Unregistered remote schemes fail loudly with a
+how-to-fix error rather than a confusing downstream ENOENT.
+
+    from tensorflowonspark_tpu import fs
+    fs.register_filesystem("gs", my_gcs_open)      # open(path, mode)
+    with fs.open("gs://bucket/data.tfrecord", "rb") as f: ...
+
+Local paths (``file://`` or bare) use the builtin filesystem and never
+need registration.
+"""
+
+import builtins
+import re
+
+_SCHEME_RE = re.compile(r"^([a-zA-Z][a-zA-Z0-9+.-]*)://")
+
+_REGISTRY = {}
+
+
+class UnsupportedSchemeError(RuntimeError):
+    """A remote path scheme nobody registered an opener for."""
+
+
+def require_local(path, what):
+    """Fail loudly when a directory-level consumer gets a remote path.
+
+    The registry serves per-FILE opens (TFRecord read/write). Consumers
+    that need directory semantics — orbax checkpoints, model export,
+    shard listing — require a local/NFS path: an ``opener`` can't
+    makedirs/listdir, and orbax brings its own remote backends. Without
+    this guard a remote path would be silently written to a local
+    directory literally named ``gs:`` (os.path.abspath of a URL).
+    """
+    if scheme_of(path) is not None:
+        raise UnsupportedSchemeError(
+            "{} requires a local or NFS path, got {!r}: the fs registry "
+            "serves per-file opens only (directory semantics — makedirs/"
+            "listdir/atomic rename — need a real filesystem; for remote "
+            "checkpoints use orbax's own storage backends, for remote "
+            "TFRecords read/write individual files via fs.open)".format(
+                what, path))
+    return local_part(path)
+
+
+def scheme_of(path):
+    """'hdfs' for 'hdfs://x/y', None for local/bare paths."""
+    m = _SCHEME_RE.match(path)
+    if not m:
+        return None
+    s = m.group(1).lower()
+    return None if s == "file" else s
+
+
+def register_filesystem(scheme, opener):
+    """Register ``opener(path, mode) -> file object`` for a scheme.
+
+    Returns the previous opener (None if first registration) so tests
+    and apps can restore.
+    """
+    scheme = scheme.lower().rstrip(":")
+    prev = _REGISTRY.get(scheme)
+    _REGISTRY[scheme] = opener
+    return prev
+
+
+def unregister_filesystem(scheme):
+    _REGISTRY.pop(scheme.lower().rstrip(":"), None)
+
+
+def is_supported(path):
+    """True if :func:`open` can serve this path right now."""
+    s = scheme_of(path)
+    return s is None or s in _REGISTRY
+
+
+def local_part(path):
+    """Strip a file:// prefix; other schemes are returned untouched."""
+    if path.startswith("file://"):
+        return path[len("file://"):]
+    return path
+
+
+def open(path, mode="rb"):  # noqa: A001 - deliberate builtin shadow
+    """Open a path through the registered filesystem for its scheme."""
+    s = scheme_of(path)
+    if s is None:
+        return builtins.open(local_part(path), mode)
+    opener = _REGISTRY.get(s)
+    if opener is None:
+        raise UnsupportedSchemeError(
+            "no filesystem registered for {!r} paths ({!r}); this "
+            "framework bundles no remote-FS client (the reference used "
+            "TF's gfile+Hadoop). Register one once per process:\n"
+            "    from tensorflowonspark_tpu import fs\n"
+            "    fs.register_filesystem({!r}, opener)  # opener(path, mode)\n"
+            "e.g. fsspec: fs.register_filesystem({!r}, "
+            "lambda p, m: fsspec.open(p, m).open())".format(
+                s, path, s, s))
+    return opener(path, mode)

@@ -124,9 +124,20 @@ class NodeContext(object):
         """Absolutize a user path against default_fs / working dir.
 
         Reference: ``TFNodeContext.absolute_path`` / ``TFNode.hdfs_path``.
+        The reference resolved remote schemes through TF's gfile+Hadoop;
+        here remote schemes require a registered opener (fs.py) — an
+        unregistered scheme fails HERE, loudly, instead of as a
+        confusing ENOENT deep inside a reader.
         """
-        if path.startswith("hdfs://") or path.startswith("gs://") or \
-                path.startswith("file://") or os.path.isabs(path):
+        from tensorflowonspark_tpu import fs
+        if fs.scheme_of(path) is not None:
+            if not fs.is_supported(path):
+                raise fs.UnsupportedSchemeError(
+                    "path {!r}: no filesystem registered for scheme "
+                    "{!r}; see tensorflowonspark_tpu.fs."
+                    "register_filesystem".format(path, fs.scheme_of(path)))
+            return path
+        if path.startswith("file://") or os.path.isabs(path):
             return path
         return os.path.join(self.working_dir, path)
 
@@ -249,6 +260,7 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                                             cluster_meta["cluster_template"])
         host = info.get("host") or util.get_ip_address()
         authkey = bytes.fromhex(cluster_meta["authkey"])
+        _register_filesystems(cluster_meta)
 
         # 1. queue broker for this node (the process-boundary bridge)
         mgr = manager.start(authkey, list(queues),
@@ -383,6 +395,20 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
     return _mapfn
 
 
+def _register_filesystems(cluster_meta):
+    """Replay driver-provided {scheme: opener} registrations here.
+
+    The fs registry is process-local (fs.py); cluster.run ships the
+    openers in cluster_meta so executors, trainers, and data-task
+    processes all resolve the same remote schemes. Idempotent.
+    """
+    openers = cluster_meta.get("filesystems") or {}
+    if openers:
+        from tensorflowonspark_tpu import fs
+        for scheme, opener in openers.items():
+            fs.register_filesystem(scheme, opener)
+
+
 def _trainer_main(payload):
     """spawn-mode entry: unwrap the cloudpickle payload first."""
     from tensorflowonspark_tpu.engine import serializer
@@ -403,6 +429,7 @@ def _trainer_main_fork(fn, tf_args, executor_id, job_name, task_index,
         .format(executor_id))
     authkey = bytes.fromhex(cluster_meta["authkey"])
     multiprocessing.current_process().authkey = authkey
+    _register_filesystems(cluster_meta)  # spawn mode starts from scratch
     ctx = NodeContext(executor_id, job_name, task_index, cluster_info,
                       cluster_meta, mgr_addr=tuple(mgr_addr),
                       mgr_authkey=authkey)

@@ -61,7 +61,8 @@ class TFRecordWriter(object):
     """Append-only TFRecord file writer (context manager)."""
 
     def __init__(self, path):
-        self._f = open(path, "wb")
+        from tensorflowonspark_tpu import fs
+        self._f = fs.open(path, "wb")  # remote schemes via fs registry
 
     def write(self, record):
         record = bytes(record)
@@ -84,23 +85,49 @@ class TFRecordWriter(object):
         self.close()
 
 
+def _read_exact(f, n):
+    """Read exactly n bytes (or whatever remains at EOF).
+
+    Registered remote openers (fs.py) may hand back raw/network streams
+    whose read() legally returns short — a single read() would then
+    misreport intact files as truncated/corrupt.
+    """
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = f.read(n - got)
+        if not chunk:
+            break
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
 def tfrecord_iterator(path, verify_crc=True):
-    """Yield raw record bytes from a TFRecord file."""
-    with open(path, "rb") as f:
+    """Yield raw record bytes from a TFRecord file (fs registry handles
+    remote schemes)."""
+    from tensorflowonspark_tpu import fs
+    with fs.open(path, "rb") as f:
         while True:
-            header = f.read(8)
+            header = _read_exact(f, 8)
             if not header:
                 return
             if len(header) < 8:
                 raise ValueError("truncated TFRecord length header")
             (length,) = _U64.unpack(header)
-            (length_crc,) = _U32.unpack(f.read(4))
+            crc_bytes = _read_exact(f, 4)
+            if len(crc_bytes) < 4:
+                raise ValueError("truncated TFRecord length crc")
+            (length_crc,) = _U32.unpack(crc_bytes)
             if verify_crc and masked_crc32c(header) != length_crc:
                 raise ValueError("corrupt TFRecord: bad length crc")
-            data = f.read(length)
+            data = _read_exact(f, length)
             if len(data) < length:
                 raise ValueError("truncated TFRecord payload")
-            (data_crc,) = _U32.unpack(f.read(4))
+            crc_bytes = _read_exact(f, 4)
+            if len(crc_bytes) < 4:
+                raise ValueError("truncated TFRecord data crc")
+            (data_crc,) = _U32.unpack(crc_bytes)
             if verify_crc and masked_crc32c(data) != data_crc:
                 raise ValueError("corrupt TFRecord: bad data crc")
             yield data
@@ -309,7 +336,14 @@ def read_examples(path):
 
 
 def list_tfrecord_files(directory):
-    """part-* files under ``directory``, sorted (the Hadoop layout)."""
+    """part-* files under ``directory``, sorted (the Hadoop layout).
+
+    Directory listing needs a real filesystem — remote schemes fail
+    loudly here (fs.require_local) instead of as an os.listdir ENOENT.
+    """
+    from tensorflowonspark_tpu import fs
+
+    directory = fs.require_local(directory, "TFRecord shard listing")
     names = [n for n in sorted(os.listdir(directory))
              if n.startswith("part-") and not n.endswith(".crc")]
     return [os.path.join(directory, n) for n in names]

@@ -165,11 +165,16 @@ class DataFeed(object):
     def _next_item(self):
         """Blocking read of the next feed item (chunk or Marker).
 
-        Bounded waits with state checks between them: a consumer blocked on
-        a feed whose producer side died (state flipped to 'error'/'stopped'
-        by the watchdog or driver) must raise, not hang forever.
+        Bounded waits with state checks between them: a consumer blocked
+        on a feed whose producer side died must raise, not hang forever.
+        'error' aborts immediately; 'terminating' (set by the driver's
+        shutdown AFTER it queued EndFeed, and by our own terminate())
+        gets a short grace so an in-flight EndFeed can still arrive, then
+        aborts — otherwise a feeder that died mid-shutdown would park
+        this consumer on an empty feed until the shutdown timeout.
         """
         import queue as _queue
+        idle_terminating = 0
         while True:
             if self._ring is not None:
                 obj = self._ring.read_obj(timeout=5.0)
@@ -181,9 +186,15 @@ class DataFeed(object):
                 except _queue.Empty:
                     pass
             state = self.mgr.get("state")
-            if state in ("error", "stopped"):
+            if state == "error":
                 raise RuntimeError(
                     "feed aborted: node state is {!r}".format(state))
+            if state == "terminating":
+                idle_terminating += 1
+                if idle_terminating >= 3:  # ~15s with no EndFeed showing
+                    raise RuntimeError(
+                        "feed aborted: node is terminating and no "
+                        "end-of-feed marker arrived")
 
     def _item_done(self):
         if self._queue_in is not None:

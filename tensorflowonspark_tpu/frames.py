@@ -57,8 +57,16 @@ class ColumnarChunk(object):
                              self.scalar)
 
     def materialize(self):
-        """Own the memory (copy out of any transient buffer)."""
-        self.cols = [np.ascontiguousarray(c) for c in self.cols]
+        """Own the memory (copy out of any transient buffer).
+
+        Must COPY views: ``np.ascontiguousarray`` returns an already-
+        contiguous view unchanged, which for ring-backed ``frombuffer``
+        views would alias memory the producer is about to overwrite —
+        silent data corruption. OWNDATA is the contract.
+        """
+        self.cols = [c if c.flags["OWNDATA"] and c.flags["C_CONTIGUOUS"]
+                     else np.array(c, order="C", copy=True)
+                     for c in self.cols]
         return self
 
     def record(self, i):

@@ -20,6 +20,7 @@ need registration.
 """
 
 import builtins
+import os
 import re
 
 _SCHEME_RE = re.compile(r"^([a-zA-Z][a-zA-Z0-9+.-]*)://")
@@ -53,8 +54,12 @@ def require_local(path, what):
 
 
 def scheme_of(path):
-    """'hdfs' for 'hdfs://x/y', None for local/bare paths."""
-    m = _SCHEME_RE.match(path)
+    """'hdfs' for 'hdfs://x/y', None for local/bare paths.
+
+    Accepts PathLike (fspath'd first) — pathlib users predate the
+    registry and must keep working.
+    """
+    m = _SCHEME_RE.match(os.fspath(path))
     if not m:
         return None
     s = m.group(1).lower()
@@ -85,6 +90,7 @@ def is_supported(path):
 
 def local_part(path):
     """Strip a file:// prefix; other schemes are returned untouched."""
+    path = os.fspath(path)
     if path.startswith("file://"):
         return path[len("file://"):]
     return path
@@ -92,6 +98,7 @@ def local_part(path):
 
 def open(path, mode="rb"):  # noqa: A001 - deliberate builtin shadow
     """Open a path through the registered filesystem for its scheme."""
+    path = os.fspath(path)
     s = scheme_of(path)
     if s is None:
         return builtins.open(local_part(path), mode)

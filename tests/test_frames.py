@@ -173,6 +173,33 @@ def test_ring_wraparound_with_gather_writes():
         ring.close()
 
 
+def test_read_obj_result_survives_ring_wraparound():
+    """read_obj must return batches that OWN their memory: a held batch
+    aliasing the mmap would be silently overwritten once the producer
+    wraps (np.ascontiguousarray does NOT copy contiguous views —
+    regression test for exactly that)."""
+    from tensorflowonspark_tpu import shm
+    if not shm.available():
+        pytest.skip("native ring unavailable")
+    shm._load().shmring_unlink(b"/tfos-test-uaf")
+    ring = shm.ShmRing.create("/tfos-test-uaf", capacity=1 << 16)
+    try:
+        first = np.full(6000, 1, dtype=np.uint8)
+        ring.write_obj(frames.ColumnarChunk([first]), timeout=2.0)
+        held = ring.read_obj(timeout=2.0)
+        assert held.cols[0].flags["OWNDATA"]
+        # hammer the ring far past wraparound while holding `held`
+        for i in range(2, 30):
+            ring.write_obj(
+                frames.ColumnarChunk([np.full(6000, i, dtype=np.uint8)]),
+                timeout=2.0)
+            ring.read_obj(timeout=2.0)
+        np.testing.assert_array_equal(held.cols[0], first)
+    finally:
+        ring.unlink()
+        ring.close()
+
+
 def test_ring_rejects_messages_over_half_capacity():
     from tensorflowonspark_tpu import shm
     if not shm.available():

@@ -23,6 +23,20 @@ def test_local_paths_need_no_registration(tmp_path):
     assert fs.is_supported(str(p))
 
 
+def test_pathlib_paths_accepted_everywhere(tmp_path):
+    """PathLike worked before the registry existed and must keep working
+    (scheme_of/open/require_local fspath their input)."""
+    from tensorflowonspark_tpu import tfrecord
+
+    assert fs.scheme_of(tmp_path) is None
+    assert fs.is_supported(tmp_path)
+    assert fs.require_local(tmp_path, "t") == str(tmp_path)
+    p = tmp_path / "r.tfrecord"
+    with tfrecord.TFRecordWriter(p) as w:
+        w.write(b"rec")
+    assert list(tfrecord.tfrecord_iterator(p)) == [b"rec"]
+
+
 def test_unregistered_scheme_fails_loudly():
     with pytest.raises(fs.UnsupportedSchemeError) as ei:
         fs.open("fake://bucket/obj", "rb")

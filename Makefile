@@ -21,7 +21,7 @@ tensorflowonspark_tpu/_libshmring.so: native/shm_ring.cpp
 test:
 	timeout $(SUITE_TIMEOUT) $(PYTHON) -m pytest tests/ -q
 
-SUITE_TIMEOUT ?= 900
+SUITE_TIMEOUT ?= 1200
 
 test-fast:
 	$(PYTHON) -m pytest tests/ -q -x -m "not slow"

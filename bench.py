@@ -69,13 +69,7 @@ def _bench_map_fun(args, ctx):
     from tensorflowonspark_tpu import infeed, training
     from tensorflowonspark_tpu.parallel import build_mesh
 
-    if args["on_tpu"]:
-        from tensorflowonspark_tpu.models.resnet import ResNet50
-        model = ResNet50()
-    else:
-        from tensorflowonspark_tpu.models.resnet import ResNet
-        model = ResNet(stage_sizes=[1, 1], num_classes=10, width=8)
-
+    model = _bench_model(args["on_tpu"])
     batch = args["batch"]
     image = args["image"]
     mesh = build_mesh({"data": len(jax.devices())})
@@ -197,6 +191,23 @@ def _mfu(trainer, state, batch_data, images_per_sec_per_chip, batch,
     return images_per_sec_per_chip * flops_per_img / peak
 
 
+def _bench_model(on_tpu):
+    """ResNet-50 (tiny variant on CPU smoke), with perf-experiment knobs:
+    TFOS_BENCH_BN_DTYPE=bfloat16 runs BatchNorm in bf16 (halves the HBM
+    traffic of every norm; stats/params stay fp32)."""
+    import jax.numpy as jnp
+
+    bn_dtype = jnp.bfloat16 \
+        if os.environ.get("TFOS_BENCH_BN_DTYPE") == "bfloat16" \
+        else jnp.float32
+    if on_tpu:
+        from tensorflowonspark_tpu.models.resnet import ResNet50
+        return ResNet50(bn_dtype=bn_dtype)
+    from tensorflowonspark_tpu.models.resnet import ResNet
+    return ResNet(stage_sizes=[1, 1], num_classes=10, width=8,
+                  bn_dtype=bn_dtype)
+
+
 def _device_only(on_tpu, batch, image, steps, warmup):
     """Step time with the batch staged in HBM once (the ceiling)."""
     import jax
@@ -206,12 +217,7 @@ def _device_only(on_tpu, batch, image, steps, warmup):
     from tensorflowonspark_tpu import training
     from tensorflowonspark_tpu.parallel import build_mesh
 
-    if on_tpu:
-        from tensorflowonspark_tpu.models.resnet import ResNet50
-        model = ResNet50()
-    else:
-        from tensorflowonspark_tpu.models.resnet import ResNet
-        model = ResNet(stage_sizes=[1, 1], num_classes=10, width=8)
+    model = _bench_model(on_tpu)
 
     mesh = build_mesh({"data": len(jax.devices())})
     trainer = training.Trainer(model, optax.sgd(0.1, momentum=0.9), mesh)
@@ -270,6 +276,7 @@ def main():
         batch, image, steps, warmup, fed_steps = 256, 224, 30, 5, 12
     else:  # CPU smoke mode so the bench is runnable anywhere
         batch, image, steps, warmup, fed_steps = 16, 32, 5, 2, 4
+    batch = int(os.environ.get("TFOS_BENCH_BATCH") or 0) or batch
 
     # Fed runs first: the driver has not initialized jax yet, so the
     # trainer subprocesses are the chip's only owners.

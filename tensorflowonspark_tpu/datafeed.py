@@ -186,7 +186,7 @@ class DataFeed(object):
                 except _queue.Empty:
                     pass
             state = self.mgr.get("state")
-            if state == "error":
+            if state in ("error", "stopped"):  # terminal states: abort now
                 raise RuntimeError(
                     "feed aborted: node state is {!r}".format(state))
             if state == "terminating":

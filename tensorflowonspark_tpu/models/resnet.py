@@ -22,11 +22,17 @@ class BottleneckBlock(nn.Module):
     filters: int
     strides: int = 1
     dtype: Any = jnp.bfloat16
+    #: BatchNorm compute dtype. float32 is the conservative default; on
+    #: TPU, bfloat16 BN halves the HBM traffic of every norm (stats stay
+    #: fp32 in flax's running-average params either way) and is the
+    #: standard throughput configuration for ResNet on TPUs.
+    bn_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train=False):
         norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+                       momentum=0.9, epsilon=1e-5, dtype=self.bn_dtype,
+                       param_dtype=jnp.float32)
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
 
         residual = x
@@ -55,6 +61,7 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     dtype: Any = jnp.bfloat16
+    bn_dtype: Any = jnp.float32  # see BottleneckBlock.bn_dtype
 
     @nn.compact
     def __call__(self, x, train=False):
@@ -63,14 +70,16 @@ class ResNet(nn.Module):
         x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
                     use_bias=False, dtype=self.dtype, name="conv_init")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=jnp.float32, name="bn_init")(x)
+                         epsilon=1e-5, dtype=self.bn_dtype,
+                         param_dtype=jnp.float32, name="bn_init")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = 2 if i > 0 and j == 0 else 1
                 x = BottleneckBlock(self.width * 2 ** i, strides=strides,
-                                    dtype=self.dtype)(x, train=train)
+                                    dtype=self.dtype,
+                                    bn_dtype=self.bn_dtype)(x, train=train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
         return x

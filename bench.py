@@ -259,24 +259,36 @@ def _probe_platform():
              "import jax; print(jax.devices()[0].platform)"],
             capture_output=True, text=True, timeout=300)
     except subprocess.TimeoutExpired:
-        raise RuntimeError(
-            "device probe timed out: no usable jax backend (is the TPU "
-            "tunnel up?)")
+        return None, "device probe timed out after 300s (TPU tunnel down?)"
     lines = out.stdout.strip().splitlines()
     if out.returncode != 0 or not lines:
-        raise RuntimeError(
-            "device probe failed (rc={}):\n{}".format(
-                out.returncode, out.stderr[-2000:]))
-    return lines[-1]
+        return None, "device probe rc={}: {}".format(
+            out.returncode, (out.stderr or "")[-500:].strip())
+    return lines[-1], None
 
 
 def main():
-    on_tpu = _probe_platform() != "cpu"
+    platform, probe_error = _probe_platform()
+    if platform is None:
+        # Keep the one-JSON-line contract even with a wedged device
+        # backend (e.g. the TPU tunnel down): report the outage instead
+        # of dying with a stack trace or hanging the driver.
+        print(json.dumps({
+            "metric": "resnet50_cluster_fed_images_per_sec_per_chip",
+            "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
+            "error": probe_error,
+        }))
+        return
+    on_tpu = platform != "cpu"
     if on_tpu:
         batch, image, steps, warmup, fed_steps = 256, 224, 30, 5, 12
     else:  # CPU smoke mode so the bench is runnable anywhere
         batch, image, steps, warmup, fed_steps = 16, 32, 5, 2, 4
-    batch = int(os.environ.get("TFOS_BENCH_BATCH") or 0) or batch
+    try:
+        batch = int(os.environ.get("TFOS_BENCH_BATCH") or 0) or batch
+    except ValueError:
+        print("ignoring malformed TFOS_BENCH_BATCH={!r}".format(
+            os.environ["TFOS_BENCH_BATCH"]), file=sys.stderr)
 
     # Fed runs first: the driver has not initialized jax yet, so the
     # trainer subprocesses are the chip's only owners.

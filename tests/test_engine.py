@@ -127,3 +127,78 @@ def test_take_computes_minimal_partitions(sc):
         computed)
     assert rdd.first() == 0
     assert sc.parallelize([], 4).take(2) == []
+
+
+def test_standalone_mode_with_external_executors(tmp_path):
+    """spawn_local=False: the driver writes driver.info and waits; an
+    external launcher starts executors via the tfos-executor CLI (the
+    remote-host deployment shape — SURVEY.md engine substrate)."""
+    import json
+    import subprocess
+    import sys
+    import threading
+
+    work_root = str(tmp_path / "standalone")
+    holder = {}
+
+    def make_ctx():
+        try:
+            holder["sc"] = Context(num_executors=2, spawn_local=False,
+                                   work_root=work_root, start_timeout=60)
+        except BaseException as e:  # noqa: BLE001 - re-raised on main thread
+            holder["error"] = e
+
+    t = threading.Thread(target=make_ctx)
+    t.start()
+    # the constructor blocks awaiting executors; driver.info appears first
+    info_path = os.path.join(work_root, "driver.info")
+    for _ in range(200):
+        if "error" in holder:
+            raise holder["error"]
+        if os.path.exists(info_path):
+            try:
+                info = json.load(open(info_path))
+                break
+            except ValueError:
+                pass
+        import time
+        time.sleep(0.1)
+    else:
+        raise AssertionError("driver.info never appeared")
+
+    procs = []
+    logs = []
+    try:
+        for i in range(info["num_executors"]):
+            wd = os.path.join(work_root, "ext-exec-%d" % i)
+            os.makedirs(wd, exist_ok=True)
+            log = open(os.path.join(wd, "log"), "ab")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "tensorflowonspark_tpu.engine.executor",
+                 "--driver", "{}:{}".format(info["host"], info["port"]),
+                 "--executor-id", str(i),
+                 "--authkey-file", info["authkey_file"],
+                 "--work-dir", wd],
+                stdout=log, stderr=subprocess.STDOUT))
+        t.join(timeout=60)
+        assert not t.is_alive(), "driver never saw the external executors"
+        if "error" in holder:
+            raise holder["error"]
+        sc = holder["sc"]
+        try:
+            got = sc.parallelize(range(10), 2).map(lambda x: x * 3).collect()
+            assert got == [x * 3 for x in range(10)]
+        finally:
+            sc.stop()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()

@@ -200,107 +200,122 @@ def _unfold(x, b, s, n, d):
     return jnp.transpose(jnp.reshape(x, (b, n, s, d)), (0, 2, 1, 3))
 
 
-def _check_blocks(s, block_q, block_k):
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    assert s % block_q == 0 and s % block_k == 0, (
-        "seq len {} must be divisible by block sizes ({}, {})"
-        .format(s, block_q, block_k))
+def _check_blocks(s_q, s_k, block_q, block_k):
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    assert s_q % block_q == 0 and s_k % block_k == 0, (
+        "seq lens ({}, {}) must be divisible by block sizes ({}, {})"
+        .format(s_q, s_k, block_q, block_k))
     return block_q, block_k
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    """Returns (out [B,S,N,D], lse [B*N, S])."""
+    """Returns (out [B,Sq,N,D], lse [B*N, Sq]). Sq may differ from the
+    KV length (cross attention); causal requires Sq == Sk."""
     from jax.experimental import pallas as pl
 
-    b, s, n, d = q.shape
-    block_q, block_k = _check_blocks(s, block_q, block_k)
+    b, s_q, n, d = q.shape
+    s_k = k.shape[1]
+    assert not causal or s_q == s_k, "causal needs equal q/kv lengths"
+    block_q, block_k = _check_blocks(s_q, s_k, block_q, block_k)
 
-    qf, kf, vf = (_fold(x, b, s, n, d) for x in (q, k, v))
-    grid = (b * n, s // block_q)
+    qf = _fold(q, b, s_q, n, d)
+    kf = _fold(k, b, s_k, n, d)
+    vf = _fold(v, b, s_k, n, d)
+    grid = (b * n, s_q // block_q)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_len=s)
+        block_k=block_k, seq_len=s_k)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, s_k, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, s_k, d), lambda bh, i: (bh, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * n, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b * n, s), jnp.float32),
+            jax.ShapeDtypeStruct((b * n, s_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b * n, s_q), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return _unfold(out, b, s, n, d), lse
+    return _unfold(out, b, s_q, n, d), lse
 
 
 def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-               interpret):
-    """Fused dq/dk/dv. All tensors [B,S,N,D] except lse [B*N,S]."""
+               interpret, g_lse=None):
+    """Fused dq/dk/dv. All tensors [B,S,N,D] except lse [B*N,S].
+
+    ``g_lse`` ([B*N, S] or None): cotangent of the lse output for the
+    (out, lse) variant — enters as ds += p * g_lse, folded into delta.
+    """
     from jax.experimental import pallas as pl
 
-    b, s, n, d = q.shape
-    block_q, block_k = _check_blocks(s, block_q, block_k)
+    b, s_q, n, d = q.shape
+    s_k = k.shape[1]
+    block_q, block_k = _check_blocks(s_q, s_k, block_q, block_k)
 
-    qf, kf, vf, of, gf = (_fold(x, b, s, n, d)
-                          for x in (q, k, v, out, g))
+    qf = _fold(q, b, s_q, n, d)
+    kf = _fold(k, b, s_k, n, d)
+    vf = _fold(v, b, s_k, n, d)
+    of = _fold(out, b, s_q, n, d)
+    gf = _fold(g, b, s_q, n, d)
     # delta = rowsum(dO ⊙ O): one fused XLA elementwise+reduce, f32
     delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
-                    axis=-1)                            # [B*N, S]
+                    axis=-1)                            # [B*N, Sq]
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
 
     full = lambda bh, i: (bh, 0, 0)  # noqa: E731
     full_vec = lambda bh, i: (bh, 0)  # noqa: E731
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_len=s),
-        grid=(b * n, s // block_q),
+                          block_q=block_q, block_k=block_k, seq_len=s_k),
+        grid=(b * n, s_q // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, s, d), full),
-            pl.BlockSpec((1, s, d), full),
+            pl.BlockSpec((1, s_k, d), full),
+            pl.BlockSpec((1, s_k, d), full),
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
             pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * n, s, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b * n, s_q, d), q.dtype),
         interpret=interpret,
     )(qf, kf, vf, gf, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_len=s),
-        grid=(b * n, s // block_k),
+                          block_q=block_q, block_k=block_k, seq_len=s_q),
+        grid=(b * n, s_k // block_k),
         in_specs=[
-            pl.BlockSpec((1, s, d), full),
+            pl.BlockSpec((1, s_q, d), full),
             pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, s, d), full),
-            pl.BlockSpec((1, s), full_vec),
-            pl.BlockSpec((1, s), full_vec),
+            pl.BlockSpec((1, s_q, d), full),
+            pl.BlockSpec((1, s_q), full_vec),
+            pl.BlockSpec((1, s_q), full_vec),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * n, s, d), k.dtype),
-            jax.ShapeDtypeStruct((b * n, s, d), v.dtype),
+            jax.ShapeDtypeStruct((b * n, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((b * n, s_k, d), v.dtype),
         ],
         interpret=interpret,
     )(qf, kf, vf, gf, lse, delta)
 
-    return (_unfold(dq, b, s, n, d), _unfold(dk, b, s, n, d),
-            _unfold(dv, b, s, n, d))
+    return (_unfold(dq, b, s_q, n, d), _unfold(dk, b, s_k, n, d),
+            _unfold(dv, b, s_k, n, d))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -322,6 +337,84 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_pair(q, k, v, causal, scale, block_q, block_k, interpret):
+    """(out, lse) variant — the composable building block.
+
+    Callers that merge attention partials (ring attention) need the
+    per-row logsumexp alongside the normalized output, and need
+    gradients to flow through BOTH: ``d lse / d s = p``, which folds
+    into the existing backward kernels as ``delta_eff = delta - g_lse``
+    (ds = p * (dp - delta + g_lse)) — no extra kernel.
+    """
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_pair_vjp_fwd(q, k, v, causal, scale, block_q, block_k,
+                        interpret):
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_pair_vjp_bwd(causal, scale, block_q, block_k, interpret,
+                        residuals, gs):
+    q, k, v, out, lse = residuals
+    g, g_lse = gs
+    return _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q,
+                      block_k, interpret, g_lse=g_lse)
+
+
+_flash_pair.defvjp(_flash_pair_vjp_fwd, _flash_pair_vjp_bwd)
+
+
+def _reference_lse(q, k, v, causal, scale):
+    """XLA (out, lse) pair — same contract as the fused kernels."""
+    logits = jnp.einsum("bqnd,bknd->bnqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)   # [b, n, q]
+    safe = jnp.where(jnp.isneginf(lse), 0.0, lse)
+    p = jnp.where(jnp.isneginf(logits), 0.0,
+                  jnp.exp(logits - safe[..., None]))
+    out = jnp.einsum("bnqk,bknd->bqnd", p.astype(v.dtype), v)
+    return out.astype(q.dtype), lse
+
+
+def flash_attention_lse(q, k, v, causal=False, scale=None,
+                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                        force_pallas=False, interpret=None):
+    """Fused attention returning ``(out [B,S,N,D], lse [B,N,S])``.
+
+    The building block for partial-attention composition (ring
+    attention's per-step block update): two partials (out_a, lse_a),
+    (out_b, lse_b) over disjoint KV merge exactly as
+
+        lse = logaddexp(lse_a, lse_b)
+        out = out_a * exp(lse_a - lse) + out_b * exp(lse_b - lse)
+
+    Differentiable in q/k/v including through the lse output. Rows that
+    attend to nothing (fully-masked) have lse == -inf and out == 0.
+
+    Backend policy matches :func:`flash_attention`: Pallas kernels on
+    TPU; the XLA reference pair elsewhere (``interpret=True`` /
+    ``force_pallas`` route through the Pallas interpreter for tests).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if not (on_tpu or force_pallas or interpret):
+        return _reference_lse(q, k, v, causal, scale)
+    if interpret is None:
+        interpret = not on_tpu
+    b, s, n, d = q.shape
+    out, lse = _flash_pair(q, k, v, causal, scale, block_q, block_k,
+                           interpret)
+    return out, jnp.reshape(lse, (b, n, s))
 
 
 def flash_attention(q, k, v, causal=False, scale=None,

@@ -56,6 +56,112 @@ def _block_update(q, k, v, m, l, o, q_offset, kv_offset, causal, scale):
     return m_new, l_new, o_new
 
 
+def _merge_partials(out_a, lse_a, out_b, lse_b):
+    """Combine two attention partials over disjoint KV sets.
+
+    out: [b, s, n, d]; lse: [b, n, s]. Exact: each partial is a
+    normalized softmax-attention over its KV subset with row logsumexp
+    lse; reweighting by exp(lse_i - lse_merged) reconstructs the full
+    softmax. Fully-masked partials (lse == -inf, out == 0) merge as
+    identity; -inf/-inf rows stay inert (no NaNs).
+    """
+    lse = jnp.logaddexp(lse_a, lse_b)
+    safe = jnp.where(jnp.isneginf(lse), 0.0, lse)
+
+    def w(l_i):
+        return jnp.where(jnp.isneginf(l_i), 0.0, jnp.exp(l_i - safe))
+
+    w_a = jnp.einsum("bns->bsn", w(lse_a))[..., None]
+    w_b = jnp.einsum("bns->bsn", w(lse_b))[..., None]
+    return out_a * w_a + out_b * w_b, lse
+
+
+def ring_flash_attention(q, k, v, mesh, seq_axis="seq", causal=False,
+                         scale=None, block_q=None, block_k=None,
+                         interpret=None):
+    """Ring attention with the fused flash kernel as the block engine.
+
+    Same contract and ppermute schedule as :func:`ring_attention`, but
+    each per-step block update runs the Pallas flash kernel
+    (ops/flash_attention.py) instead of materializing the
+    [s_local, s_local] score matrix in XLA — peak memory O(S/P) per
+    device in the *local* dimension too, and the MXU-tiled kernel does
+    the FLOPs. Fully differentiable (the kernel's (out, lse) vjp).
+
+    Causal masking uses the ring's alignment: all blocks are the same
+    size and offsets are multiples of s_local, so every (q_shard,
+    kv_block) pair is exactly one of fully-visible (kv strictly past),
+    diagonal (standard local causal), or fully-masked (kv strictly
+    future) — selected with ``lax.switch`` on the rotating source rank,
+    no global-position support needed in the kernel.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from tensorflowonspark_tpu.ops.flash_attention import (
+        DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q, flash_attention_lse)
+
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    block_q = block_q or DEFAULT_BLOCK_Q
+    block_k = block_k or DEFAULT_BLOCK_K
+    axis_size = mesh.shape[seq_axis]
+    spec = P(None, seq_axis, None, None)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, check_vma=False)
+    def _ring(q_blk, k_blk, v_blk):
+        rank = jax.lax.axis_index(seq_axis)
+        b, s_local, n, d = q_blk.shape
+
+        def flash_full(args):
+            qb, kb, vb = args
+            return flash_attention_lse(qb, kb, vb, causal=False,
+                                       scale=scale, block_q=block_q,
+                                       block_k=block_k,
+                                       interpret=interpret)
+
+        def flash_diag(args):
+            qb, kb, vb = args
+            return flash_attention_lse(qb, kb, vb, causal=True,
+                                       scale=scale, block_q=block_q,
+                                       block_k=block_k,
+                                       interpret=interpret)
+
+        def masked(args):
+            qb, _, _ = args
+            return (jnp.zeros_like(qb),
+                    jnp.full((b, n, s_local), -jnp.inf, jnp.float32))
+
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+        def step(t, carry):
+            out, lse, k_cur, v_cur = carry
+            src_rank = (rank - t) % axis_size
+            if causal:
+                # 0: kv strictly future (masked), 1: diagonal, 2: past
+                idx = jnp.int32(1) + jnp.sign(rank - src_rank).astype(
+                    jnp.int32)
+                out_t, lse_t = jax.lax.switch(
+                    idx, (masked, flash_diag, flash_full),
+                    (q_blk, k_cur, v_cur))
+            else:
+                out_t, lse_t = flash_full((q_blk, k_cur, v_cur))
+            out, lse = _merge_partials(out, lse, out_t.astype(jnp.float32),
+                                       lse_t)
+            k_nxt = jax.lax.ppermute(k_cur, seq_axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, seq_axis, perm)
+            return out, lse, k_nxt, v_nxt
+
+        out0 = jnp.zeros((b, s_local, n, d), jnp.float32)
+        lse0 = jnp.full((b, n, s_local), -jnp.inf, jnp.float32)
+        out, lse, _, _ = jax.lax.fori_loop(
+            0, axis_size, step, (out0, lse0, k_blk, v_blk))
+        return out.astype(q_blk.dtype)
+
+    return _ring(q, k, v)
+
+
 def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False, scale=None):
     """Sequence-parallel attention over ``mesh[seq_axis]``.
 

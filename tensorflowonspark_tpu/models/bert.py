@@ -4,9 +4,12 @@ fine-tuning — the BASELINE.json config #3 model.
 Written MXU-first: attention and FFN matmuls in bfloat16 with float32
 params and float32 LayerNorm/softmax (the numerically-sensitive parts),
 head dims at lane multiples, static shapes, no python control flow in the
-forward. Attention is expressed with einsum so the sequence-parallel
-variant (parallel/ring_attention.py) can swap in per-shard blockwise
-computation without touching the module tree.
+forward. Attention runs the fused Pallas flash kernel
+(ops/flash_attention.py, padding mask as its key_mask) whenever
+attention-matrix dropout is inactive; the einsum formulation remains as
+the dropout-training path and the swap point for the sequence-parallel
+variant (parallel/ring_attention.py). Both paths share the -inf masking
+convention: a fully-masked row attends to nothing and outputs zeros.
 """
 
 from functools import partial
@@ -21,7 +24,8 @@ class BertConfig:
 
     def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=3072, max_position=512,
-                 type_vocab_size=2, dropout_rate=0.1, dtype=jnp.bfloat16):
+                 type_vocab_size=2, dropout_rate=0.1, dtype=jnp.bfloat16,
+                 use_flash=True):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -31,6 +35,9 @@ class BertConfig:
         self.type_vocab_size = type_vocab_size
         self.dropout_rate = dropout_rate
         self.dtype = dtype
+        #: route attention through the fused Pallas kernel when possible
+        #: (trace-stable config, unlike an env var read at trace time)
+        self.use_flash = use_flash
 
 
 def bert_base():
@@ -41,6 +48,15 @@ def bert_tiny(vocab_size=1024):
     """Test-sized config: same code path, minutes-not-hours to run."""
     return BertConfig(vocab_size=vocab_size, hidden_size=64, num_layers=2,
                       num_heads=2, intermediate_size=128, max_position=128)
+
+
+def _pick_block(s):
+    """Largest flash tile <= 128 dividing the sequence length, or None
+    (-> einsum path) when nothing MXU-friendly divides it."""
+    for b in (128, 64, 32, 16, 8):
+        if s % b == 0:
+            return b
+    return None
 
 
 class SelfAttention(nn.Module):
@@ -58,16 +74,41 @@ class SelfAttention(nn.Module):
         v = dense(name="value")(x)
 
         scale = head_dim ** -0.5
-        # [B, N, S, S]; accumulate logits in f32 for a stable softmax
-        logits = jnp.einsum("bsnd,btnd->bnst", q, k,
-                            preferred_element_type=jnp.float32) * scale
-        if mask is not None:
-            big_neg = jnp.finfo(jnp.float32).min
-            logits = jnp.where(mask[:, None, None, :], logits, big_neg)
-        probs = nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        probs = nn.Dropout(cfg.dropout_rate)(probs,
-                                             deterministic=deterministic)
-        ctx_ = jnp.einsum("bnst,btnd->bsnd", probs, v)
+        # Fused path: the Pallas flash kernel (ops/flash_attention.py)
+        # with the padding mask as its key_mask — never materializes the
+        # [S, S] score matrix. Attention-matrix dropout can't run inside
+        # the fused kernel, so the einsum path serves when dropout is
+        # live (training with dropout_rate > 0); flash serves inference
+        # and dropout-free training. Identical math either way, including
+        # fully-masked rows (-inf masking -> zero output).
+        s_len = x.shape[1]
+        block = _pick_block(s_len)
+        use_flash = (cfg.use_flash and block is not None
+                     and (deterministic or cfg.dropout_rate == 0.0))
+        if use_flash:
+            from tensorflowonspark_tpu.ops.flash_attention import (
+                flash_attention)
+            ctx_ = flash_attention(q, k, v, key_mask=mask, scale=scale,
+                                   block_q=block, block_k=block)
+        else:
+            # [B, N, S, S]; accumulate logits in f32 for a stable softmax
+            logits = jnp.einsum("bsnd,btnd->bnst", q, k,
+                                preferred_element_type=jnp.float32) * scale
+            if mask is not None:
+                logits = jnp.where(mask[:, None, None, :], logits,
+                                   -jnp.inf)
+            # -inf-safe softmax: fully-masked rows output zeros (the
+            # flash kernel's convention), not a uniform average
+            m = jnp.max(logits, axis=-1, keepdims=True)
+            m = jnp.where(jnp.isneginf(m), 0.0, m)
+            e = jnp.where(jnp.isneginf(logits), 0.0,
+                          jnp.exp(logits - m))
+            denom = jnp.sum(e, axis=-1, keepdims=True)
+            probs = (e / jnp.where(denom == 0.0, 1.0, denom)) \
+                .astype(cfg.dtype)
+            probs = nn.Dropout(cfg.dropout_rate)(probs,
+                                                 deterministic=deterministic)
+            ctx_ = jnp.einsum("bnst,btnd->bsnd", probs, v)
         out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1),
                               dtype=cfg.dtype, name="out")(ctx_)
         return out

@@ -17,12 +17,19 @@ Both directions are fused:
   ``delta = rowsum(dO ⊙ O)`` is precomputed in XLA (one fused
   elementwise+reduce).
 
+Masking: causal (in-kernel position compare) and/or a per-key padding
+mask (``key_mask`` [B, S_k] bool — BERT-style), carried through both
+directions as an additive 0/-inf bias row.
+
+Rectangular attention is supported (``S_q != S_k`` — cross attention);
+causal requires equal lengths.
+
 Non-TPU backends take the XLA reference for both directions (and the
 Pallas interpreter validates the kernels on CPU in tests).
 
 Layout: [batch, seq, heads, head_dim], same contract as
-``parallel.ring_attention`` (whose per-shard block update this kernel can
-replace for ring+flash composition).
+``parallel.ring_attention`` (whose per-shard block update this kernel
+replaces in ``ring_flash_attention``).
 """
 
 import functools
@@ -34,11 +41,35 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
-def _reference(q, k, v, causal, scale):
+def _reference(q, k, v, causal, scale, bias=None):
     from tensorflowonspark_tpu.parallel.ring_attention import (
         reference_attention)
 
-    return reference_attention(q, k, v, causal=causal, scale=scale)
+    if bias is None:
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    out, _ = _reference_lse(q, k, v, causal, scale, bias)
+    return out
+
+
+def _reference_lse(q, k, v, causal, scale, bias=None):
+    """XLA (out, lse [b, n, s_q]) pair — same contract as the kernels.
+
+    ``bias``: optional [B, S_k] additive f32 row (0 / -inf key mask).
+    """
+    logits = jnp.einsum("bqnd,bknd->bnqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias[:, None, None, :]
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)   # [b, n, q]
+    safe = jnp.where(jnp.isneginf(lse), 0.0, lse)
+    p = jnp.where(jnp.isneginf(logits), 0.0,
+                  jnp.exp(logits - safe[..., None]))
+    out = jnp.einsum("bnqk,bknd->bqnd", p.astype(v.dtype), v)
+    return out.astype(q.dtype), lse
 
 
 def _causal_mask(s, q_offset, k_offset, block_q, block_k):
@@ -49,10 +80,14 @@ def _causal_mask(s, q_offset, k_offset, block_q, block_k):
     return jnp.where(q_pos >= k_pos, s, -jnp.inf)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_q, block_k, seq_len):
+def _fwd_kernel(*refs, scale, causal, block_q, block_k, seq_len, has_bias):
     """One (batch*head, q-block) program: loop KV tiles, online softmax."""
     from jax.experimental import pallas as pl
+
+    if has_bias:
+        q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, lse_ref), bias_ref = refs, None
 
     q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
     d = q.shape[-1]
@@ -73,6 +108,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
             q, k_blk.astype(jnp.float32),
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # [BQ, BK]
+        if bias_ref is not None:
+            s = s + bias_ref[0, pl.ds(kv_i * block_k, block_k)][None, :]
         if causal:
             s = _causal_mask(s, q_offset, kv_i * block_k, block_q, block_k)
         m_blk = jnp.max(s, axis=-1)
@@ -97,10 +134,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[0] = jnp.where(l == 0.0, -jnp.inf, m + jnp.log(l_safe))
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, causal, block_q, block_k, seq_len):
+def _dq_kernel(*refs, scale, causal, block_q, block_k, seq_len, has_bias):
     """dQ for one (batch*head, q-block): loop KV tiles, recompute P."""
     from jax.experimental import pallas as pl
+
+    if has_bias:
+        q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref, dq_ref \
+            = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref), \
+            bias_ref = refs, None
 
     q = q_ref[0].astype(jnp.float32) * scale           # [BQ, D]
     do = do_ref[0].astype(jnp.float32)                 # [BQ, D]
@@ -120,6 +163,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
             q, k_blk.astype(jnp.float32),
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [BQ, BK]
+        if bias_ref is not None:
+            s = s + bias_ref[0, pl.ds(kv_i * block_k, block_k)][None, :]
         if causal:
             s = _causal_mask(s, q_offset, kv_i * block_k, block_q, block_k)
         p = jnp.where(jnp.isneginf(s), 0.0,
@@ -138,17 +183,23 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = (dq_acc * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, causal, block_q, block_k,
-                seq_len):
+def _dkv_kernel(*refs, scale, causal, block_q, block_k, seq_len, has_bias):
     """dK/dV for one (batch*head, kv-block): loop Q tiles, recompute P."""
     from jax.experimental import pallas as pl
+
+    if has_bias:
+        q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref, \
+            dk_ref, dv_ref = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+         dv_ref), bias_ref = refs, None
 
     k_blk = k_ref[0].astype(jnp.float32)               # [BK, D]
     v_blk = v_ref[0].astype(jnp.float32)
     d = k_blk.shape[-1]
     kv_i = pl.program_id(1)
     k_offset = kv_i * block_k
+    bias_blk = bias_ref[0] if bias_ref is not None else None  # [BK]
 
     dk_acc = jnp.zeros((block_k, d), jnp.float32)
     dv_acc = jnp.zeros((block_k, d), jnp.float32)
@@ -167,6 +218,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_blk, k_blk,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [BQ, BK]
+        if bias_blk is not None:
+            s = s + bias_blk[None, :]
         if causal:
             s = _causal_mask(s, qi * block_q, k_offset, block_q, block_k)
         p = jnp.where(jnp.isneginf(s), 0.0,
@@ -200,6 +253,11 @@ def _unfold(x, b, s, n, d):
     return jnp.transpose(jnp.reshape(x, (b, n, s, d)), (0, 2, 1, 3))
 
 
+# NOTE: the bias row is per-BATCH ([B, S_k]); the grids run over
+# bh = b*N + n, so bias BlockSpec index maps use bh // N (closing over
+# the static head count) instead of materializing an N-fold repeat.
+
+
 def _check_blocks(s_q, s_k, block_q, block_k):
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_k)
@@ -209,9 +267,10 @@ def _check_blocks(s_q, s_k, block_q, block_k):
     return block_q, block_k
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, bias, causal, scale, block_q, block_k, interpret):
     """Returns (out [B,Sq,N,D], lse [B*N, Sq]). Sq may differ from the
-    KV length (cross attention); causal requires Sq == Sk."""
+    KV length (cross attention); causal requires Sq == Sk.
+    ``bias``: optional [B, S_k] additive f32 row (key mask)."""
     from jax.experimental import pallas as pl
 
     b, s_q, n, d = q.shape
@@ -225,15 +284,21 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     grid = (b * n, s_q // block_q)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_len=s_k)
+        block_k=block_k, seq_len=s_k, has_bias=bias is not None)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((1, s_k, d), lambda bh, i: (bh, 0, 0)),
+        pl.BlockSpec((1, s_k, d), lambda bh, i: (bh, 0, 0)),
+    ]
+    inputs = [qf, kf, vf]
+    if bias is not None:
+        in_specs.append(
+            pl.BlockSpec((1, s_k), lambda bh, i, n=n: (bh // n, 0)))
+        inputs.append(bias.astype(jnp.float32))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, s_k, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, s_k, d), lambda bh, i: (bh, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
@@ -243,12 +308,12 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((b * n, s_q), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*inputs)
     return _unfold(out, b, s_q, n, d), lse
 
 
-def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-               interpret, g_lse=None):
+def _flash_bwd(q, k, v, bias, out, lse, g, causal, scale, block_q,
+               block_k, interpret, g_lse=None):
     """Fused dq/dk/dv. All tensors [B,S,N,D] except lse [B*N,S].
 
     ``g_lse`` ([B*N, S] or None): cotangent of the lse output for the
@@ -265,6 +330,8 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     vf = _fold(v, b, s_k, n, d)
     of = _fold(out, b, s_q, n, d)
     gf = _fold(g, b, s_q, n, d)
+    bf = None if bias is None else bias.astype(jnp.float32)
+    has_bias = bf is not None
     # delta = rowsum(dO ⊙ O): one fused XLA elementwise+reduce, f32
     delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1)                            # [B*N, Sq]
@@ -274,35 +341,55 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     full = lambda bh, i: (bh, 0, 0)  # noqa: E731
     full_vec = lambda bh, i: (bh, 0)  # noqa: E731
 
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((1, s_k, d), full),
+        pl.BlockSpec((1, s_k, d), full),
+    ]
+    dq_inputs = [qf, kf, vf]
+    if has_bias:
+        dq_specs.append(
+            pl.BlockSpec((1, s_k), lambda bh, i, n=n: (bh // n, 0)))
+        dq_inputs.append(bf)
+    dq_specs += [
+        pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
+        pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
+    ]
+    dq_inputs += [gf, lse, delta]
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_len=s_k),
+                          block_q=block_q, block_k=block_k, seq_len=s_k,
+                          has_bias=has_bias),
         grid=(b * n, s_q // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, s_k, d), full),
-            pl.BlockSpec((1, s_k, d), full),
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
-            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * n, s_q, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf, gf, lse, delta)
+    )(*dq_inputs)
 
+    dkv_specs = [
+        pl.BlockSpec((1, s_q, d), full),
+        pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
+    ]
+    dkv_inputs = [qf, kf, vf]
+    if has_bias:
+        dkv_specs.append(
+            pl.BlockSpec((1, block_k), lambda bh, i, n=n: (bh // n, i)))
+        dkv_inputs.append(bf)
+    dkv_specs += [
+        pl.BlockSpec((1, s_q, d), full),
+        pl.BlockSpec((1, s_q), full_vec),
+        pl.BlockSpec((1, s_q), full_vec),
+    ]
+    dkv_inputs += [gf, lse, delta]
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_len=s_q),
+                          block_q=block_q, block_k=block_k, seq_len=s_q,
+                          has_bias=has_bias),
         grid=(b * n, s_k // block_k),
-        in_specs=[
-            pl.BlockSpec((1, s_q, d), full),
-            pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, s_q, d), full),
-            pl.BlockSpec((1, s_q), full_vec),
-            pl.BlockSpec((1, s_q), full_vec),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0)),
@@ -312,35 +399,39 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
             jax.ShapeDtypeStruct((b * n, s_k, d), v.dtype),
         ],
         interpret=interpret,
-    )(qf, kf, vf, gf, lse, delta)
+    )(*dkv_inputs)
 
     return (_unfold(dq, b, s_q, n, d), _unfold(dk, b, s_k, n, d),
             _unfold(dv, b, s_k, n, d))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, bias, causal, scale, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, bias, causal, scale, block_q, block_k,
+                        interpret)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+def _flash_vjp_fwd(q, k, v, bias, causal, scale, block_q, block_k,
+                   interpret):
+    out, lse = _flash_fwd(q, k, v, bias, causal, scale, block_q, block_k,
                           interpret)
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, bias, out, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
-    q, k, v, out, lse = residuals
-    return _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q,
-                      block_k, interpret)
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, residuals,
+                   g):
+    q, k, v, bias, out, lse = residuals
+    dq, dk, dv = _flash_bwd(q, k, v, bias, out, lse, g, causal, scale,
+                            block_q, block_k, interpret)
+    return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_pair(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_pair(q, k, v, bias, causal, scale, block_q, block_k, interpret):
     """(out, lse) variant — the composable building block.
 
     Callers that merge attention partials (ring attention) need the
@@ -349,44 +440,37 @@ def _flash_pair(q, k, v, causal, scale, block_q, block_k, interpret):
     into the existing backward kernels as ``delta_eff = delta - g_lse``
     (ds = p * (dp - delta + g_lse)) — no extra kernel.
     """
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return _flash_fwd(q, k, v, bias, causal, scale, block_q, block_k,
+                      interpret)
 
 
-def _flash_pair_vjp_fwd(q, k, v, causal, scale, block_q, block_k,
+def _flash_pair_vjp_fwd(q, k, v, bias, causal, scale, block_q, block_k,
                         interpret):
-    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+    out, lse = _flash_fwd(q, k, v, bias, causal, scale, block_q, block_k,
                           interpret)
-    return (out, lse), (q, k, v, out, lse)
+    return (out, lse), (q, k, v, bias, out, lse)
 
 
 def _flash_pair_vjp_bwd(causal, scale, block_q, block_k, interpret,
                         residuals, gs):
-    q, k, v, out, lse = residuals
+    q, k, v, bias, out, lse = residuals
     g, g_lse = gs
-    return _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q,
-                      block_k, interpret, g_lse=g_lse)
+    dq, dk, dv = _flash_bwd(q, k, v, bias, out, lse, g, causal, scale,
+                            block_q, block_k, interpret, g_lse=g_lse)
+    return dq, dk, dv, None
 
 
 _flash_pair.defvjp(_flash_pair_vjp_fwd, _flash_pair_vjp_bwd)
 
 
-def _reference_lse(q, k, v, causal, scale):
-    """XLA (out, lse) pair — same contract as the fused kernels."""
-    logits = jnp.einsum("bqnd,bknd->bnqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
-    if causal:
-        s_q, s_k = q.shape[1], k.shape[1]
-        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
-        logits = jnp.where(mask[None, None], logits, -jnp.inf)
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)   # [b, n, q]
-    safe = jnp.where(jnp.isneginf(lse), 0.0, lse)
-    p = jnp.where(jnp.isneginf(logits), 0.0,
-                  jnp.exp(logits - safe[..., None]))
-    out = jnp.einsum("bnqk,bknd->bqnd", p.astype(v.dtype), v)
-    return out.astype(q.dtype), lse
+def _mask_to_bias(key_mask):
+    """[B, S_k] bool -> [B, S_k] f32 additive row (True = attend)."""
+    if key_mask is None:
+        return None
+    return jnp.where(key_mask, 0.0, -jnp.inf).astype(jnp.float32)
 
 
-def flash_attention_lse(q, k, v, causal=False, scale=None,
+def flash_attention_lse(q, k, v, causal=False, scale=None, key_mask=None,
                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                         force_pallas=False, interpret=None):
     """Fused attention returning ``(out [B,S,N,D], lse [B,N,S])``.
@@ -401,38 +485,45 @@ def flash_attention_lse(q, k, v, causal=False, scale=None,
     Differentiable in q/k/v including through the lse output. Rows that
     attend to nothing (fully-masked) have lse == -inf and out == 0.
 
+    ``key_mask``: optional [B, S_k] bool, True = key is attendable (the
+    BERT-style padding mask).
+
     Backend policy matches :func:`flash_attention`: Pallas kernels on
     TPU; the XLA reference pair elsewhere (``interpret=True`` /
     ``force_pallas`` route through the Pallas interpreter for tests).
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    bias = _mask_to_bias(key_mask)
     on_tpu = jax.default_backend() in ("tpu", "axon")
     if not (on_tpu or force_pallas or interpret):
-        return _reference_lse(q, k, v, causal, scale)
+        return _reference_lse(q, k, v, causal, scale, bias)
     if interpret is None:
         interpret = not on_tpu
     b, s, n, d = q.shape
-    out, lse = _flash_pair(q, k, v, causal, scale, block_q, block_k,
+    out, lse = _flash_pair(q, k, v, bias, causal, scale, block_q, block_k,
                            interpret)
     return out, jnp.reshape(lse, (b, n, s))
 
 
-def flash_attention(q, k, v, causal=False, scale=None,
+def flash_attention(q, k, v, causal=False, scale=None, key_mask=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                     force_pallas=False, interpret=None):
     """Fused attention. [B, S, N, D] in, [B, S, N, D] out.
 
+    ``key_mask``: optional [B, S_k] bool, True = key is attendable.
     On TPU backends runs the Pallas kernels (both directions); elsewhere
     falls back to the XLA reference (``interpret=True`` forces the
     kernels through the Pallas interpreter — used by tests to validate
     kernel logic on CPU).
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    bias = _mask_to_bias(key_mask)
     # allowlist, not denylist: unknown plugin backends must take the XLA
     # fallback, not the TPU kernel ('axon' is the tunneled TPU platform)
     on_tpu = jax.default_backend() in ("tpu", "axon")
     if interpret is None:
         interpret = not on_tpu
     if not (on_tpu or force_pallas):
-        return _reference(q, k, v, causal, scale)
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+        return _reference(q, k, v, causal, scale, bias)
+    return _flash(q, k, v, bias, causal, scale, block_q, block_k,
+                  interpret)

@@ -109,3 +109,37 @@ def test_inception_forward(jax):
     # Inception-v3 has ~23.8M params (1000-class head ~2M of it; ours is
     # 10-class here, so ~21.8M): sanity-check the architecture size.
     assert 20_000_000 < n_params < 26_000_000, n_params
+
+
+def test_bert_flash_attention_matches_einsum(jax):
+    """The fused attention path and the einsum path are the same math:
+    deterministic forward with a ragged padding mask must agree —
+    including a fully-masked sequence (both conventions output zeros)."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.models import bert
+
+    cfg = bert.bert_tiny()
+    model = bert.BertForQuestionAnswering(cfg)
+    rng = np.random.RandomState(0)
+    B, S = 3, 32
+    ids = rng.randint(0, cfg.vocab_size, size=(B, S)).astype(np.int32)
+    mask = np.ones((B, S), bool)
+    mask[0, 20:] = False
+    mask[1, 5:9] = False
+    mask[2, :] = False  # fully padded row (dataset-tail padding)
+    variables = model.init(jax.random.PRNGKey(0), ids, mask)
+
+    def fwd(cfg_):
+        m = bert.BertForQuestionAnswering(cfg_)
+        return m.apply(variables, ids, mask, deterministic=True)
+
+    flash_logits = fwd(cfg)
+    cfg_no_flash = bert.bert_tiny()
+    cfg_no_flash.use_flash = False
+    einsum_logits = fwd(cfg_no_flash)
+    for a, b in zip(jax.tree.leaves(flash_logits),
+                    jax.tree.leaves(einsum_logits)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=2e-2, atol=2e-2)

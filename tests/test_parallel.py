@@ -245,3 +245,17 @@ def test_flash_attention_lse_merge_identity(jax):
     want = reference_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(merged), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_longcontext_example_learns(jax):
+    """examples/longcontext: causal LM over ring+flash on a seq mesh
+    learns a periodic task that REQUIRES long-range attention."""
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    from examples.longcontext import long_dist
+
+    first, last = long_dist.train(
+        seq_len=256, batch=2, steps=15, hidden=32, heads=2, layers=1,
+        period=13, seq_devices=4, interpret=True, log_every=0)
+    assert last < first * 0.7, (first, last)

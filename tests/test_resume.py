@@ -1,0 +1,82 @@
+"""Cluster-level checkpoint/resume: the reference's recovery story.
+
+SURVEY.md §5 "Checkpoint / resume": recovery in the reference is
+resubmit-the-job + restore-latest from shared storage. Here: a first
+cluster.run trains and checkpoints (chief-only commit), a SECOND
+cluster.run — a fresh cluster id, fresh trainer processes — restores
+the latest step and continues from it. Proves the orbax round trip
+through real trainer process boundaries, not just in-process.
+"""
+
+import json
+import os
+import sys
+
+import cloudpickle
+
+from tensorflowonspark_tpu import cluster
+from tensorflowonspark_tpu.engine import Context
+
+# Executor processes cannot import this test module, so its functions
+# must ship by value (the engine's cloudpickle serializer honors this).
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+def _train_fun(args, ctx):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import checkpoint, training
+    from tensorflowonspark_tpu.models.lenet import LeNet
+
+    devices = ctx.initialize_jax()
+    mesh = ctx.mesh({"data": len(devices)})
+    trainer = training.Trainer(LeNet(num_classes=10),
+                               optax.sgd(0.01), mesh)
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 28, 28, 1).astype(np.float32)
+    y = (np.arange(16) % 10).astype(np.int64)
+    state = trainer.init(jax.random.PRNGKey(0), x)
+
+    ckpt = checkpoint.Checkpointer(args["dir"],
+                                   chief=ctx.job_name == "chief")
+    restored = ckpt.restore(state)
+    start_step = 0 if restored is None else int(restored["step"])
+    if restored is not None:
+        state = restored
+    for _ in range(args["steps"]):
+        state, metrics = trainer.step(state, {"x": x, "y": y})
+    jax.block_until_ready(metrics["loss"])
+    ckpt.save(int(state["step"]), state, force=True)
+    ckpt.wait()
+    ckpt.close()
+    with open(os.path.join(args["dir"], "run-%d.json" % args["run"]),
+              "w") as f:
+        json.dump({"start_step": start_step,
+                   "end_step": int(state["step"]),
+                   "loss": float(metrics["loss"])}, f)
+
+
+def test_cluster_resume_from_checkpoint(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpt")
+    os.makedirs(ckpt_dir)
+
+    for run in (1, 2):
+        sc = Context(num_executors=1,
+                     work_root=str(tmp_path / ("engine%d" % run)))
+        try:
+            tfc = cluster.run(sc, _train_fun,
+                              {"dir": ckpt_dir, "steps": 3, "run": run},
+                              num_executors=1,
+                              input_mode=cluster.InputMode.TENSORFLOW)
+            tfc.shutdown()
+        finally:
+            sc.stop()
+
+    r1 = json.load(open(os.path.join(ckpt_dir, "run-1.json")))
+    r2 = json.load(open(os.path.join(ckpt_dir, "run-2.json")))
+    assert r1["start_step"] == 0 and r1["end_step"] == 3
+    # the resubmitted job restored step 3 and continued to 6
+    assert r2["start_step"] == 3, r2
+    assert r2["end_step"] == 6, r2

@@ -92,3 +92,16 @@ RESNET_TP_RULES = (
     (r"Conv_\d+/kernel", (None, None, None, "model")),
     (r"Dense_\d+/kernel", (None, "model")),
 )
+
+
+#: TP rules for models/widedeep.py (BASELINE config #4 "ETL -> TPU
+#: embedding tables"): the fused categorical tables are the dominant
+#: params (hash_buckets x num_cat rows) — row-shard them over ``model``
+#: so each chip holds a table shard and XLA emits the gather/psum
+#: pattern; the first MLP pair follows the megatron up/down convention.
+WIDEDEEP_TP_RULES = (
+    (r"(deep|wide)_embeddings/embedding", ("model", None)),
+    (r"mlp_0/kernel", (None, "model")),
+    (r"mlp_0/bias", ("model",)),
+    (r"mlp_1/kernel", ("model", None)),
+)

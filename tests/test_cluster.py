@@ -137,3 +137,35 @@ def test_cluster_spec_shape(sc):
     assert info[0]["job_name"] == "chief"
     assert info[1]["job_name"] == "worker"
     tfc.shutdown()
+
+
+def test_ps_and_evaluator_roles(tmp_path):
+    """Role-template parity: num_ps and eval_node create ps/evaluator
+    nodes whose fns run with those job names, parked OUTSIDE the device
+    collective (they are not participants)."""
+    out = str(tmp_path / "roles")
+    os.makedirs(out)
+
+    def map_fun(args, ctx):
+        participants = [n["job_name"] for n in ctx.participants()]
+        with open(os.path.join(args["out"],
+                               "role-%d" % ctx.executor_id), "w") as f:
+            f.write("{}|{}".format(ctx.job_name, ",".join(participants)))
+
+    sc = Context(num_executors=3, work_root=str(tmp_path / "engine"))
+    try:
+        tfc = cluster.run(sc, map_fun, {"out": out}, num_executors=3,
+                          num_ps=1, eval_node=True,
+                          input_mode=cluster.InputMode.TENSORFLOW)
+        tfc.shutdown()
+    finally:
+        sc.stop()
+
+    roles = {}
+    for name in os.listdir(out):
+        job, parts = open(os.path.join(out, name)).read().split("|")
+        roles[job] = parts.split(",")
+    assert set(roles) == {"ps", "chief", "evaluator"}
+    # every node agrees: only the chief joins the device collective
+    for parts in roles.values():
+        assert parts == ["chief"], roles

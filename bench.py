@@ -140,15 +140,8 @@ def _cluster_fed_images_per_sec(transport, batch, image, steps, on_tpu):
             # 4 partitions, each a multiple of the device batch so no
             # short batches (and no recompiles) at partition boundaries
             per_part = -(-n_records // 4 // batch) * batch
-            parts = [
-                sc.parallelize(range(1), 1).mapPartitions(
-                    lambda _, i=i: iter(
-                        _synth_partition(per_part, image, seed=i)))
-                for i in range(4)
-            ]
-            rdd = parts[0]
-            for p in parts[1:]:
-                rdd = rdd.union(p)
+            rdd = sc.parallelize(range(4), 4).mapPartitionsWithIndex(
+                lambda i, _: iter(_synth_partition(per_part, image, seed=i)))
             tfc.train(rdd, num_epochs=1)
             tfc.shutdown()
         finally:

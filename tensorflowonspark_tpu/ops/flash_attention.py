@@ -405,29 +405,16 @@ def _flash_bwd(q, k, v, bias, out, lse, g, causal, scale, block_q,
             _unfold(dv, b, s_k, n, d))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def _flash(q, k, v, bias, causal, scale, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, bias, causal, scale, block_q, block_k,
-                        interpret)
+    """Output-only attention: _flash_pair with the lse discarded.
+
+    Differentiation flows through _flash_pair's custom_vjp; the unused
+    lse output contributes a zero cotangent (folded into delta at no
+    meaningful cost), so no second custom_vjp is needed.
+    """
+    out, _ = _flash_pair(q, k, v, bias, causal, scale, block_q, block_k,
+                         interpret)
     return out
-
-
-def _flash_vjp_fwd(q, k, v, bias, causal, scale, block_q, block_k,
-                   interpret):
-    out, lse = _flash_fwd(q, k, v, bias, causal, scale, block_q, block_k,
-                          interpret)
-    return out, (q, k, v, bias, out, lse)
-
-
-def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, residuals,
-                   g):
-    q, k, v, bias, out, lse = residuals
-    dq, dk, dv = _flash_bwd(q, k, v, bias, out, lse, g, causal, scale,
-                            block_q, block_k, interpret)
-    return dq, dk, dv, None
-
-
-_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))

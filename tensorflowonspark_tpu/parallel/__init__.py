@@ -16,3 +16,12 @@ from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401
     data_parallel_sharding,
     replicated_sharding,
 )
+
+__all__ = [
+    "build_mesh", "data_parallel_sharding", "replicated_sharding",
+    # submodules (imported lazily by users; listed for discoverability):
+    # .sharding   — TP rule catalogs (BERT/ResNet/WideDeep) + appliers
+    # .ring_attention — ring_attention / ring_flash_attention (SP)
+    # .pipeline   — GPipe microbatch pipeline_apply (PP)
+    # .moe        — expert-parallel moe_ffn (EP)
+]

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Single-chip perf sweep (BASELINE.md primary metric; run on a live TPU).
+# Each config runs in a fresh process (TPU single-owner discipline); the
+# fed plane is off here — this sweeps the device-step ceiling. Takes the
+# best cell to BASELINE.md "Measured results".
+set -u
+cd "$(dirname "$0")/.."
+for batch in 256 512 1024; do
+  for bn in float32 bfloat16; do
+    echo "=== batch=$batch bn_dtype=$bn ==="
+    TFOS_BENCH_FED=0 TFOS_BENCH_BATCH=$batch TFOS_BENCH_BN_DTYPE=$bn \
+      timeout 900 python bench.py 2>/dev/null | tail -1
+  done
+done

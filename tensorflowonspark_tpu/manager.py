@@ -143,11 +143,20 @@ class ManagerClient(object):
         return control.join(qname, timeout)
 
 
-#: Max chunks buffered per queue. Bounded so (a) a feeder ahead of the
-#: trainer backpressures instead of ballooning broker RAM, and (b) the
-#: queue.Full path in the feed closures (state checks, feed_timeout) is
-#: live. 64 chunks x FEED_CHUNK records is plenty of runway for overlap.
-QUEUE_MAXSIZE = 64
+#: Max chunks buffered per DATA (input-like) queue. Bounded so (a) a
+#: feeder ahead of the trainer backpressures instead of ballooning
+#: broker RAM, and (b) the queue.Full path in the feed closures (state
+#: checks, feed_timeout) is live. Sized for COLUMNAR chunks
+#: (node.FEED_CHUNK=256 records — a 224px uint8 image chunk is ~38MB):
+#: 16 chunks ≈ 600MB ceiling and ~16 device batches of runway.
+QUEUE_MAXSIZE = 16
+
+#: Output/error queues hold small result rows, not bulk frames, and the
+#: inference pattern feeds the WHOLE partition before draining results
+#: (node._inference) — so they get a deep bound: a shallow one would
+#: wedge trainer batch_results against the input backpressure until
+#: feed_timeout.
+RESULT_QUEUE_MAXSIZE = 256
 
 
 def start(authkey, queues, mode="local", host=None, maxsize=QUEUE_MAXSIZE):
@@ -162,7 +171,9 @@ def start(authkey, queues, mode="local", host=None, maxsize=QUEUE_MAXSIZE):
     processes are long-lived, so a daemon server thread suffices and dies
     with the node — one less orphan to reap on task retry.
     """
-    qdict = {name: _queue.Queue(maxsize=maxsize) for name in queues}
+    qdict = {name: _queue.Queue(
+        maxsize=RESULT_QUEUE_MAXSIZE if name in ("output", "error")
+        else maxsize) for name in queues}
     kv = _KV()
     kv.set("state", "running")
 

@@ -45,3 +45,17 @@ def test_cross_process_connect():
     p.join(timeout=30)
     assert p.exitcode == 0
     assert mgr.get("state") == "done"
+
+
+def test_per_role_queue_bounds():
+    """Data queues are shallow (bulk columnar chunks backpressure);
+    output/error are deep (small result rows; the inference pattern
+    feeds the whole partition before draining results)."""
+    from tensorflowonspark_tpu import manager as manager_lib
+
+    mgr = manager_lib.start(b"boundkey", ["input", "output", "error"])
+    assert mgr.get_queue("input").maxsize == manager_lib.QUEUE_MAXSIZE
+    assert mgr.get_queue("output").maxsize == \
+        manager_lib.RESULT_QUEUE_MAXSIZE
+    assert mgr.get_queue("error").maxsize == \
+        manager_lib.RESULT_QUEUE_MAXSIZE

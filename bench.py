@@ -147,7 +147,11 @@ def _cluster_fed_images_per_sec(transport, batch, image, steps, on_tpu):
         finally:
             sc.stop()
         with open(result_path) as f:
-            return json.load(f)["images_per_sec"]
+            result = json.load(f)
+        if os.environ.get("TFOS_BENCH_VERBOSE"):
+            print("cluster_fed[{}]: {}".format(transport, result),
+                  file=sys.stderr)
+        return result["images_per_sec"]
     except Exception as e:  # noqa: BLE001 - a broken transport reports None
         print("cluster_fed[{}] failed: {}".format(transport, e),
               file=sys.stderr)
@@ -277,11 +281,17 @@ def main():
         batch, image, steps, warmup, fed_steps = 256, 224, 30, 5, 12
     else:  # CPU smoke mode so the bench is runnable anywhere
         batch, image, steps, warmup, fed_steps = 16, 32, 5, 2, 4
-    try:
-        batch = int(os.environ.get("TFOS_BENCH_BATCH") or 0) or batch
-    except ValueError:
-        print("ignoring malformed TFOS_BENCH_BATCH={!r}".format(
-            os.environ["TFOS_BENCH_BATCH"]), file=sys.stderr)
+    def _env_int(name, default):
+        try:
+            return int(os.environ.get(name) or 0) or default
+        except ValueError:
+            print("ignoring malformed {}={!r}".format(
+                name, os.environ[name]), file=sys.stderr)
+            return default
+
+    batch = _env_int("TFOS_BENCH_BATCH", batch)
+    fed_steps = _env_int("TFOS_BENCH_FED_STEPS", fed_steps)
+    image = _env_int("TFOS_BENCH_IMAGE", image)
 
     # Fed runs first: the driver has not initialized jax yet, so the
     # trainer subprocesses are the chip's only owners.

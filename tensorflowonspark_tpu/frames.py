@@ -108,7 +108,13 @@ class ColumnarChunk(object):
 
 def concat(chunks):
     """Concatenate ColumnarChunks (one copy; used for batch re-slicing)."""
-    chunks = [c for c in chunks if len(c)]
+    nonempty = [c for c in chunks if len(c)]
+    if not nonempty:
+        # All-empty input: preserve the shape metadata of the first chunk
+        # so downstream column lookups still resolve.
+        first = chunks[0]
+        return ColumnarChunk(first.cols, first.names, first.scalar)
+    chunks = nonempty
     if len(chunks) == 1:
         return chunks[0]
     names = chunks[0].names

@@ -31,30 +31,56 @@ def prefetch(batch_iter, size=2, device_put=None):
     (default ``jax.device_put`` — leaves layout to JAX). The generator
     yields staged batches in order. Exceptions on the staging thread
     re-raise at the consuming ``next()``.
+
+    Closing the generator early (break, ``inference terminate()``, an
+    error in the consumer) cancels and joins the staging thread — a bare
+    ``buf.put`` there would strand the thread forever on a full queue,
+    holding staged device arrays, once per abandoned feed.
     """
     import jax
 
     put = device_put or jax.device_put
     buf = _queue.Queue(maxsize=size)
+    stop = threading.Event()
+
+    def _put(item):
+        """Bounded put that observes cancellation; False when cancelled."""
+        while not stop.is_set():
+            try:
+                buf.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
 
     def _stage():
         try:
             for batch in batch_iter:
-                buf.put(jax.tree.map(put, batch))
-            buf.put(_END)
+                if stop.is_set() or not _put(jax.tree.map(put, batch)):
+                    return
+            _put(_END)
         except BaseException as e:  # noqa: BLE001 - re-raised at next()
-            buf.put(e)
+            _put(e)
 
     t = threading.Thread(target=_stage, name="infeed-prefetch", daemon=True)
     t.start()
 
-    while True:
-        item = buf.get()
-        if item is _END:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    try:
+        while True:
+            item = buf.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        try:  # unblock a put-in-flight so the join below is prompt
+            while True:
+                buf.get_nowait()
+        except _queue.Empty:
+            pass
+        t.join(timeout=5.0)
 
 
 def sharded_batches(batch_iter, mesh, axis="data", size=2):

@@ -653,10 +653,7 @@ def _join_feed(mgr, qname, feed_timeout, on_error="return"):
 
     def _drained():
         if ring is not None:
-            if ring.pending() == 0:
-                return True
-            time.sleep(0.05)
-            return False
+            return ring.wait_drained(timeout=1.0)
         return mgr.join_queue(qname, 1.0)
 
     deadline = time.monotonic() + feed_timeout

@@ -79,6 +79,8 @@ def _load():
                                      ctypes.c_uint64, ctypes.c_int]
         lib.shmring_pending.restype = ctypes.c_uint64
         lib.shmring_pending.argtypes = [ctypes.c_void_p]
+        lib.shmring_wait_drained.restype = ctypes.c_int
+        lib.shmring_wait_drained.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.shmring_close.argtypes = [ctypes.c_void_p]
         lib.shmring_unlink.argtypes = [ctypes.c_char_p]
         _lib = lib
@@ -109,16 +111,29 @@ def default_capacity():
     back to the queue transport (tmpfs pages materialize lazily, so an
     oversized ring would SIGBUS the producer mid-feed, not fail create).
     """
+    want = 256 * 1024 * 1024
     env = os.environ.get("TFOS_SHM_CAPACITY")
     if env:
-        return int(env)
-    want = 256 * 1024 * 1024
+        want = int(env)
     try:
         st = os.statvfs("/dev/shm")
-        free = st.f_bavail * st.f_frsize
-        want = min(want, free // 2)
+        free_half = st.f_bavail * st.f_frsize // 2
+        if want > free_half:
+            # The env override is clamped too: tmpfs pages materialize
+            # lazily, so an oversized ring SIGBUSes the producer mid-feed
+            # instead of failing create — honoring the override verbatim
+            # would re-open exactly that hazard.
+            if env:
+                logger.warning(
+                    "TFOS_SHM_CAPACITY=%s exceeds half of /dev/shm free "
+                    "space; clamping to %d", env, free_half)
+            want = free_half
     except OSError:
         pass
+    # The env override does not bypass the uselessly-small floor either:
+    # a clamped-down ring whose max message (capacity/2) can't hold one
+    # record would fail mid-feed, whereas 0 makes node.py fall back to
+    # the queue transport cleanly.
     return want if want >= MIN_USEFUL_CAPACITY else 0
 
 
@@ -224,8 +239,13 @@ class ShmRing(object):
             return None, None
         view = _from_memory(ptr, out_len.value, _PyBUF_READ)
         n = out_len.value
+        done = [False]  # one-shot: a double release would advance the
+        # tail past an unconsumed message and desync the stream
 
-        def release(_lib=lib, _h=self._h, _n=n):
+        def release(_lib=lib, _h=self._h, _n=n, _done=done):
+            if _done[0]:
+                return
+            _done[0] = True
             _lib.shmring_advance(_h, _n)
 
         return view, release
@@ -233,6 +253,15 @@ class ShmRing(object):
     def pending(self):
         """Unconsumed bytes (0 == fully drained)."""
         return int(_load().shmring_pending(self._h))
+
+    def wait_drained(self, timeout=None):
+        """Block until the consumer drained everything; True if drained.
+
+        Futex-sleeps on the consumer's advance counter — the feeder's
+        partition join wakes the instant the trainer releases the last
+        message, instead of on a poll tick."""
+        return bool(_load().shmring_wait_drained(
+            self._h, -1 if timeout is None else int(timeout * 1000)))
 
     # -- object / frame API ------------------------------------------------
 

@@ -29,6 +29,40 @@ def test_ring_roundtrip_and_wraparound():
         ring.close()
 
 
+def test_ring_wait_drained():
+    ring = shm.ShmRing.create("/tfos-test-drain", capacity=1 << 16)
+    try:
+        assert ring.wait_drained(timeout=0.1)  # empty ring: already drained
+        ring.write(b"payload", timeout=1.0)
+        assert not ring.wait_drained(timeout=0.1)  # undrained: times out
+
+        def consume_later():
+            time.sleep(0.3)
+            ring.read(timeout=1.0)
+
+        import threading
+        t = threading.Thread(target=consume_later)
+        t0 = time.monotonic()
+        t.start()
+        # The futex wait must wake on the consumer's advance, well before
+        # its own 5s timeout and without a poll tick's worth of lag.
+        assert ring.wait_drained(timeout=5.0)
+        dt = time.monotonic() - t0
+        t.join()
+        assert 0.2 < dt < 2.0, dt
+        # release() one-shot guard: double release must not advance twice
+        ring.write(b"a", timeout=1.0)
+        ring.write(b"b", timeout=1.0)
+        view, release = ring.read_view(timeout=1.0)
+        assert bytes(view) == b"a"
+        release()
+        release()  # second call is a no-op, not a tail advance past "b"
+        assert ring.read(timeout=1.0) == b"b"
+    finally:
+        ring.unlink()
+        ring.close()
+
+
 def test_ring_backpressure_and_timeout():
     ring = shm.ShmRing.create("/tfos-test-bp", capacity=1 << 13)
     try:

@@ -40,6 +40,39 @@ def test_prefetch_order_and_error(jax):
         next(it)
 
 
+def test_prefetch_early_close_joins_staging_thread(jax):
+    """Abandoning the generator (inference terminate(), a consumer error)
+    must cancel the staging thread, not strand it on a full buffer."""
+    import threading
+    import time
+
+    from tensorflowonspark_tpu import infeed
+
+    produced = [0]
+
+    def endless():
+        while True:
+            produced[0] += 1
+            yield np.zeros((2,))
+
+    it = infeed.prefetch(endless(), size=2)
+    next(it)  # staging thread is now live and its buffer fills up
+    it.close()  # early exit: generator finalizer must join the thread
+
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if not any(t.name == "infeed-prefetch" and t.is_alive()
+                   for t in threading.enumerate()):
+            break
+        time.sleep(0.05)
+    leaked = [t.name for t in threading.enumerate()
+              if t.name == "infeed-prefetch" and t.is_alive()]
+    assert not leaked, leaked
+    n = produced[0]
+    time.sleep(0.2)
+    assert produced[0] == n  # production stopped, not just unobserved
+
+
 def test_sharded_batches_layout(jax):
     from tensorflowonspark_tpu import infeed
     from tensorflowonspark_tpu.parallel import build_mesh

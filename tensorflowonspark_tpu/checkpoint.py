@@ -1,12 +1,30 @@
-"""Training checkpoint/resume on orbax, with chief-only commit.
+"""Training checkpoint/resume on orbax: replicated AND sharded states.
 
 Reference behavior (SURVEY.md §5 "Checkpoint / resume"): the reference
 delegates checkpointing to TF (MonitoredTrainingSession / Keras callbacks
 writing to shared storage); recovery = resubmit + restore latest. The
-TPU-native analog is orbax-checkpoint with the same division of labor:
-the framework supplies a manager wired to the node's role (only the chief
-commits under pure DP, where state is replicated), user code decides when
-to save.
+TPU-native analog is orbax-checkpoint with the same division of labor —
+the framework wires the manager to the node's role, user code decides
+when to save — but the commit protocol depends on how the state is laid
+out, which the reference (pure DP only) never had to distinguish:
+
+- **Replicated state** (pure DP): every process holds identical bytes, so
+  only the chief commits and non-chief ``save()`` is a cheap no-op.
+- **Sharded state** (TP/PP/EP, or DP with a process-spanning global batch
+  axis): each process holds only its own shards. ALL processes must
+  participate in the orbax save (orbax gathers/coordinates internally via
+  ``jax.distributed``); a chief-only save would silently drop every
+  non-addressable shard and restore garbage. ``save()`` detects the
+  layout per call and picks the protocol — and *raises* on the one
+  combination that cannot be correct (a non-participating ``chief=False``
+  process holding non-replicated state with no distributed runtime to
+  coordinate through).
+
+Remote roots: orbax brings its own storage drivers (tensorstore), so a
+``gs://``-style root is passed through verbatim when
+``allow_remote=True``; the default is a loud local-path check
+(fs.require_local) because this image bundles no remote-FS client and a
+URL silently abspath'd into ``./gs:`` is the failure mode being blocked.
 """
 
 import logging
@@ -15,25 +33,55 @@ import os
 logger = logging.getLogger(__name__)
 
 
+def is_fully_replicated(state):
+    """True when every device array in ``state`` is fully replicated.
+
+    Host numpy arrays / scalars count as replicated (every process can
+    reconstruct them); a single non-replicated jax.Array makes the whole
+    state sharded for checkpoint-protocol purposes.
+    """
+    import jax
+
+    for leaf in jax.tree.leaves(state):
+        if isinstance(leaf, jax.Array):
+            try:
+                if not leaf.sharding.is_fully_replicated:
+                    return False
+            except AttributeError:  # non-standard array-likes: assume ok
+                pass
+    return True
+
+
 class Checkpointer(object):
     """Step-indexed train-state checkpoints under ``directory``.
 
     Args:
-      directory: checkpoint root (shared storage in multi-host setups).
-      chief: whether this process commits (``ctx.job_name`` in the master
-        family). Non-chief saves are no-ops, mirroring chief-only export.
+      directory: checkpoint root. Must be shared storage (NFS or a remote
+        scheme with ``allow_remote=True``) in multi-host setups.
+      chief: whether this node is in the master family (``ctx.job_name``).
+        Governs *replicated* saves only; sharded saves are all-process by
+        construction.
       max_to_keep: retention.
+      allow_remote: pass scheme'd roots (``gs://...``) straight to orbax/
+        tensorstore instead of rejecting them. The caller owns making sure
+        the scheme is one orbax's storage layer can actually serve.
     """
 
-    def __init__(self, directory, chief=True, max_to_keep=3):
+    def __init__(self, directory, chief=True, max_to_keep=3,
+                 allow_remote=False):
         import orbax.checkpoint as ocp
 
         from tensorflowonspark_tpu import fs
 
-        self.directory = os.path.abspath(
-            fs.require_local(directory, "checkpointing"))
+        if allow_remote and fs.scheme_of(directory) is not None:
+            self.directory = os.fspath(directory)
+            self._remote = True
+        else:
+            self.directory = os.path.abspath(
+                fs.require_local(directory, "checkpointing"))
+            self._remote = False
         self.chief = chief
-        if chief:
+        if chief and not self._remote:
             os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,
@@ -41,12 +89,35 @@ class Checkpointer(object):
                 max_to_keep=max_to_keep, create=chief))
 
     def save(self, step, state, force=False):
-        """Commit ``state`` at ``step`` (chief only); returns True if saved."""
-        if not self.chief:
-            return False
+        """Commit ``state`` at ``step``; returns True if this process saved.
+
+        Replicated state: chief commits, everyone else no-ops. Sharded
+        state: every process participates (orbax coordinates the
+        multi-process gather); a ``chief=False`` process that holds
+        non-replicated state *without* a distributed runtime raises —
+        its shards could never reach storage and the checkpoint would
+        restore garbage with no warning.
+        """
         import jax
         import orbax.checkpoint as ocp
 
+        replicated = is_fully_replicated(state)
+        if not self.chief and replicated and jax.process_count() == 1:
+            # The chief's bytes are ours too. Only safe to skip OUTSIDE a
+            # distributed runtime: orbax's save is a collective with
+            # global barriers under jax.distributed, so a non-chief that
+            # returned early there would strand the chief at the barrier.
+            # (Multi-process non-chief saves are write-free: orbax's
+            # primary-host logic commits once.)
+            return False
+        if not self.chief and not replicated and jax.process_count() == 1:
+            raise ValueError(
+                "Checkpointer(chief=False).save() got a non-replicated "
+                "(sharded) state in a single-process runtime: this "
+                "process's shards cannot reach the checkpoint and a "
+                "restore would return garbage. Sharded states need either "
+                "all processes saving under jax.distributed, or "
+                "chief=True in the single-process case.")
         state = jax.tree.map(lambda x: x, state)  # shallow copy
         saved = self._mgr.save(int(step), args=ocp.args.StandardSave(state),
                                force=force)
@@ -56,9 +127,12 @@ class Checkpointer(object):
         return self._mgr.latest_step()
 
     def restore(self, state_like, step=None):
-        """Restore into the structure of ``state_like`` (init-shaped state).
+        """Restore into the structure (and shardings) of ``state_like``.
 
-        Returns the restored state, or None if no checkpoint exists.
+        ``state_like`` is an init-shaped state; when its arrays carry
+        shardings (the TP/PP case), orbax restores each process's shards
+        in that layout. Returns the restored state, or None if no
+        checkpoint exists.
         """
         import orbax.checkpoint as ocp
 

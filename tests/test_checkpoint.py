@@ -1,0 +1,122 @@
+"""Checkpointer protocol tests: replicated vs sharded states.
+
+SURVEY.md §5 "Checkpoint / resume". The cluster-level resume round trip
+lives in test_resume.py; here the round-4 additions: TP-sharded states
+save/restore bitwise-correctly with their shardings (all-process orbax
+path), the chief=False garbage-restore trap raises, and remote roots are
+rejected unless explicitly allowed.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def jax():
+    import jax
+    return jax
+
+
+def _sharded_state(jax, mesh):
+    """A TP-shaped state: weight split over 'model', step replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    w = jax.device_put(
+        np.arange(8 * 16, dtype=np.float32).reshape(8, 16),
+        NamedSharding(mesh, PartitionSpec("model", None)))
+    step = jax.device_put(np.int32(7), NamedSharding(mesh, PartitionSpec()))
+    return {"w": w, "step": step}
+
+
+def test_sharded_save_restore_bitwise(jax, tmp_path):
+    from tensorflowonspark_tpu import checkpoint
+    from tensorflowonspark_tpu.parallel import build_mesh
+
+    mesh = build_mesh({"data": 2, "model": 4})
+    state = _sharded_state(jax, mesh)
+    assert not checkpoint.is_fully_replicated(state)
+
+    ckpt = checkpoint.Checkpointer(str(tmp_path / "ckpt"), chief=True)
+    assert ckpt.save(7, state)
+    ckpt.wait()
+    assert ckpt.latest_step() == 7
+
+    # restore into a zeros-shaped state_like CARRYING the shardings
+    like = jax.tree.map(
+        lambda x: jax.device_put(np.zeros_like(x), x.sharding), state)
+    restored = ckpt.restore(like)
+    ckpt.close()
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(state["w"]))
+    assert int(restored["step"]) == 7
+    # layout preserved: restored arrays are sharded the same way
+    assert restored["w"].sharding.is_equivalent_to(state["w"].sharding,
+                                                   ndim=2)
+
+
+def test_sharded_resume_continues_training(jax, tmp_path):
+    """Save mid-run, restore, take a step: the TP state must be usable,
+    not just byte-identical."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from tensorflowonspark_tpu import checkpoint
+    from tensorflowonspark_tpu.parallel import build_mesh
+
+    mesh = build_mesh({"data": 2, "model": 4})
+    spec = NamedSharding(mesh, PartitionSpec("model", None))
+    w = jax.device_put(np.ones((8, 4), np.float32), spec)
+
+    @jax.jit
+    def step(w):
+        return w * 2.0
+
+    w = step(w)  # -> 2.0 everywhere
+    ckpt = checkpoint.Checkpointer(str(tmp_path / "ckpt"), chief=True)
+    ckpt.save(1, {"w": w})
+    ckpt.wait()
+
+    like = {"w": jax.device_put(np.zeros((8, 4), np.float32), spec)}
+    restored = ckpt.restore(like)
+    ckpt.close()
+    out = step(restored["w"])  # resume: one more step on restored state
+    np.testing.assert_array_equal(np.asarray(out), np.full((8, 4), 4.0))
+
+
+def test_nonchief_sharded_single_process_raises(jax, tmp_path):
+    from tensorflowonspark_tpu import checkpoint
+    from tensorflowonspark_tpu.parallel import build_mesh
+
+    mesh = build_mesh({"data": 2, "model": 4})
+    state = _sharded_state(jax, mesh)
+    ckpt = checkpoint.Checkpointer(str(tmp_path / "ckpt"), chief=False)
+    with pytest.raises(ValueError, match="non-replicated"):
+        ckpt.save(0, state)
+    ckpt.close()
+
+
+def test_nonchief_replicated_is_noop(jax, tmp_path):
+    from tensorflowonspark_tpu import checkpoint
+
+    state = {"w": np.ones((4,), np.float32), "step": 3}
+    assert checkpoint.is_fully_replicated(state)
+    ckpt = checkpoint.Checkpointer(str(tmp_path / "ckpt"), chief=False)
+    assert ckpt.save(0, state) is False
+    assert ckpt.latest_step() is None
+    ckpt.close()
+
+
+def test_remote_root_rejected_unless_allowed(tmp_path):
+    from tensorflowonspark_tpu import checkpoint, fs
+
+    with pytest.raises(fs.UnsupportedSchemeError):
+        checkpoint.Checkpointer("hdfs://nn/ckpt", chief=True)
+    # allow_remote=True hands the URI to orbax verbatim; this image has
+    # no remote tensorstore driver, so just assert the path passes the
+    # fs guard and reaches orbax (which then errors its own way).
+    try:
+        checkpoint.Checkpointer("gs://bucket/ckpt", chief=True,
+                                allow_remote=True)
+    except fs.UnsupportedSchemeError:  # pragma: no cover
+        pytest.fail("allow_remote must bypass the local-path guard")
+    except Exception:
+        pass  # orbax/tensorstore's own error for an unreachable bucket

@@ -264,16 +264,40 @@ def _probe_platform():
     return lines[-1], None
 
 
+def _cpu_smoke_fallback():
+    """Re-run this bench pinned to CPU so an outage round still carries
+    fed-plane evidence (VERDICT r3: a dead tunnel must not zero the
+    artifact). Returns the smoke JSON dict or None."""
+    import subprocess
+    if os.environ.get("TFOS_BENCH_NO_FALLBACK"):
+        return None  # we ARE the fallback: never recurse
+    env = dict(os.environ,
+               TFOS_BENCH_NO_FALLBACK="1",
+               JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                          " --xla_force_host_platform_device_count=8").strip())
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=1800, env=env)
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 - fallback is best-effort
+        print("cpu smoke fallback failed: {}".format(e), file=sys.stderr)
+        return None
+
+
 def main():
     platform, probe_error = _probe_platform()
     if platform is None:
         # Keep the one-JSON-line contract even with a wedged device
         # backend (e.g. the TPU tunnel down): report the outage instead
-        # of dying with a stack trace or hanging the driver.
+        # of dying with a stack trace or hanging the driver — but still
+        # run the CPU smoke so the artifact carries fed-path evidence.
         print(json.dumps({
             "metric": "resnet50_cluster_fed_images_per_sec_per_chip",
             "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
             "error": probe_error,
+            "smoke": _cpu_smoke_fallback(),
         }))
         return
     on_tpu = platform != "cpu"

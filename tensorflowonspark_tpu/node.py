@@ -571,11 +571,13 @@ def _pack_chunk(records):
         return list(records)
 
 
-def _feed_partition(iterator, mgr, qname, feed_timeout):
+def _feed_partition(iterator, mgr, qname, feed_timeout, cancel=None):
     """Push one partition into ``qname`` as chunks + EndPartition; returns
     the record count. Shared by the train and inference feed closures.
     Transport is the shm ring when active (node bootstrap created it),
-    else the manager queue."""
+    else the manager queue. ``cancel`` (a ``threading.Event``) aborts the
+    feed between chunks — set by a concurrent consumer that failed, so a
+    background feeder never outlives its task."""
     ring = _feed_ring(qname)
     q = None if ring is not None else mgr.get_queue(qname)
 
@@ -591,6 +593,8 @@ def _feed_partition(iterator, mgr, qname, feed_timeout):
     for item in iterator:
         chunk.append(item)
         if len(chunk) >= FEED_CHUNK:
+            if cancel is not None and cancel.is_set():
+                raise RuntimeError("feed cancelled by consumer")
             put(_pack_chunk(chunk), deadline)
             count += len(chunk)
             chunk = []
@@ -707,32 +711,71 @@ def inference(cluster_info, cluster_meta, feed_timeout=600, qname="output"):
 
     def _inference(iterator):
         mgr = _get_manager(cluster_info, cluster_meta, _local_executor_id())
-        count = _feed_partition(iterator, mgr, "input", feed_timeout)
-        _join_feed(mgr, "input", feed_timeout, on_error="raise")
-        if count == 0:
-            return iter(())
+
+        # Feed in a background thread and drain results HERE, concurrently:
+        # feeding the whole partition before touching the output queue
+        # (the reference's order) wedges once BOTH bounded queues fill —
+        # trainer blocked on a full output queue, feeder blocked on a full
+        # input queue — and only feed_timeout breaks the embrace.
+        feed_state = {"count": None, "error": None}
+        cancel = threading.Event()
+
+        def _feed():
+            try:
+                n = _feed_partition(iterator, mgr, "input", feed_timeout,
+                                    cancel=cancel)
+                _join_feed(mgr, "input", feed_timeout, on_error="raise")
+                feed_state["count"] = n
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                feed_state["error"] = e
+
+        feeder = threading.Thread(target=_feed, name="inference-feed",
+                                  daemon=True)
+        feeder.start()
 
         q_out = mgr.get_queue(qname)
         results = []
         deadline = time.monotonic() + feed_timeout
-        while len(results) < count:
-            try:
-                batch = q_out.get(block=True, timeout=1.0)
-            except _queue.Empty:
-                if mgr.get("state") in ("error", "terminating", "stopped"):
-                    raise RuntimeError(
-                        "inference aborted: trainer terminated with {}/{} "
-                        "results delivered".format(len(results), count))
-                if time.monotonic() > deadline:
-                    raise RuntimeError("inference results timeout")
-                continue
-            q_out.task_done()
-            deadline = time.monotonic() + feed_timeout
-            if isinstance(batch, list):
-                results.extend(batch)
-            else:
-                results.append(batch)
-        return iter(results[:count])
+        try:
+            while True:
+                if feed_state["error"] is not None:
+                    raise feed_state["error"]
+                count = feed_state["count"]
+                if count is not None and len(results) >= count:
+                    break
+                try:
+                    batch = q_out.get(block=True, timeout=1.0)
+                except _queue.Empty:
+                    if mgr.get("state") in ("error", "terminating",
+                                            "stopped"):
+                        raise RuntimeError(
+                            "inference aborted: trainer terminated with "
+                            "{}/{} results delivered".format(
+                                len(results), count if count is not None
+                                else "?"))
+                    if count is None:
+                        # Feeding still in progress: its OWN per-put
+                        # deadline (_feed_partition) governs liveness.
+                        # The drain deadline arms once the feed is done,
+                        # preserving the pre-concurrency semantics for
+                        # trainers that emit only at partition end.
+                        deadline = time.monotonic() + feed_timeout
+                    elif time.monotonic() > deadline:
+                        raise RuntimeError("inference results timeout")
+                    continue
+                q_out.task_done()
+                deadline = time.monotonic() + feed_timeout
+                if isinstance(batch, list):
+                    results.extend(batch)
+                else:
+                    results.append(batch)
+        except BaseException:
+            cancel.set()  # the feeder must not outlive a failed task
+            raise
+        feeder.join()
+        if feed_state["error"] is not None:
+            raise feed_state["error"]
+        return iter(results[:feed_state["count"]])
 
     return _inference
 

@@ -76,6 +76,37 @@ def test_spark_mode_inference_roundtrip(sc):
     tfc.shutdown()
 
 
+def test_inference_deep_partition_no_wedge(sc):
+    """Results drain concurrently with feeding (ADVICE r3): a partition
+    deep enough to fill BOTH bounded queues (input 16 chunks x 256
+    records, output 256 result items) must stream through instead of
+    deadlocking trainer batch_results against feeder backpressure."""
+
+    def map_fun(args, ctx):
+        feed = ctx.get_data_feed(train_mode=False)
+        while not feed.should_stop():
+            batch = feed.next_batch(8)
+            if batch:
+                feed.batch_results([x + 1 for x in batch])
+
+    prev = os.environ.get("TFOS_FEED_TRANSPORT")
+    os.environ["TFOS_FEED_TRANSPORT"] = "queue"
+    try:
+        tfc = cluster.run(sc, map_fun, {}, num_executors=2,
+                          input_mode=cluster.InputMode.SPARK)
+        n = 8000  # > 16*256 buffered input + > 256 buffered result lists
+        data = sc.parallelize(range(n), 2)
+        results = tfc.inference(data, feed_timeout=60).collect()
+        assert len(results) == n
+        assert sorted(results) == [x + 1 for x in range(n)]
+        tfc.shutdown()
+    finally:
+        if prev is None:
+            os.environ.pop("TFOS_FEED_TRANSPORT", None)
+        else:
+            os.environ["TFOS_FEED_TRANSPORT"] = prev
+
+
 def test_tensorflow_mode_inline(sc, tmp_path):
     """InputMode.TENSORFLOW: fn runs inline; run() returns after barrier."""
     out_dir = str(tmp_path / "marks")

@@ -1,0 +1,127 @@
+"""Streaming e2e: DStream micro-batches through cluster.train.
+
+Reference capability (SURVEY.md §2 Cluster API row): ``TFCluster.train``
+accepts a Spark Streaming DStream and feeds each micro-batch through the
+same queue plane; ``shutdown(ssc)`` stops the stream before ending the
+feed (§3.5). VERDICT r3 task 6: prove it at cluster level — a real
+trainer consuming across intervals, plus clean shutdown mid-stream.
+"""
+
+import json
+import os
+import queue
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import cluster
+from tensorflowonspark_tpu.engine import Context
+from tensorflowonspark_tpu.engine.streaming import StreamingContext
+
+
+@pytest.fixture()
+def sc(tmp_path):
+    ctx = Context(num_executors=2, work_root=str(tmp_path / "engine"))
+    yield ctx
+    ctx.stop()
+
+
+def _make_summing_map_fun():
+    # nested so it pickles by value (executors can't import test modules)
+    def map_fun(args, ctx):
+        import json as _json
+        import os as _os
+        feed = ctx.get_data_feed(train_mode=True)
+        total = 0
+        count = 0
+        while not feed.should_stop():
+            batch = feed.next_batch(8)
+            total += sum(batch)
+            count += len(batch)
+        path = _os.path.join(args["out_dir"],
+                             "node-%d.json" % ctx.executor_id)
+        with open(path, "w") as f:
+            _json.dump({"total": total, "count": count}, f)
+    return map_fun
+
+
+def _totals(out_dir):
+    stats = [json.load(open(os.path.join(out_dir, f)))
+             for f in sorted(os.listdir(out_dir))]
+    return (sum(s["total"] for s in stats), sum(s["count"] for s in stats))
+
+
+def test_streaming_train_consumes_micro_batches(sc, tmp_path):
+    """Trainers consume records pushed across several stream intervals."""
+    out_dir = str(tmp_path / "sums")
+    os.makedirs(out_dir)
+
+    tfc = cluster.run(sc, _make_summing_map_fun(), {"out_dir": out_dir},
+                      num_executors=2, input_mode=cluster.InputMode.SPARK)
+    ssc = StreamingContext(sc, batch_interval=0.1)
+    rdd_queue = queue.Queue()
+    stream = ssc.queueStream(rdd_queue)
+    tfc.train(stream)  # registers the per-micro-batch feed
+    ssc.start()
+
+    # Three micro-batches arriving over time, like a live source would.
+    pushed = []
+    for i in range(3):
+        lo, hi = i * 20, (i + 1) * 20
+        rdd_queue.put(sc.parallelize(range(lo, hi), 2))
+        pushed.extend(range(lo, hi))
+        time.sleep(0.15)
+
+    tfc.shutdown(ssc)
+
+    total, count = _totals(out_dir)
+    assert count == len(pushed)
+    assert total == sum(pushed)
+
+
+def test_streaming_shutdown_mid_stream_drains_pending(sc, tmp_path):
+    """shutdown(ssc) mid-stream: queued micro-batches the loop never got
+    to poll are drained, not dropped, and the cluster closes cleanly."""
+    out_dir = str(tmp_path / "sums")
+    os.makedirs(out_dir)
+
+    tfc = cluster.run(sc, _make_summing_map_fun(), {"out_dir": out_dir},
+                      num_executors=2, input_mode=cluster.InputMode.SPARK)
+    # A long interval: the loop consumes the first batch then sleeps, so
+    # later pushes are still queued when shutdown lands mid-stream.
+    ssc = StreamingContext(sc, batch_interval=60.0)
+    rdd_queue = queue.Queue()
+    stream = ssc.queueStream(rdd_queue)
+    tfc.train(stream)
+    rdd_queue.put(sc.parallelize(range(10), 2))
+    ssc.start()
+    time.sleep(0.3)  # first poll happens; loop now sleeps out the interval
+    rdd_queue.put(sc.parallelize(range(10, 30), 2))
+    rdd_queue.put(sc.parallelize(range(30, 40), 2))
+
+    tfc.shutdown(ssc)
+
+    total, count = _totals(out_dir)
+    assert count == 40
+    assert total == sum(range(40))
+
+
+def test_streaming_micro_batch_error_surfaces_at_shutdown(sc, tmp_path):
+    """A trainer blowing up mid-stream surfaces on the driver at
+    shutdown(ssc) instead of hanging the stream loop."""
+
+    def map_fun(args, ctx):
+        feed = ctx.get_data_feed(train_mode=True)
+        feed.next_batch(1)
+        raise ValueError("stream boom")
+
+    tfc = cluster.run(sc, map_fun, {}, num_executors=2,
+                      input_mode=cluster.InputMode.SPARK)
+    ssc = StreamingContext(sc, batch_interval=0.1)
+    stream = ssc.queueStream([sc.parallelize(range(10), 2)])
+    tfc.train(stream)
+    ssc.start()
+    time.sleep(0.3)
+    with pytest.raises(RuntimeError) as err:
+        tfc.shutdown(ssc, grace_secs=1)
+    assert "boom" in str(err.value.__cause__ or err.value)

@@ -1,0 +1,93 @@
+"""TFRecord codec throughput: native C scan vs pure-python framing.
+
+Two regimes, mirroring the shipped pipelines:
+  - bulk: 10KB bytes payload per record (image shards) — framing/crc
+    dominates, parse is one feature lookup.
+  - dense: 40 floats + 1 label per record (criteo/W&D rows) — proto
+    walking dominates; read_batch is the production dense path.
+
+Prints one JSON line per (regime, path). Used to populate
+docs/feedpath.md-style evidence; run on the 1-core box with nothing
+else hot.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from tensorflowonspark_tpu import tfrecord
+
+
+def _time(fn, reps=3):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.monotonic()
+        fn()
+        best = min(best, time.monotonic() - t0)
+    return best
+
+
+def main(tmp="/tmp/tfos-tfrec-bench"):
+    os.makedirs(tmp, exist_ok=True)
+    rng = np.random.RandomState(0)
+
+    bulk = os.path.join(tmp, "bulk.tfrecord")
+    n_bulk = 2000
+    blob = rng.bytes(10240)
+    with tfrecord.TFRecordWriter(bulk) as w:
+        for i in range(n_bulk):
+            w.write(tfrecord.encode_example({"image": [blob], "label": [i]}))
+    bulk_bytes = os.path.getsize(bulk)
+
+    dense = os.path.join(tmp, "dense.tfrecord")
+    n_dense = 20000
+    feats = rng.rand(n_dense, 40).astype("float32")
+    with tfrecord.TFRecordWriter(dense) as w:
+        for i in range(n_dense):
+            w.write(tfrecord.encode_example(
+                {"dense": feats[i], "label": [i % 3]}))
+    dense_bytes = os.path.getsize(dense)
+
+    results = []
+    for use_native in (False, True):
+        tfrecord._NATIVE = use_native
+        label = "native" if use_native else "python"
+
+        dt = _time(lambda: sum(1 for _ in tfrecord.tfrecord_iterator(bulk)))
+        results.append({"regime": "bulk_iterate", "path": label,
+                        "records_per_sec": round(n_bulk / dt),
+                        "mb_per_sec": round(bulk_bytes / dt / 1e6, 1)})
+
+        dt = _time(lambda: sum(
+            1 for _ in tfrecord.read_examples(dense)))
+        results.append({"regime": "dense_parse", "path": label,
+                        "records_per_sec": round(n_dense / dt),
+                        "mb_per_sec": round(dense_bytes / dt / 1e6, 1)})
+
+        dt = _time(lambda: tfrecord.read_batch(
+            dense, {"dense": ("float32", 40), "label": ("int64", 1)}))
+        results.append({"regime": "dense_read_batch", "path": label,
+                        "records_per_sec": round(n_dense / dt),
+                        "mb_per_sec": round(dense_bytes / dt / 1e6, 1)})
+    tfrecord._NATIVE = None
+
+    for r in results:
+        print(json.dumps(r))
+    ratios = {}
+    for regime in ("bulk_iterate", "dense_parse", "dense_read_batch"):
+        py = next(r for r in results
+                  if r["regime"] == regime and r["path"] == "python")
+        nat = next(r for r in results
+                   if r["regime"] == regime and r["path"] == "native")
+        ratios[regime] = round(
+            nat["records_per_sec"] / py["records_per_sec"], 1)
+    print(json.dumps({"speedup_native_vs_python": ratios}))
+
+
+if __name__ == "__main__":
+    main()

@@ -169,7 +169,9 @@ def loadTFRecords(sc, input_dir, binary_features=(), num_partitions=None):
     # partitions: infer from the first file that actually has a record.
     first = None
     for path in files:
-        first = next(iter(tfrecord.tfrecord_iterator(path)), None)
+        # first_record: lazy single-record read — the native iterator
+        # would CRC-scan the entire shard just to infer the schema
+        first = tfrecord.first_record(path)
         if first is not None:
             break
     if first is None:

@@ -582,10 +582,12 @@ def _feed_partition(iterator, mgr, qname, feed_timeout, cancel=None):
     q = None if ring is not None else mgr.get_queue(qname)
 
     def put(obj, deadline):
+        if cancel is not None and cancel.is_set():
+            raise RuntimeError("feed cancelled by consumer")
         if ring is not None:
-            _ring_put(ring, obj, mgr, deadline)
+            _ring_put(ring, obj, mgr, deadline, cancel=cancel)
         else:
-            _bounded_put(q, obj, mgr, deadline)
+            _bounded_put(q, obj, mgr, deadline, cancel=cancel)
 
     deadline = time.monotonic() + feed_timeout
     chunk = []
@@ -593,8 +595,6 @@ def _feed_partition(iterator, mgr, qname, feed_timeout, cancel=None):
     for item in iterator:
         chunk.append(item)
         if len(chunk) >= FEED_CHUNK:
-            if cancel is not None and cancel.is_set():
-                raise RuntimeError("feed cancelled by consumer")
             put(_pack_chunk(chunk), deadline)
             count += len(chunk)
             chunk = []
@@ -613,7 +613,7 @@ def _feed_partition(iterator, mgr, qname, feed_timeout, cancel=None):
 _RING_WRITE_LOCK = threading.Lock()
 
 
-def _ring_put(ring, obj, mgr, deadline):
+def _ring_put(ring, obj, mgr, deadline, cancel=None):
     """shm-ring analog of _bounded_put: bounded writes + state checks.
 
     Frame-encodes once; retries move no bytes until space frees. A frame
@@ -629,6 +629,8 @@ def _ring_put(ring, obj, mgr, deadline):
                 ring.write_buffers(bufs, timeout=1.0)
             return
         except TimeoutError:
+            if cancel is not None and cancel.is_set():
+                raise RuntimeError("feed cancelled by consumer")
             if mgr.get("state") in ("terminating", "stopped", "error"):
                 raise RuntimeError("feed aborted: node is terminating")
             if time.monotonic() > deadline:
@@ -636,8 +638,10 @@ def _ring_put(ring, obj, mgr, deadline):
         except ValueError:
             if isinstance(obj, frames_lib.ColumnarChunk) and len(obj) > 1:
                 half = len(obj) // 2
-                _ring_put(ring, obj.slice(0, half), mgr, deadline)
-                _ring_put(ring, obj.slice(half, len(obj)), mgr, deadline)
+                _ring_put(ring, obj.slice(0, half), mgr, deadline,
+                          cancel=cancel)
+                _ring_put(ring, obj.slice(half, len(obj)), mgr, deadline,
+                          cancel=cancel)
                 return
             raise RuntimeError(
                 "feed record does not fit the shm ring; raise "
@@ -679,7 +683,7 @@ def _put_chunk(q, chunk, mgr, deadline):
     _bounded_put(q, list(chunk), mgr, deadline)
 
 
-def _bounded_put(q, item, mgr, deadline):
+def _bounded_put(q, item, mgr, deadline, cancel=None):
     """put with terminating-state + timeout checks (reference: abort if
     mgr state == 'terminating'; raise on feed_timeout -> task fail).
     The broker queues are bounded (manager.QUEUE_MAXSIZE), so queue.Full
@@ -694,6 +698,8 @@ def _bounded_put(q, item, mgr, deadline):
             q.put(item, block=True, timeout=1.0)
             return
         except _queue.Full:
+            if cancel is not None and cancel.is_set():
+                raise RuntimeError("feed cancelled by consumer")
             if mgr.get("state") in ("terminating", "stopped", "error"):
                 raise RuntimeError("feed aborted: node is terminating")
             if time.monotonic() > deadline:

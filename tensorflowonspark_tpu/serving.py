@@ -1,0 +1,226 @@
+"""Language-neutral model serving — the JVM/Scala inference API analog.
+
+Reference capability (SURVEY.md §2 L0 row): a Scala/JVM API so Spark
+jobs written in Scala could run inference against trained models. A JVM
+has no place in a TPU-native stack; the ecosystem-correct equivalent is
+the TF-Serving REST wire protocol, which is exactly what JVM Spark
+shops call from Scala (plain HTTP + JSON, no Python on the client):
+
+    GET  /v1/models/<name>            -> model status
+    GET  /v1/models/<name>/metadata   -> signature metadata
+    POST /v1/models/<name>:predict    -> {"instances": [...]} row format
+                                         or {"inputs": {...}} columnar
+
+Backed by the framework's export format (export.py): the exported
+``apply_fn`` + variables serve every request; one process owns the
+accelerator and requests serialize through it (the TPU single-owner
+rule, same as the trainer process).
+
+Start in-process (:class:`ModelServer`) or from a shell::
+
+    python -m tensorflowonspark_tpu.serving --model-dir EXPORT \
+        --name mnist --port 8501
+
+This is deliberately protocol-compatible with TF-Serving's REST surface
+for the predict/metadata paths a Spark-Scala client uses, so reference
+users' JVM-side HTTP code ports by changing the URL.
+"""
+
+import json
+import logging
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class _BadRequest(ValueError):
+    pass
+
+
+def _to_batch(payload, signature):
+    """TF-Serving request JSON -> {name: ndarray} batch dict."""
+    if not isinstance(payload, dict):
+        raise _BadRequest("request body must be a JSON object")
+    if "instances" in payload:
+        rows = payload["instances"]
+        if not isinstance(rows, list) or not rows:
+            raise _BadRequest("'instances' must be a non-empty list")
+        if isinstance(rows[0], dict):
+            names = rows[0].keys()
+            cols = {n: [] for n in names}
+            for i, row in enumerate(rows):
+                if not isinstance(row, dict) or row.keys() != names:
+                    raise _BadRequest(
+                        "instance %d keys differ from instance 0" % i)
+                for n in names:
+                    cols[n].append(row[n])
+        else:
+            # single unnamed input: take the signature's (or 'x')
+            inputs = signature.get("inputs") or ["x"]
+            if len(inputs) != 1:
+                raise _BadRequest(
+                    "unnamed instances need a single-input signature")
+            cols = {inputs[0]: rows}
+        return {n: np.asarray(v) for n, v in cols.items()}
+    if "inputs" in payload:
+        cols = payload["inputs"]
+        if isinstance(cols, dict):
+            return {n: np.asarray(v) for n, v in cols.items()}
+        inputs = signature.get("inputs") or ["x"]
+        if len(inputs) != 1:
+            raise _BadRequest("unnamed inputs need a single-input signature")
+        return {inputs[0]: np.asarray(cols)}
+    raise _BadRequest("request needs 'instances' or 'inputs'")
+
+
+def _to_json(outputs, row_format):
+    """apply_fn outputs -> TF-Serving response dict."""
+    def listify(x):
+        return np.asarray(x).tolist()
+
+    if isinstance(outputs, dict):
+        cols = {k: listify(v) for k, v in outputs.items()}
+    elif isinstance(outputs, (tuple, list)):
+        cols = {"output_%d" % i: listify(v) for i, v in enumerate(outputs)}
+    else:
+        cols = {"output": listify(outputs)}
+    if not row_format:
+        return {"outputs": cols if len(cols) > 1
+                else next(iter(cols.values()))}
+    names = list(cols)
+    n = len(cols[names[0]])
+    if len(names) == 1:
+        return {"predictions": cols[names[0]]}
+    return {"predictions": [
+        {name: cols[name][i] for name in names} for i in range(n)]}
+
+
+class ModelServer(object):
+    """HTTP server exposing one exported model, TF-Serving REST shaped."""
+
+    def __init__(self, model_dir, name="model", host="127.0.0.1", port=8501):
+        from tensorflowonspark_tpu import export as export_lib
+
+        apply_fn, variables, signature = export_lib.load_model(model_dir)
+        self.name = name
+        self.signature = signature or {}
+        self._apply = apply_fn
+        self._variables = variables
+        self._lock = threading.Lock()  # one owner: requests serialize
+        self._httpd = None
+        self._thread = None
+        self._host, self._port = host, port
+
+    # -- request handling ------------------------------------------------
+
+    def predict(self, payload):
+        """{'instances'|'inputs': ...} -> TF-Serving response dict."""
+        row_format = "instances" in payload
+        batch = _to_batch(payload, self.signature)
+        with self._lock:
+            outputs = self._apply(self._variables, batch)
+        return _to_json(outputs, row_format)
+
+    def metadata(self):
+        return {"model_spec": {"name": self.name,
+                               "signature_name": "serving_default"},
+                "metadata": {"signature_def": self.signature,
+                             "format": "tfos-tpu-export-v1"}}
+
+    def status(self):
+        return {"model_version_status": [{
+            "version": "1", "state": "AVAILABLE",
+            "status": {"error_code": "OK", "error_message": ""}}]}
+
+    # -- http plumbing ---------------------------------------------------
+
+    def start(self):
+        """Start serving in a daemon thread; returns (host, port)."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code, obj):
+                body = json.dumps(obj).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                base = "/v1/models/%s" % server.name
+                if self.path == base:
+                    return self._send(200, server.status())
+                if self.path == base + "/metadata":
+                    return self._send(200, server.metadata())
+                return self._send(404, {"error": "not found: %s" % self.path})
+
+            def do_POST(self):
+                if self.path != "/v1/models/%s:predict" % server.name:
+                    return self._send(404,
+                                      {"error": "not found: %s" % self.path})
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    return self._send(200, server.predict(payload))
+                except _BadRequest as e:
+                    return self._send(400, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 - surface as 500
+                    logger.exception("predict failed")
+                    return self._send(500, {"error": str(e)})
+
+            def log_message(self, fmt, *args):  # quiet by default
+                logger.debug("serving: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tfos-serving",
+            daemon=True)
+        self._thread.start()
+        logger.info("serving %r on %s:%d", self.name, self._host, self._port)
+        return self._host, self._port
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._thread.join(timeout=10)
+            self._httpd = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Serve an exported model over TF-Serving-shaped REST")
+    ap.add_argument("--model-dir", required=True)
+    ap.add_argument("--name", default="model")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8501)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = ModelServer(args.model_dir, name=args.name,
+                         host=args.host, port=args.port)
+    host, port = server.start()
+    print("serving %s at http://%s:%d/v1/models/%s" % (
+        args.model_dir, host, port, args.name))
+    try:
+        server._thread.join()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

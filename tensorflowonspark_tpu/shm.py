@@ -41,10 +41,20 @@ _PyBUF_READ = 0x100
 
 
 def _build():
-    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", _SO + ".tmp",
+    # per-pid temp: concurrent executor processes all lazily build; a
+    # shared .tmp would tear and the mtime guard would then pin the torn
+    # .so forever. os.replace of complete files is atomic either way.
+    tmp = "{}.{}.tmp".format(_SO, os.getpid())
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp,
            _SRC, "-lrt", "-pthread"]
-    subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(_SO + ".tmp", _SO)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, _SO)
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
 
 
 def _load():

@@ -19,6 +19,7 @@ The proto wire codec below is hand-rolled for exactly this fixed schema
 ``google_crc32c`` when present, else a pure-python table fallback.
 """
 
+import io
 import os
 import struct
 
@@ -55,6 +56,24 @@ def masked_crc32c(data):
 
 _U64 = struct.Struct("<Q")
 _U32 = struct.Struct("<I")
+
+_NATIVE = None  # tri-state: None = unprobed
+
+
+def _native_ok():
+    """Native codec availability, probed once (TFOS_TFRECORD_NATIVE=0
+    opts out)."""
+    global _NATIVE
+    if _NATIVE is None:
+        if os.environ.get("TFOS_TFRECORD_NATIVE", "1") != "1":
+            _NATIVE = False
+        else:
+            try:
+                from tensorflowonspark_tpu import _tfrecord_native
+                _NATIVE = _tfrecord_native.available()
+            except Exception:  # noqa: BLE001 - pure python remains
+                _NATIVE = False
+    return _NATIVE
 
 
 class TFRecordWriter(object):
@@ -103,34 +122,79 @@ def _read_exact(f, n):
     return b"".join(chunks)
 
 
+def _try_mmap(f):
+    """mmap of an open local REGULAR file for the native scan, or None.
+
+    None means "not mmap-able" — sockets/pipes/remote streams (a socket's
+    fileno fstats as size 0, which must not read as an empty file) and
+    openers without a usable fileno. ``f`` is NOT closed either way, so a
+    one-shot stream opener keeps its handle for the streaming fallback."""
+    import mmap
+    import stat as stat_mod
+
+    try:
+        st = os.fstat(f.fileno())
+        if not stat_mod.S_ISREG(st.st_mode):
+            return None
+        if st.st_size == 0:
+            return b""
+        return mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    except (AttributeError, OSError, ValueError, io.UnsupportedOperation):
+        return None
+
+
 def tfrecord_iterator(path, verify_crc=True):
     """Yield raw record bytes from a TFRecord file (fs registry handles
-    remote schemes)."""
+    remote schemes).
+
+    Fast path: when the native codec builds (``_tfrecord_native``) AND
+    the path is a local regular file, the file is mmapped and framing +
+    both CRCs are validated in one C scan before the first yield,
+    producing zero-copy payload views. Note the eagerness tradeoff: the
+    whole file is validated up front, so consuming only the first
+    records of a huge file is cheaper via :func:`first_record` or the
+    python loop below — which remains the canonical fallback and the
+    only remote-stream path (it never buffers the file in RAM)."""
     from tensorflowonspark_tpu import fs
-    with fs.open(path, "rb") as f:
-        while True:
-            header = _read_exact(f, 8)
-            if not header:
-                return
-            if len(header) < 8:
-                raise ValueError("truncated TFRecord length header")
-            (length,) = _U64.unpack(header)
-            crc_bytes = _read_exact(f, 4)
-            if len(crc_bytes) < 4:
-                raise ValueError("truncated TFRecord length crc")
-            (length_crc,) = _U32.unpack(crc_bytes)
-            if verify_crc and masked_crc32c(header) != length_crc:
-                raise ValueError("corrupt TFRecord: bad length crc")
-            data = _read_exact(f, length)
-            if len(data) < length:
-                raise ValueError("truncated TFRecord payload")
-            crc_bytes = _read_exact(f, 4)
-            if len(crc_bytes) < 4:
-                raise ValueError("truncated TFRecord data crc")
-            (data_crc,) = _U32.unpack(crc_bytes)
-            if verify_crc and masked_crc32c(data) != data_crc:
-                raise ValueError("corrupt TFRecord: bad data crc")
+    f = fs.open(path, "rb")
+    buf = _try_mmap(f) if _native_ok() else None
+    if buf is not None:
+        from tensorflowonspark_tpu import _tfrecord_native
+        f.close()
+        for view in _tfrecord_native.iter_records(buf, verify_crc):
+            yield view
+        return
+    with f:
+        for data in _iter_stream(f, verify_crc):
             yield data
+
+
+def _iter_stream(f, verify_crc):
+    """The lazy per-record loop over an OPEN stream (never buffers the
+    file; the only path for non-mmap-able remote streams)."""
+    while True:
+        header = _read_exact(f, 8)
+        if not header:
+            return
+        if len(header) < 8:
+            raise ValueError("truncated TFRecord length header")
+        (length,) = _U64.unpack(header)
+        crc_bytes = _read_exact(f, 4)
+        if len(crc_bytes) < 4:
+            raise ValueError("truncated TFRecord length crc")
+        (length_crc,) = _U32.unpack(crc_bytes)
+        if verify_crc and masked_crc32c(header) != length_crc:
+            raise ValueError("corrupt TFRecord: bad length crc")
+        data = _read_exact(f, length)
+        if len(data) < length:
+            raise ValueError("truncated TFRecord payload")
+        crc_bytes = _read_exact(f, 4)
+        if len(crc_bytes) < 4:
+            raise ValueError("truncated TFRecord data crc")
+        (data_crc,) = _U32.unpack(crc_bytes)
+        if verify_crc and masked_crc32c(data) != data_crc:
+            raise ValueError("corrupt TFRecord: bad data crc")
+        yield data
 
 
 # -- protobuf wire primitives ---------------------------------------------
@@ -308,7 +372,9 @@ def parse_example(data):
             feat = ("empty", [])
             for ef, ew, ev in _iter_fields(entry):
                 if ef == 1:
-                    name = ev.decode("utf-8")
+                    # bytes() no-ops on bytes; the native iterator hands
+                    # zero-copy memoryviews through here
+                    name = bytes(ev).decode("utf-8")
                 elif ef == 2:
                     feat = _decode_feature(ev)
             if name is not None:
@@ -329,10 +395,70 @@ def write_tfrecords(path, examples, compress=False):
     return count
 
 
+def first_record(path, verify_crc=True):
+    """First record's bytes (or None if the file is empty) via the LAZY
+    streaming loop — O(one record) of I/O regardless of file size, where
+    the native :func:`tfrecord_iterator` path would CRC-scan the whole
+    file before yielding. The schema-inference read (dfutil) wants this."""
+    from tensorflowonspark_tpu import fs
+    with fs.open(path, "rb") as f:
+        return next(_iter_stream(f, verify_crc), None)
+
+
 def read_examples(path):
     """Yield parsed {name: (kind, values)} dicts from a TFRecord file."""
     for record in tfrecord_iterator(path):
         yield parse_example(record)
+
+
+def read_batch(path, schema, verify_crc=True):
+    """Dense columnar read: ``{name: ndarray[m, width]}`` for a fixed
+    schema, in file order.
+
+    ``schema``: ``{feature_name: (dtype, width)}`` with dtype
+    ``"float32"``/``"int64"`` — the dense-features shape of the W&D /
+    Criteo pipelines, where per-record python parsing dominates load
+    time. Uses the native batch decoder when available; falls back to
+    :func:`parse_example`. Raises ``ValueError`` when a record misses a
+    feature or its arity differs (a dense schema is a contract, not a
+    hint).
+    """
+    for name, (dtype, width) in schema.items():
+        if dtype not in ("float32", "int64"):
+            raise ValueError(
+                "schema dtype for %r must be float32 or int64" % name)
+    if _native_ok():
+        from tensorflowonspark_tpu import _tfrecord_native
+        from tensorflowonspark_tpu import fs
+        with fs.open(path, "rb") as f:
+            buf = _try_mmap(f)
+        if buf is not None:
+            offsets, lengths = _tfrecord_native.index_buffer(buf, verify_crc)
+            out = {}
+            for name, (dtype, width) in schema.items():
+                fn = (_tfrecord_native.batch_floats if dtype == "float32"
+                      else _tfrecord_native.batch_int64)
+                out[name] = fn(buf, offsets, lengths, name, width)
+            return out
+    columns = {name: [] for name in schema}
+    for i, parsed in enumerate(read_examples(path)):
+        for name, (dtype, width) in schema.items():
+            if name not in parsed:
+                raise ValueError(
+                    "record %d: feature %r missing, wrong kind, or not "
+                    "%d values" % (i, name, width))
+            kind, values = parsed[name]
+            expect = "float" if dtype == "float32" else "int64"
+            if kind != expect or len(values) != width:
+                raise ValueError(
+                    "record %d: feature %r missing, wrong kind, or not "
+                    "%d values" % (i, name, width))
+            columns[name].append(values)
+    return {name: np.asarray(columns[name],
+                             "float32" if schema[name][0] == "float32"
+                             else "int64").reshape(len(columns[name]),
+                                                   schema[name][1])
+            for name in schema}
 
 
 def list_tfrecord_files(directory):

@@ -1,0 +1,117 @@
+"""Language-neutral serving endpoint (serving.py) — the L0 JVM-API analog.
+
+Reference: the Scala inference API let JVM Spark jobs run inference; the
+TPU-native replacement is TF-Serving-shaped REST (SURVEY.md §2 L0 row),
+callable from Scala/Java with plain HTTP. These tests speak raw HTTP via
+urllib — exactly what a non-Python client does.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import export, serving
+
+
+@pytest.fixture()
+def server(tmp_path):
+    def apply_fn(variables, batch):
+        return {"y": batch["x"] @ variables["w"] + variables["b"]}
+
+    variables = {"w": jnp.asarray([[2.0], [1.0]]), "b": jnp.asarray([1.0])}
+    d = str(tmp_path / "export")
+    export.save_model(d, apply_fn, variables,
+                      signature={"inputs": ["x"], "outputs": ["y"]})
+    with serving.ModelServer(d, name="lin", port=0) as srv:
+        host, port = srv._host, srv._port
+        yield "http://%s:%d" % (host, port)
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_status_and_metadata(server):
+    code, status = _get(server + "/v1/models/lin")
+    assert code == 200
+    assert status["model_version_status"][0]["state"] == "AVAILABLE"
+
+    code, meta = _get(server + "/v1/models/lin/metadata")
+    assert code == 200
+    assert meta["model_spec"]["name"] == "lin"
+    assert meta["metadata"]["signature_def"]["inputs"] == ["x"]
+
+
+def test_predict_row_format(server):
+    # TF-Serving row format: named instance dicts
+    code, out = _post(server + "/v1/models/lin:predict",
+                      {"instances": [{"x": [1.0, 2.0]}, {"x": [3.0, 0.0]}]})
+    assert code == 200
+    np.testing.assert_allclose(out["predictions"], [[5.0], [7.0]])
+
+    # unnamed instances resolve through the single-input signature
+    code, out = _post(server + "/v1/models/lin:predict",
+                      {"instances": [[1.0, 2.0], [3.0, 0.0]]})
+    assert code == 200
+    np.testing.assert_allclose(out["predictions"], [[5.0], [7.0]])
+
+
+def test_predict_columnar_format(server):
+    code, out = _post(server + "/v1/models/lin:predict",
+                      {"inputs": {"x": [[1.0, 2.0], [0.0, 1.0]]}})
+    assert code == 200
+    np.testing.assert_allclose(out["outputs"], [[5.0], [2.0]])
+
+
+def test_predict_bad_request(server):
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(server + "/v1/models/lin:predict", {"wrong": 1})
+    assert err.value.code == 400
+    body = json.loads(err.value.read())
+    assert "instances" in body["error"]
+
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(server + "/v1/models/lin:predict",
+              {"instances": [{"x": [1.0]}, {"z": [1.0]}]})
+    assert err.value.code == 400
+
+
+def test_unknown_model_404(server):
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(server + "/v1/models/nope/metadata")
+    assert err.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(server + "/v1/models/nope:predict", {"instances": [[1.0]]})
+    assert err.value.code == 404
+
+
+def test_concurrent_predicts(server):
+    """The single-owner lock serializes; concurrent clients all succeed."""
+    import threading
+
+    results = []
+
+    def call(i):
+        _, out = _post(server + "/v1/models/lin:predict",
+                       {"instances": [[float(i), 0.0]]})
+        results.append((i, out["predictions"][0][0]))
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(results) == [(i, 2.0 * i + 1.0) for i in range(8)]

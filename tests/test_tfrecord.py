@@ -100,6 +100,153 @@ def test_corruption_detected(tmp_path):
         list(tfrecord.tfrecord_iterator(path))
 
 
+def test_native_codec_available():
+    """The C codec must build on this image (g++ is baked in); elsewhere
+    the pure-python path is the documented degradation."""
+    from tensorflowonspark_tpu import _tfrecord_native
+    assert _tfrecord_native.available()
+
+
+def test_native_crc_matches_python():
+    from tensorflowonspark_tpu import _tfrecord_native
+    for blob in (b"", b"a", bytes(range(256)) * 3, b"x" * 999,
+                 b"\x00" * 64):
+        assert _tfrecord_native.masked_crc32c(blob) == \
+            tfrecord.masked_crc32c(blob), blob[:8]
+
+
+def test_native_iterator_matches_python(tmp_path, monkeypatch):
+    """Both read paths yield byte-identical records."""
+    path = str(tmp_path / "x.tfrecord")
+    with tfrecord.TFRecordWriter(path) as w:
+        for i in range(20):
+            w.write(tfrecord.encode_example(
+                {"i": [i], "w": [0.5 * i], "s": [b"r%d" % i]}))
+    monkeypatch.setattr(tfrecord, "_NATIVE", True)
+    native = [bytes(r) for r in tfrecord.tfrecord_iterator(path)]
+    monkeypatch.setattr(tfrecord, "_NATIVE", False)
+    pure = [bytes(r) for r in tfrecord.tfrecord_iterator(path)]
+    assert native == pure
+    assert len(native) == 20
+
+
+def test_native_corruption_and_truncation(tmp_path, monkeypatch):
+    monkeypatch.setattr(tfrecord, "_NATIVE", True)
+    path = str(tmp_path / "x.tfrecord")
+    with tfrecord.TFRecordWriter(path) as w:
+        w.write(b"payload-bytes")
+    raw = open(path, "rb").read()
+
+    bad = bytearray(raw)
+    bad[14] ^= 0xFF  # payload byte -> data crc mismatch
+    open(path, "wb").write(bytes(bad))
+    with pytest.raises(ValueError, match="crc"):
+        list(tfrecord.tfrecord_iterator(path))
+
+    bad = bytearray(raw)
+    bad[9] ^= 0xFF  # length crc itself
+    open(path, "wb").write(bytes(bad))
+    with pytest.raises(ValueError, match="crc"):
+        list(tfrecord.tfrecord_iterator(path))
+
+    open(path, "wb").write(raw[:-2])  # truncated trailing crc
+    with pytest.raises(ValueError, match="[Tt]runcat"):
+        list(tfrecord.tfrecord_iterator(path))
+
+
+def test_read_batch_dense_schema(tmp_path, monkeypatch):
+    """read_batch: native and pure python agree, and a dense-schema
+    violation raises on both paths."""
+    path = str(tmp_path / "dense.tfrecord")
+    with tfrecord.TFRecordWriter(path) as w:
+        for i in range(32):
+            w.write(tfrecord.encode_example(
+                {"dense": [float(i), i + 0.5, -i * 2.0],
+                 "label": [i % 3]}))
+    schema = {"dense": ("float32", 3), "label": ("int64", 1)}
+
+    monkeypatch.setattr(tfrecord, "_NATIVE", True)
+    native = tfrecord.read_batch(path, schema)
+    monkeypatch.setattr(tfrecord, "_NATIVE", False)
+    pure = tfrecord.read_batch(path, schema)
+    for name in schema:
+        np.testing.assert_array_equal(native[name], pure[name])
+    assert native["dense"].shape == (32, 3)
+    assert native["dense"].dtype == np.float32
+    assert native["label"].dtype == np.int64
+    assert native["label"][5, 0] == 5 % 3
+
+    for use_native in (True, False):
+        monkeypatch.setattr(tfrecord, "_NATIVE", use_native)
+        with pytest.raises(ValueError, match="feature"):
+            tfrecord.read_batch(path, {"dense": ("float32", 4),
+                                       "label": ("int64", 1)})
+        with pytest.raises(ValueError, match="feature"):
+            tfrecord.read_batch(path, {"missing": ("int64", 1)})
+
+
+def test_pipe_backed_stream_uses_streaming_path(tmp_path):
+    """A non-regular-file opener (pipe: fileno fstats size 0) must NOT
+    read as an empty file via the native mmap path — it streams."""
+    import os as _os
+    import threading
+
+    from tensorflowonspark_tpu import fs
+
+    payload_buf = []
+    with tfrecord.TFRecordWriter(str(tmp_path / "t.tfrecord")) as w:
+        w.write(tfrecord.encode_example({"i": [41]}))
+        w.write(tfrecord.encode_example({"i": [42]}))
+    payload = open(str(tmp_path / "t.tfrecord"), "rb").read()
+    payload_buf.append(payload)
+
+    r, w_fd = _os.pipe()
+
+    def _writer():
+        _os.write(w_fd, payload)
+        _os.close(w_fd)
+
+    t = threading.Thread(target=_writer)
+    t.start()
+    fs.register_filesystem("pipe", lambda p, m: _os.fdopen(r, "rb"))
+    try:
+        rows = list(tfrecord.read_examples("pipe://x"))
+    finally:
+        fs.unregister_filesystem("pipe")
+        t.join()
+    assert [row["i"][1][0] for row in rows] == [41, 42]
+
+
+def test_first_record_lazy(tmp_path):
+    path = str(tmp_path / "f.tfrecord")
+    with tfrecord.TFRecordWriter(path) as w:
+        for i in range(5):
+            w.write(tfrecord.encode_example({"i": [i]}))
+    first = tfrecord.first_record(path)
+    assert tfrecord.parse_example(first)["i"] == ("int64", [0])
+    open(path, "wb").write(b"")
+    assert tfrecord.first_record(path) is None
+
+
+def test_read_batch_tf_written_file(tmp_path):
+    """Native batch decode reads TF-written packed/unpacked wire forms."""
+    _tf = tf()
+    path = str(tmp_path / "tfw.tfrecord")
+    with _tf.io.TFRecordWriter(path) as w:
+        for i in range(6):
+            ex = _tf.train.Example(features=_tf.train.Features(feature={
+                "f": _tf.train.Feature(float_list=_tf.train.FloatList(
+                    value=[i * 1.0, i * 2.0])),
+                "l": _tf.train.Feature(int64_list=_tf.train.Int64List(
+                    value=[i, -i, 3_000_000_000 + i]))}))
+            w.write(ex.SerializeToString())
+    out = tfrecord.read_batch(path, {"f": ("float32", 2),
+                                     "l": ("int64", 3)})
+    np.testing.assert_allclose(out["f"][:, 1], np.arange(6) * 2.0)
+    assert out["l"][4, 2] == 3_000_000_004
+    assert out["l"][3, 1] == -3
+
+
 def test_dfutil_string_arrays_and_empty_parts(tmp_path, request):
     """array<string> round-trips; empty part files don't break schema
     inference; variable-length under scalar dtype raises."""

@@ -36,6 +36,20 @@ smoke:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PYTHON) bench.py
 
+# Everything that needs the real chip, in priority order (VERDICT r3):
+# fed bench -> device sweep -> flash kernels on Mosaic -> step analysis.
+# Run the moment the tunnel serves compute; each stage appends to
+# .onchip/ so a mid-run outage keeps earlier results.
+onchip:
+	mkdir -p .onchip
+	TFOS_BENCH_VERBOSE=1 $(PYTHON) bench.py 2>.onchip/bench.stderr \
+	  | tee .onchip/bench.json
+	bash scripts/perf_sweep.sh 2>&1 | tee .onchip/sweep.txt
+	$(PYTHON) scripts/flash_on_chip.py 2>.onchip/flash.stderr \
+	  | tee .onchip/flash.json
+	$(PYTHON) scripts/perf_analysis.py --batch 256 \
+	  --trace .onchip/trace 2>/dev/null | tee .onchip/perf_analysis.json
+
 clean:
 	rm -f tensorflowonspark_tpu/_libshmring.so
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -39,18 +39,26 @@ _DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8, "s32": 4,
 
 
 def _allreduce_bytes(hlo_text):
-    """Sum output bytes of every all-reduce in the compiled HLO."""
+    """Sum output bytes of every all-reduce in the compiled HLO.
+
+    XLA bundles gradients: an op's output is often a TUPLE of shapes
+    ('%ar = (f32[64]{0}, f32[9,9,3,64]{...}) all-reduce(...)'), so every
+    element must be counted, not just the first — undercounting would
+    overstate the very efficiency this model exists to bound."""
     total = 0
     ops = 0
-    # e.g.:  %all-reduce.1 = f32[2048,1000] all-reduce(...)
-    for m in re.finditer(
-            r"=\s*\(?([a-z0-9]+)\[([0-9,]*)\][^=]*?\ball-reduce",
-            hlo_text):
-        dtype, dims = m.group(1), m.group(2)
-        nbytes = _DTYPE_BYTES.get(dtype, 4)
-        for d in filter(None, dims.split(",")):
-            nbytes *= int(d)
-        total += nbytes
+    # 'all-reduce(' and async 'all-reduce-start(' (whose matching -done
+    # is NOT separately counted) — anchored on the opcode's open-paren
+    for m in re.finditer(r"=\s*(\([^)]*\)|[a-z0-9]+\[[0-9,]*\][^=\n]*?)"
+                         r"\s*all-reduce(?:-start)?\(", hlo_text):
+        shapes = re.findall(r"([a-z0-9]+)\[([0-9,]*)\]", m.group(1))
+        if not shapes:
+            continue
+        for dtype, dims in shapes:
+            nbytes = _DTYPE_BYTES.get(dtype, 4)
+            for d in filter(None, dims.split(",")):
+                nbytes *= int(d)
+            total += nbytes
         ops += 1
     return total, ops
 

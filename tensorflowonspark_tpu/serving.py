@@ -167,7 +167,8 @@ class ModelServer(object):
                     n = int(self.headers.get("Content-Length", "0"))
                     payload = json.loads(self.rfile.read(n) or b"{}")
                     return self._send(200, server.predict(payload))
-                except _BadRequest as e:
+                except (_BadRequest, json.JSONDecodeError) as e:
+                    # malformed JSON is the client's fault: 400, not 500
                     return self._send(400, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 - surface as 500
                     logger.exception("predict failed")

@@ -5,6 +5,8 @@
 # multi-process test fails CI instead of hanging it.
 
 PYTHON ?= python
+# bash for pipefail in the onchip recipe (dash lacks it)
+SHELL := /bin/bash
 
 .PHONY: test test-fast bench smoke install lint native clean
 
@@ -21,7 +23,10 @@ tensorflowonspark_tpu/_libshmring.so: native/shm_ring.cpp
 test:
 	timeout $(SUITE_TIMEOUT) $(PYTHON) -m pytest tests/ -q
 
-SUITE_TIMEOUT ?= 1200
+# example-surface smokes (tests/test_examples.py) add ~4 min of
+# subprocess training runs to the ~7 min library suite; 30 min keeps the
+# cap meaningful with CI-box variance without killing real runs
+SUITE_TIMEOUT ?= 1800
 
 test-fast:
 	$(PYTHON) -m pytest tests/ -q -x -m "not slow"
@@ -39,16 +44,19 @@ smoke:
 # Everything that needs the real chip, in priority order (VERDICT r3):
 # fed bench -> device sweep -> flash kernels on Mosaic -> step analysis.
 # Run the moment the tunnel serves compute; each stage appends to
-# .onchip/ so a mid-run outage keeps earlier results.
+# .onchip/ so a mid-run outage keeps earlier results. '-' prefixes keep
+# later stages running past an earlier failure; pipefail keeps each
+# stage's failure VISIBLE instead of laundered through tee.
 onchip:
 	mkdir -p .onchip
-	TFOS_BENCH_VERBOSE=1 $(PYTHON) bench.py 2>.onchip/bench.stderr \
-	  | tee .onchip/bench.json
-	bash scripts/perf_sweep.sh 2>&1 | tee .onchip/sweep.txt
-	$(PYTHON) scripts/flash_on_chip.py 2>.onchip/flash.stderr \
-	  | tee .onchip/flash.json
-	$(PYTHON) scripts/perf_analysis.py --batch 256 \
-	  --trace .onchip/trace 2>/dev/null | tee .onchip/perf_analysis.json
+	-set -o pipefail; TFOS_BENCH_VERBOSE=1 $(PYTHON) bench.py \
+	  2>.onchip/bench.stderr | tee .onchip/bench.json
+	-set -o pipefail; bash scripts/perf_sweep.sh 2>&1 | tee .onchip/sweep.txt
+	-set -o pipefail; $(PYTHON) scripts/flash_on_chip.py \
+	  2>.onchip/flash.stderr | tee .onchip/flash.json
+	-set -o pipefail; $(PYTHON) scripts/perf_analysis.py --batch 256 \
+	  --trace .onchip/trace 2>.onchip/perf_analysis.stderr \
+	  | tee .onchip/perf_analysis.json
 
 clean:
 	rm -f tensorflowonspark_tpu/_libshmring.so

@@ -127,12 +127,25 @@ def map_fun_tfrecord(args, ctx):
     label = np.concatenate([c["label"] for c in cols])[:, 0].astype(np.int32)
     read_rate = len(dense) / (time.monotonic() - t0)
 
+    # SPMD discipline: every worker must run the SAME number of steps or
+    # the gradient all-reduce deadlocks on uneven shards. All workers
+    # count every shard (metadata-rate native index) and agree on
+    # min-worker batches; local data wraps circularly (resnet example
+    # pattern).
+    W = max(ctx.num_workers, 1)
+    shard_counts = [tfrecord.count_records(f) for f in files]
+    worker_counts = [sum(shard_counts[w::W]) for w in range(W)]
+    B = args["batch_size"]
+    steps = max(1, args["epochs"] * (min(worker_counts) // B))
+
     def batches():
-        B = args["batch_size"]
-        for _ in range(args["epochs"]):
-            for i in range(0, len(dense) - B + 1, B):
-                yield {"dense": dense[i:i + B], "cat": cat[i:i + B],
-                       "label": label[i:i + B]}
+        i = 0
+        n = len(dense)
+        for _ in range(steps):
+            idx = np.arange(i, i + B) % n
+            i = (i + B) % n
+            yield {"dense": dense[idx], "cat": cat[idx],
+                   "label": label[idx]}
 
     sample = {"dense": np.zeros((8, 13), np.float32),
               "cat": np.zeros((8, 26), np.int64)}

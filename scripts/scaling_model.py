@@ -48,9 +48,13 @@ def _allreduce_bytes(hlo_text):
     total = 0
     ops = 0
     # 'all-reduce(' and async 'all-reduce-start(' (whose matching -done
-    # is NOT separately counted) — anchored on the opcode's open-paren
-    for m in re.finditer(r"=\s*(\([^)]*\)|[a-z0-9]+\[[0-9,]*\][^=\n]*?)"
-                         r"\s*all-reduce(?:-start)?\(", hlo_text):
+    # is NOT separately counted) — anchored on the opcode's open-paren.
+    # The shape region is taken as everything between '=' and the opcode
+    # on the line: TPU post-layout HLO embeds parens inside shapes
+    # ('f32[64]{0:T(8,128)}'), so a paren-balanced tuple match would
+    # silently drop exactly the on-chip ops this script must count.
+    for m in re.finditer(r"=\s*([^\n]+?)\s+all-reduce(?:-start)?\(",
+                         hlo_text):
         shapes = re.findall(r"([a-z0-9]+)\[([0-9,]*)\]", m.group(1))
         if not shapes:
             continue

@@ -405,6 +405,19 @@ def first_record(path, verify_crc=True):
         return next(_iter_stream(f, verify_crc), None)
 
 
+def count_records(path, verify_crc=True):
+    """Number of records in a TFRecord file — metadata-rate via the
+    native framing index when available (no per-record python work)."""
+    if _native_ok():
+        from tensorflowonspark_tpu import _tfrecord_native
+        from tensorflowonspark_tpu import fs
+        with fs.open(path, "rb") as f:
+            buf = _try_mmap(f)
+        if buf is not None:
+            return len(_tfrecord_native.index_buffer(buf, verify_crc)[0])
+    return sum(1 for _ in tfrecord_iterator(path, verify_crc))
+
+
 def read_examples(path):
     """Yield parsed {name: (kind, values)} dicts from a TFRecord file."""
     for record in tfrecord_iterator(path):

@@ -48,15 +48,20 @@ smoke:
 # later stages running past an earlier failure; pipefail keeps each
 # stage's failure VISIBLE instead of laundered through tee.
 onchip:
-	mkdir -p .onchip
+	mkdir -p .onchip && rm -f .onchip/*.rc
 	-set -o pipefail; TFOS_BENCH_VERBOSE=1 $(PYTHON) bench.py \
-	  2>.onchip/bench.stderr | tee .onchip/bench.json
-	-set -o pipefail; bash scripts/perf_sweep.sh 2>&1 | tee .onchip/sweep.txt
+	  2>.onchip/bench.stderr | tee .onchip/bench.json \
+	  || echo $$? > .onchip/bench.rc
+	-set -o pipefail; bash scripts/perf_sweep.sh 2>&1 \
+	  | tee .onchip/sweep.txt || echo $$? > .onchip/sweep.rc
 	-set -o pipefail; $(PYTHON) scripts/flash_on_chip.py \
-	  2>.onchip/flash.stderr | tee .onchip/flash.json
+	  2>.onchip/flash.stderr | tee .onchip/flash.json \
+	  || echo $$? > .onchip/flash.rc
 	-set -o pipefail; $(PYTHON) scripts/perf_analysis.py --batch 256 \
 	  --trace .onchip/trace 2>.onchip/perf_analysis.stderr \
-	  | tee .onchip/perf_analysis.json
+	  | tee .onchip/perf_analysis.json || echo $$? > .onchip/perf.rc
+	@if ls .onchip/*.rc >/dev/null 2>&1; then \
+	  echo "onchip stages FAILED:" .onchip/*.rc; exit 1; fi
 
 clean:
 	rm -f tensorflowonspark_tpu/_libshmring.so

@@ -133,7 +133,12 @@ def map_fun_tfrecord(args, ctx):
     # min-worker batches; local data wraps circularly (resnet example
     # pattern).
     W = max(ctx.num_workers, 1)
-    shard_counts = [tfrecord.count_records(f) for f in files]
+    # verify_crc=False: this is a COUNT of all shards by all workers —
+    # checksumming W x full-dataset here would multiply startup I/O by
+    # the cluster size; the shards a worker trains on were already
+    # CRC-validated by its read_batch above
+    shard_counts = [tfrecord.count_records(f, verify_crc=False)
+                    for f in files]
     worker_counts = [sum(shard_counts[w::W]) for w in range(W)]
     B = args["batch_size"]
     steps = max(1, args["epochs"] * (min(worker_counts) // B))

@@ -75,6 +75,7 @@ def register_filesystem(scheme, opener):
     scheme = scheme.lower().rstrip(":")
     prev = _REGISTRY.get(scheme)
     _REGISTRY[scheme] = opener
+    _FSSPEC_NEGATIVE.pop(scheme, None)  # re-arm the fallback probe path
     return prev
 
 
@@ -85,7 +86,7 @@ def unregister_filesystem(scheme):
 def is_supported(path):
     """True if :func:`open` can serve this path right now."""
     s = scheme_of(path)
-    return s is None or s in _REGISTRY
+    return s is None or _resolve_opener(s)[0] is not None
 
 
 def local_part(path):
@@ -96,21 +97,56 @@ def local_part(path):
     return path
 
 
+#: schemes fsspec could NOT serve, with the probe error (cleared by an
+#: explicit register_filesystem for the scheme): failed plugin imports
+#: are not cached in sys.modules, so re-probing per path would redo the
+#: whole import attempt in path-resolution loops.
+_FSSPEC_NEGATIVE = {}
+
+
+def _resolve_opener(scheme):
+    """(opener, probe_error) for a scheme: explicit registration first,
+    then a cached ``fsspec`` protocol fallback.
+
+    fsspec ships in this image and brings protocol plugins
+    (``memory://`` out of the box; ``hdfs://`` via pyarrow; ``gs://`` /
+    ``s3://`` wherever the extras are installed) — the role Hadoop's
+    FileSystem registry played for the reference's ``defaultFS`` paths.
+    """
+    opener = _REGISTRY.get(scheme)
+    if opener is not None:
+        return opener, None
+    if scheme in _FSSPEC_NEGATIVE:
+        return None, _FSSPEC_NEGATIVE[scheme]
+    try:
+        import fsspec
+        fsspec.get_filesystem_class(scheme)  # raises for unknown schemes
+    except Exception as e:  # noqa: BLE001 - surfaced via the raise below
+        _FSSPEC_NEGATIVE[scheme] = e
+        return None, e
+
+    def opener(path, mode):
+        import fsspec as _fsspec
+        return _fsspec.open(path, mode).open()
+    # setdefault: a concurrently registered EXPLICIT opener must win
+    return _REGISTRY.setdefault(scheme, opener), None
+
+
 def open(path, mode="rb"):  # noqa: A001 - deliberate builtin shadow
     """Open a path through the registered filesystem for its scheme."""
     path = os.fspath(path)
     s = scheme_of(path)
     if s is None:
         return builtins.open(local_part(path), mode)
-    opener = _REGISTRY.get(s)
+    opener, probe_error = _resolve_opener(s)
     if opener is None:
         raise UnsupportedSchemeError(
-            "no filesystem registered for {!r} paths ({!r}); this "
-            "framework bundles no remote-FS client (the reference used "
-            "TF's gfile+Hadoop). Register one once per process:\n"
+            "no filesystem registered for {!r} paths ({!r}) and fsspec "
+            "could not serve the scheme ({!r}); this framework bundles "
+            "no remote-FS client (the reference used TF's gfile+Hadoop)."
+            " Either install an fsspec protocol package (gcsfs/s3fs/...)"
+            " or register an opener once per process:\n"
             "    from tensorflowonspark_tpu import fs\n"
-            "    fs.register_filesystem({!r}, opener)  # opener(path, mode)\n"
-            "e.g. fsspec: fs.register_filesystem({!r}, "
-            "lambda p, m: fsspec.open(p, m).open())".format(
-                s, path, s, s))
+            "    fs.register_filesystem({!r}, opener)  # opener(path, "
+            "mode)".format(s, path, probe_error, s)) from probe_error
     return opener(path, mode)

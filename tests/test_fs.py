@@ -185,8 +185,10 @@ def test_absolute_path_rejects_unregistered_scheme():
     ctx = NodeContext(0, "chief", 0, [], {"working_dir": "/wd"})
     assert ctx.absolute_path("rel/path") == "/wd/rel/path"
     assert ctx.absolute_path("/abs/path") == "/abs/path"
+    # hdfs:// is now served by the fsspec fallback (pyarrow plugin), so
+    # the reject case needs a scheme NOTHING can serve
     with pytest.raises(fs.UnsupportedSchemeError):
-        ctx.absolute_path("hdfs://nn/data")
+        ctx.absolute_path("nosuchproto-xyz://nn/data")
     fs.register_filesystem("hdfs", lambda p, m: (_ for _ in ()).throw(
         IOError("not actually reachable")))
     try:
@@ -194,3 +196,31 @@ def test_absolute_path_rejects_unregistered_scheme():
         assert ctx.absolute_path("hdfs://nn/data") == "hdfs://nn/data"
     finally:
         fs.unregister_filesystem("hdfs")
+
+
+def test_fsspec_fallback_memory_scheme(tmp_path):
+    """Unregistered schemes fall back to fsspec's protocol registry:
+    a memory:// TFRecord round-trips through the production codec (the
+    streaming path — fsspec streams have no usable mmap)."""
+    from tensorflowonspark_tpu import tfrecord
+
+    path = "memory://shard/part-00000"
+    assert fs.is_supported(path)
+    with tfrecord.TFRecordWriter(path) as w:
+        for i in range(5):
+            w.write(tfrecord.encode_example({"i": [i]}))
+    rows = list(tfrecord.read_examples(path))
+    assert [r["i"][1][0] for r in rows] == [0, 1, 2, 3, 4]
+    # explicit registrations still win over the fallback
+    fs.register_filesystem("memory", lambda p, m: (_ for _ in ()).throw(
+        RuntimeError("explicit opener wins")))
+    try:
+        with pytest.raises(RuntimeError, match="explicit opener wins"):
+            fs.open(path, "rb")
+    finally:
+        fs.unregister_filesystem("memory")
+
+
+def test_unknown_scheme_still_fails_loudly():
+    with pytest.raises(fs.UnsupportedSchemeError, match="no filesystem"):
+        fs.open("nosuchproto-xyz://bucket/x", "rb")

@@ -73,7 +73,8 @@ def _bench_map_fun(args, ctx):
     batch = args["batch"]
     image = args["image"]
     mesh = build_mesh({"data": len(jax.devices())})
-    trainer = training.Trainer(model, optax.sgd(0.1, momentum=0.9), mesh)
+    trainer = training.Trainer(model, optax.sgd(0.1, momentum=0.9), mesh,
+                               remat=_bench_remat())
     state = trainer.init(
         jax.random.PRNGKey(0),
         np.zeros((batch, image, image, 3), np.float32))
@@ -188,6 +189,12 @@ def _mfu(trainer, state, batch_data, images_per_sec_per_chip, batch,
     return images_per_sec_per_chip * flops_per_img / peak
 
 
+def _bench_remat():
+    """TFOS_BENCH_REMAT=1: rematerialized backward (jax.checkpoint) —
+    the knob for pushing batch into the HBM ceiling on the sweep."""
+    return os.environ.get("TFOS_BENCH_REMAT") == "1"
+
+
 def _bench_model(on_tpu):
     """ResNet-50 (tiny variant on CPU smoke), with perf-experiment knobs:
     TFOS_BENCH_BN_DTYPE=bfloat16 runs BatchNorm in bf16 (halves the HBM
@@ -217,7 +224,8 @@ def _device_only(on_tpu, batch, image, steps, warmup):
     model = _bench_model(on_tpu)
 
     mesh = build_mesh({"data": len(jax.devices())})
-    trainer = training.Trainer(model, optax.sgd(0.1, momentum=0.9), mesh)
+    trainer = training.Trainer(model, optax.sgd(0.1, momentum=0.9), mesh,
+                               remat=_bench_remat())
 
     rng = np.random.RandomState(0)
     x = rng.rand(batch, image, image, 3).astype(np.float32)

@@ -59,7 +59,8 @@ def main():
 
     model = bench._bench_model(on_tpu)
     mesh = build_mesh({"data": len(jax.devices())})
-    trainer = training.Trainer(model, optax.sgd(0.1, momentum=0.9), mesh)
+    trainer = training.Trainer(model, optax.sgd(0.1, momentum=0.9), mesh,
+                               remat=bench._bench_remat())
     rng = np.random.RandomState(0)
     x = rng.rand(batch, image, image, 3).astype(np.float32)
     y = (np.arange(batch) % (1000 if on_tpu else 10)).astype(np.int64)
@@ -72,6 +73,7 @@ def main():
 
     report = {"config": {"batch": batch, "image": image,
                          "bn_dtype": args.bn_dtype,
+                         "remat": bench._bench_remat(),
                          "backend": jax.default_backend(),
                          "device": str(jax.devices()[0].device_kind)}}
 

@@ -12,3 +12,9 @@ for batch in 256 512 1024; do
       timeout 900 python bench.py 2>/dev/null | tail -1
   done
 done
+# remat opens headroom past the HBM ceiling at the largest batches
+for batch in 1024 2048; do
+  echo "=== batch=$batch bn_dtype=bfloat16 remat=1 ==="
+  TFOS_BENCH_FED=0 TFOS_BENCH_BATCH=$batch TFOS_BENCH_BN_DTYPE=bfloat16 \
+    TFOS_BENCH_REMAT=1 timeout 900 python bench.py 2>/dev/null | tail -1
+done

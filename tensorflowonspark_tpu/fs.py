@@ -89,6 +89,27 @@ def is_supported(path):
     return s is None or _resolve_opener(s)[0] is not None
 
 
+def ensure_supported(path):
+    """Raise the canonical UnsupportedSchemeError (probe cause chained)
+    for a path :func:`open` cannot serve; returns the path otherwise.
+    Path consumers that want to fail EARLY (ctx.absolute_path) call this
+    instead of duplicating — and drifting from — open()'s message."""
+    s = scheme_of(path)
+    if s is None:
+        return path
+    opener, probe_error = _resolve_opener(s)
+    if opener is None:
+        raise UnsupportedSchemeError(_unsupported_msg(s, path, probe_error)) \
+            from probe_error
+    return path
+
+
+def clear_probe_cache():
+    """Forget cached fsspec probe failures (e.g. after installing a
+    protocol package mid-process)."""
+    _FSSPEC_NEGATIVE.clear()
+
+
 def local_part(path):
     """Strip a file:// prefix; other schemes are returned untouched."""
     path = os.fspath(path)
@@ -132,6 +153,20 @@ def _resolve_opener(scheme):
     return _REGISTRY.setdefault(scheme, opener), None
 
 
+def _unsupported_msg(s, path, probe_error):
+    return (
+        "no filesystem registered for {!r} paths ({!r}) and fsspec "
+        "could not serve the scheme ({!r}); this framework bundles "
+        "no remote-FS client (the reference used TF's gfile+Hadoop)."
+        " Either install an fsspec protocol package (gcsfs/s3fs/...) "
+        "— the failed probe is cached for this process, so afterwards "
+        "call fs.clear_probe_cache() (or restart) — or register an "
+        "opener once per process:\n"
+        "    from tensorflowonspark_tpu import fs\n"
+        "    fs.register_filesystem({!r}, opener)  # opener(path, "
+        "mode)".format(s, path, probe_error, s))
+
+
 def open(path, mode="rb"):  # noqa: A001 - deliberate builtin shadow
     """Open a path through the registered filesystem for its scheme."""
     path = os.fspath(path)
@@ -140,13 +175,6 @@ def open(path, mode="rb"):  # noqa: A001 - deliberate builtin shadow
         return builtins.open(local_part(path), mode)
     opener, probe_error = _resolve_opener(s)
     if opener is None:
-        raise UnsupportedSchemeError(
-            "no filesystem registered for {!r} paths ({!r}) and fsspec "
-            "could not serve the scheme ({!r}); this framework bundles "
-            "no remote-FS client (the reference used TF's gfile+Hadoop)."
-            " Either install an fsspec protocol package (gcsfs/s3fs/...)"
-            " or register an opener once per process:\n"
-            "    from tensorflowonspark_tpu import fs\n"
-            "    fs.register_filesystem({!r}, opener)  # opener(path, "
-            "mode)".format(s, path, probe_error, s)) from probe_error
+        raise UnsupportedSchemeError(_unsupported_msg(s, path, probe_error)) \
+            from probe_error
     return opener(path, mode)

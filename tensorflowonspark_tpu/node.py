@@ -131,12 +131,8 @@ class NodeContext(object):
         """
         from tensorflowonspark_tpu import fs
         if fs.scheme_of(path) is not None:
-            if not fs.is_supported(path):
-                raise fs.UnsupportedSchemeError(
-                    "path {!r}: no filesystem registered for scheme "
-                    "{!r}; see tensorflowonspark_tpu.fs."
-                    "register_filesystem".format(path, fs.scheme_of(path)))
-            return path
+            # canonical message + chained probe cause, same as fs.open
+            return fs.ensure_supported(path)
         if path.startswith("file://") or os.path.isabs(path):
             return path
         return os.path.join(self.working_dir, path)

@@ -50,7 +50,8 @@ class Trainer(object):
 
     def __init__(self, model, optimizer, mesh, loss_fn=softmax_xent,
                  data_axis="data", donate_state=True, train_mode_kwarg="auto",
-                 dropout_rng=False, input_keys=("x",), constrain_state=True):
+                 dropout_rng=False, input_keys=("x",), constrain_state=True,
+                 remat=False):
         import inspect
 
         import jax
@@ -84,6 +85,11 @@ class Trainer(object):
                 {train_mode_kwarg: True} if train_mode_kwarg else {})
         self._donate = donate_state
         self._constrain_state = constrain_state
+        #: rematerialize the forward pass in the backward (jax.checkpoint)
+        #: — trades ~33% more FLOPs for dropping activation storage, the
+        #: standard lever for scaling batch into the HBM ceiling
+        #: (SURVEY.md build guidance; TFOS_BENCH_REMAT in bench.py).
+        self._remat = remat
         self._jit_step = None  # built lazily: needs init()'s aux-state info
 
     def _inputs(self, batch):
@@ -129,8 +135,14 @@ class Trainer(object):
                 rngs = {"dropout": jax.random.fold_in(
                     jax.random.PRNGKey(0), state["step"])}
 
+            apply = jax.checkpoint(self._apply) if self._remat \
+                else self._apply
+
             def loss_of(p):
-                logits, new_extra = self._apply(p, state["extra"], batch, rngs)
+                # extra/batch/rngs go through checkpoint as ARGUMENTS —
+                # closing over them here would make them saved constants
+                # of the checkpointed region instead of rematerialized
+                logits, new_extra = apply(p, state["extra"], batch, rngs)
                 return self.loss_fn(logits, batch), new_extra
 
             (loss, new_extra), grads = jax.value_and_grad(

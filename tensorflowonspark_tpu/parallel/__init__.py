@@ -12,13 +12,15 @@ submodules import jax lazily inside functions where practical.
 """
 
 from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401
+    build_hybrid_mesh,
     build_mesh,
     data_parallel_sharding,
     replicated_sharding,
 )
 
 __all__ = [
-    "build_mesh", "data_parallel_sharding", "replicated_sharding",
+    "build_hybrid_mesh", "build_mesh", "data_parallel_sharding",
+    "replicated_sharding",
     # submodules (imported lazily by users; listed for discoverability):
     # .sharding   — TP rule catalogs (BERT/ResNet/WideDeep) + appliers
     # .ring_attention — ring_attention / ring_flash_attention (SP)

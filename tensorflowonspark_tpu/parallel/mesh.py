@@ -68,6 +68,72 @@ def build_mesh(axis_shapes=None, devices=None):
     return Mesh(mesh_devices, tuple(names))
 
 
+def build_hybrid_mesh(dcn_axis_shapes, ici_axis_shapes, devices=None):
+    """Mesh spanning multiple slices/hosts: DCN axes outer, ICI inner.
+
+    The multi-slice layout recipe (SURVEY.md §2.4 plane 3; the public
+    scaling playbook): axes whose collectives must ride the slow
+    inter-slice DCN (usually just ``data``) go OUTERMOST, while
+    model/seq/stage axes stay inside a slice so their all-gathers and
+    ppermutes ride ICI. On real multi-slice TPU this uses
+    ``mesh_utils.create_hybrid_device_mesh`` (which also picks a
+    torus-friendly intra-slice order); everywhere else — CPU meshes,
+    single slice, virtual devices — it falls back to slice-major
+    contiguous blocks, which is exactly what ``jax.devices()``'s
+    process-major global order provides.
+
+    Args:
+      dcn_axis_shapes: ordered ``{axis: size}`` across slices
+        (e.g. ``{"data": n_slices}``).
+      ici_axis_shapes: ordered ``{axis: size}`` within a slice
+        (e.g. ``{"model": 8}``). Axis names must not overlap.
+
+    Returns a ``jax.sharding.Mesh`` with the DCN axes first.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    overlap = set(dcn_axis_shapes) & set(ici_axis_shapes)
+    if overlap:
+        raise ValueError(
+            "axes {} appear in both dcn and ici shapes; an axis lives on "
+            "exactly one of the two networks".format(sorted(overlap)))
+    dcn_names = list(dcn_axis_shapes)
+    ici_names = list(ici_axis_shapes)
+    dcn_sizes = [int(s) for s in dcn_axis_shapes.values()]
+    ici_sizes = [int(s) for s in ici_axis_shapes.values()]
+    total = math.prod(dcn_sizes) * math.prod(ici_sizes)
+    if total != len(devices):
+        raise ValueError(
+            "hybrid mesh dcn={} x ici={} needs {} devices but {} are "
+            "available".format(dict(dcn_axis_shapes),
+                               dict(ici_axis_shapes), total, len(devices)))
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if len(slice_ids) > 1:
+        # Real multi-slice hardware: use the topology-aware layout and
+        # let genuine errors (shapes that cannot factor into slices)
+        # surface — a silent reshape here would put an "ICI" axis across
+        # slice boundaries and quietly ride DCN.
+        from jax.experimental import mesh_utils
+
+        # create_hybrid_device_mesh pairs shapes elementwise, so pad each
+        # side with 1s for the other's axes: ici shape (1..,ici),
+        # dcn shape (dcn,..1) -> combined (dcn, ici).
+        ici_shape = [1] * len(dcn_sizes) + ici_sizes
+        dcn_shape = dcn_sizes + [1] * len(ici_sizes)
+        mesh_devices = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices)
+    else:
+        # No slice metadata (CPU/virtual devices, single slice): the
+        # process-major global order IS slice-major; contiguous blocks
+        # give the same inner/outer split.
+        mesh_devices = np.asarray(devices).reshape(dcn_sizes + ici_sizes)
+    return Mesh(mesh_devices, tuple(dcn_names + ici_names))
+
+
 def data_parallel_sharding(mesh, axis=DATA_AXIS):
     """NamedSharding that splits the leading (batch) dim over ``axis``."""
     from jax.sharding import NamedSharding, PartitionSpec

@@ -58,25 +58,94 @@ def _train_fun(args, ctx):
                    "loss": float(metrics["loss"])}, f)
 
 
-def test_cluster_resume_from_checkpoint(tmp_path):
-    ckpt_dir = str(tmp_path / "ckpt")
+def _run_twice(train_fun, tmp_path, prefix):
+    """Train-save, then resubmit-restore-train in a FRESH cluster; return
+    the two runs' handshake dicts (train_fun writes '<prefix>run-N.json')."""
+    ckpt_dir = str(tmp_path / (prefix + "ckpt"))
     os.makedirs(ckpt_dir)
-
     for run in (1, 2):
         sc = Context(num_executors=1,
-                     work_root=str(tmp_path / ("engine%d" % run)))
+                     work_root=str(tmp_path / ("%sengine%d" % (prefix, run))))
         try:
-            tfc = cluster.run(sc, _train_fun,
+            tfc = cluster.run(sc, train_fun,
                               {"dir": ckpt_dir, "steps": 3, "run": run},
                               num_executors=1,
                               input_mode=cluster.InputMode.TENSORFLOW)
             tfc.shutdown()
         finally:
             sc.stop()
+    return tuple(
+        json.load(open(os.path.join(ckpt_dir, "%srun-%d.json" % (prefix, n))))
+        for n in (1, 2))
 
-    r1 = json.load(open(os.path.join(ckpt_dir, "run-1.json")))
-    r2 = json.load(open(os.path.join(ckpt_dir, "run-2.json")))
+
+def test_cluster_resume_from_checkpoint(tmp_path):
+    r1, r2 = _run_twice(_train_fun, tmp_path, "")
     assert r1["start_step"] == 0 and r1["end_step"] == 3
     # the resubmitted job restored step 3 and continued to 6
     assert r2["start_step"] == 3, r2
     assert r2["end_step"] == 6, r2
+
+
+def _tp_train_fun(args, ctx):
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from tensorflowonspark_tpu import checkpoint, training
+    from tensorflowonspark_tpu.parallel.sharding import tree_shardings
+
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.relu(nn.Dense(16, name="up")(x))
+            return nn.Dense(8, name="down")(x)
+
+    devices = ctx.initialize_jax()
+    mesh = ctx.mesh({"data": len(devices) // 2, "model": 2})
+    rules = (("up/kernel", P(None, "model")),
+             ("down/kernel", P("model", None)))
+    trainer = training.Trainer(MLP(), optax.sgd(0.05), mesh,
+                               constrain_state=False, donate_state=False)
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 12).astype(np.float32)
+    y = (np.arange(16) % 8).astype(np.int64)
+    state = trainer.init(jax.random.PRNGKey(0), x)
+    shardings = tree_shardings(state["params"], mesh, rules, default=P())
+    state["params"] = jax.device_put(state["params"], shardings)
+
+    ckpt = checkpoint.Checkpointer(args["dir"],
+                                   chief=ctx.job_name == "chief")
+    restored = ckpt.restore(state)
+    start_step = 0 if restored is None else int(restored["step"])
+    if restored is not None:
+        state = restored
+        # the restore must come back in the TP layout state carries
+        up = state["params"]["up"]["kernel"]
+        assert up.sharding.spec == P(None, "model"), up.sharding
+    batch = jax.device_put({"x": x, "y": y}, trainer.batch_sharding)
+    for _ in range(args["steps"]):
+        state, metrics = trainer.step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    ckpt.save(int(state["step"]), state, force=True)
+    ckpt.wait()
+    ckpt.close()
+    with open(os.path.join(args["dir"], "tp-run-%d.json" % args["run"]),
+              "w") as f:
+        json.dump({"start_step": start_step,
+                   "end_step": int(state["step"]),
+                   "loss": float(metrics["loss"])}, f)
+
+
+def test_cluster_resume_tp_sharded_state(tmp_path):
+    """Resubmit-and-restore with a TENSOR-PARALLEL state: the checkpoint
+    round-trips through fresh cluster processes with the sharded layout
+    preserved (SURVEY.md §5 checkpoint/resume; r3 VERDICT task 5 at
+    cluster level)."""
+    r1, r2 = _run_twice(_tp_train_fun, tmp_path, "tp-")
+    assert r1["start_step"] == 0 and r1["end_step"] == 3
+    assert r2["start_step"] == 3 and r2["end_step"] == 6
+    assert r2["loss"] < r1["loss"]  # training actually continued

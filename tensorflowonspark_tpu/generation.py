@@ -1,0 +1,118 @@
+"""Autoregressive generation over a KV cache — TPU-idiomatic decode.
+
+The reference's inference is batch scoring only (SURVEY.md §3.3); this
+is the don't-stop-at-parity decode loop for the decoder LM family
+(models/decoder.py): the whole generation — prompt prefill AND sampling
+— runs as two ``lax.scan``s inside ONE jit with static shapes, so XLA
+compiles a single program per (batch, prompt_len, max_new) signature
+and each new token costs O(1) attention against the pre-allocated
+cache instead of re-running the O(S²) prefix.
+
+    model = DecoderLM(vocab=V, ..., decode=True, max_len=TOTAL)
+    out = generate(model, params, prompt, max_new_tokens=64)
+
+``temperature=0`` is greedy; otherwise softmax sampling with the given
+PRNG key. Feeding happens one token per step (the flax decode-cache
+contract), which also makes prefill a scan — simple and fully
+compiled; a fused multi-token prefill is a later optimization.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def init_cache(model, batch, total_len):
+    """Fresh KV cache for ``batch`` sequences of up to ``total_len``.
+
+    Shape-only: ``jax.eval_shape`` over ``model.init`` yields the cache
+    pytree structure without executing the full-length dummy forward
+    (the cache starts as zeros anyway; params come from training, not
+    from here).
+    """
+    dummy = jnp.zeros((batch, total_len), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), dummy))
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                        shapes["cache"])
+
+
+def generate(model, params, prompt, max_new_tokens, temperature=0.0,
+             rng=None):
+    """[B, S] prompt -> [B, S + max_new_tokens] generated tokens.
+
+    ``model`` must be a decode-mode instance (``decode=True``) whose
+    ``max_len >= S + max_new_tokens``. Deterministic (greedy) when
+    ``temperature == 0``; otherwise ``rng`` is required.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, s = prompt.shape
+    total = s + int(max_new_tokens)
+    if model.max_len < total:
+        raise ValueError(
+            "model.max_len={} < prompt {} + max_new_tokens {}".format(
+                model.max_len, s, max_new_tokens))
+    if temperature and rng is None:
+        raise ValueError("temperature sampling needs a PRNG key")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    cache = init_cache(model, b, model.max_len)
+
+    def one_token(cache, token):
+        """token [B, 1] -> (new cache, logits [B, V])."""
+        logits, updated = model.apply(
+            {"params": params, "cache": cache}, token, mutable=["cache"])
+        return updated["cache"], logits[:, -1, :]
+
+    def prefill_step(carry, tok_col):
+        cache, _ = carry
+        cache, logits = one_token(cache, tok_col[:, None])
+        return (cache, logits), None
+
+    (cache, logits), _ = jax.lax.scan(
+        prefill_step, (cache, jnp.zeros((b, model.vocab), jnp.float32)),
+        prompt.T)
+
+    def pick(logits, key):
+        if temperature:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def decode_step(carry, key):
+        cache, logits = carry
+        token = pick(logits, key).astype(jnp.int32)
+        cache, next_logits = one_token(cache, token[:, None])
+        return (cache, next_logits), token
+
+    # the LAST token needs no cache-advancing forward: scan N-1 steps,
+    # then pick once from the carried logits (N forwards would waste one)
+    keys = jax.random.split(rng, max_new_tokens)
+    if max_new_tokens > 1:
+        (cache, logits), body_tokens = jax.lax.scan(
+            decode_step, (cache, logits), keys[:-1])
+    else:
+        body_tokens = jnp.zeros((0, b), jnp.int32)
+    last = pick(logits, keys[-1]).astype(jnp.int32)
+    new_tokens = jnp.concatenate([body_tokens, last[None]], axis=0)
+    return jnp.concatenate([prompt, new_tokens.T], axis=1)
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_generate(model, max_new_tokens, temperature):
+    # flax Modules are frozen dataclasses (hashable), so (model, N, T)
+    # keys a REUSED jitted fn — a fresh jax.jit(lambda) per call would
+    # recompile every time
+    return jax.jit(
+        lambda params, tokens, key: generate(
+            model, params, tokens, max_new_tokens, temperature, key))
+
+
+def generate_jit(model, params, prompt, max_new_tokens, temperature=0.0,
+                 rng=None):
+    """jit-compiled :func:`generate`: one compile per (model,
+    max_new_tokens, temperature) x input-shape signature, cached across
+    calls."""
+    fn = _jitted_generate(model, int(max_new_tokens), float(temperature))
+    return fn(params, prompt,
+              rng if rng is not None else jax.random.PRNGKey(0))

@@ -1,0 +1,84 @@
+"""Decoder-only causal LM with KV-cache decode support.
+
+The inference-side sibling of the sequence-parallel training LM
+(examples/longcontext/long_dist.py): same decoder-only shape, but the
+attention is flax's ``MultiHeadDotProductAttention`` whose ``decode``
+mode maintains the standard KV cache ("cache" variable collection), so
+autoregressive generation (generation.py) costs O(S) per new token
+instead of re-running the O(S^2) prefix.
+
+The reference framework has no generation story at all (its inference
+is batch scoring — SURVEY.md §3.3); this is a don't-stop-at-parity
+addition shaped for TPU: static shapes everywhere (cache pre-allocated
+at ``max_len``), decode steps under ``lax.scan``.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class DecoderBlock(nn.Module):
+    num_heads: int
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        h = x.shape[-1]
+        y = nn.LayerNorm(name="ln1")(x)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, qkv_features=h,
+            decode=self.decode, name="attn")(y, y, mask=mask)
+        x = x + y
+        y = nn.LayerNorm(name="ln2")(x)
+        y = nn.Dense(4 * h, name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(h, name="mlp_out")(y)
+        return x + y
+
+
+class DecoderLM(nn.Module):
+    """Tiny GPT-style LM: learned positions, pre-LN blocks, tied-free head.
+
+    ``decode=True`` instances carry the KV cache: init it by running a
+    full-length dummy input with ``init`` (flax materializes the cache at
+    that length), then feed one token at a time.
+    """
+
+    vocab: int
+    hidden: int = 64
+    num_heads: int = 4
+    num_layers: int = 2
+    max_len: int = 512
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, tokens):
+        b, s = tokens.shape
+        x = nn.Embed(self.vocab, self.hidden, name="tok_embed")(tokens)
+        pos_embed = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (self.max_len, self.hidden))
+        if self.decode:
+            # the LM tracks its own position alongside the attention KV
+            # caches (the flax lm1b pattern): 0 during cache init (the
+            # full-length dummy pass), then advancing by s per call
+            from jax import lax
+
+            initializing = not self.has_variable("cache", "pos_idx")
+            pos_idx = self.variable("cache", "pos_idx",
+                                    lambda: jnp.zeros((), jnp.int32))
+            pos = jnp.where(initializing, 0, pos_idx.value)
+            x = x + lax.dynamic_slice(
+                pos_embed, (pos.astype(jnp.int32), 0),
+                (s, self.hidden))[None]
+            if not initializing:
+                pos_idx.value = pos_idx.value + s
+            mask = None  # the attention cache masks up to its own index
+        else:
+            x = x + pos_embed[:s][None]
+            mask = nn.make_causal_mask(tokens)
+        for i in range(self.num_layers):
+            x = DecoderBlock(self.num_heads, decode=self.decode,
+                             name="block_%d" % i)(x, mask=mask)
+        x = nn.LayerNorm(name="ln_f")(x)
+        return nn.Dense(self.vocab, name="head")(x)

@@ -98,6 +98,43 @@ def test_unknown_model_404(server):
     assert err.value.code == 404
 
 
+def test_serving_generative_model(tmp_path):
+    """Generation behind the REST surface: the exported apply_fn wraps
+    the KV-cache decode loop, so a JVM-style HTTP client gets token
+    continuations from a plain :predict call."""
+    import jax
+
+    from tensorflowonspark_tpu import generation
+    from tensorflowonspark_tpu.models.decoder import DecoderLM
+
+    dec = DecoderLM(vocab=8, hidden=16, num_heads=2, num_layers=1,
+                    max_len=16, decode=True)
+    train = DecoderLM(vocab=8, hidden=16, num_heads=2, num_layers=1,
+                      max_len=16, decode=False)
+    params = train.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+
+    def apply_fn(variables, batch):
+        tokens = generation.generate_jit(
+            dec, variables["params"], jnp.asarray(batch["prompt"]),
+            max_new_tokens=4)
+        return {"tokens": tokens}
+
+    d = str(tmp_path / "lm-export")
+    export.save_model(d, apply_fn, {"params": params},
+                      signature={"inputs": ["prompt"],
+                                 "outputs": ["tokens"]})
+    with serving.ModelServer(d, name="lm", port=0) as srv:
+        url = "http://%s:%d" % (srv._host, srv._port)
+        code, out = _post(url + "/v1/models/lm:predict",
+                          {"inputs": {"prompt": [[1, 2, 3]]}})
+    assert code == 200
+    toks = out["outputs"]
+    assert len(toks) == 1 and len(toks[0]) == 7  # 3 prompt + 4 new
+    assert toks[0][:3] == [1, 2, 3]
+    assert all(0 <= t < 8 for t in toks[0])
+
+
 def test_concurrent_predicts(server):
     """The single-owner lock serializes; concurrent clients all succeed."""
     import threading

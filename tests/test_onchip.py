@@ -1,0 +1,58 @@
+"""On-chip validation hooks — skipped until real TPU compute is present.
+
+VERDICT r3 task 4: the flash kernels must be re-validated on Mosaic in
+every hardware window, so the check lives in the suite and re-arms
+automatically. The suite pins itself to CPU (conftest), so these tests
+run the harnesses in SUBPROCESSES with the CPU pin stripped; they skip
+— loudly, with the reason — unless ``TFOS_ON_CHIP=1`` is set by an
+operator who has confirmed tunnel compute (a dead tunnel makes any
+device call hang, which must never stall the default gate). `make
+onchip` is the operator entry point; this is the suite-level record.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        os.environ.get("TFOS_ON_CHIP") != "1",
+        reason="needs live TPU compute: set TFOS_ON_CHIP=1 after "
+               "confirming the tunnel serves a matmul (see make onchip)"),
+]
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_onchip(script, *args, timeout=1800):
+    env = {k: v for k, v in os.environ.items()}
+    # undo the conftest CPU pin for the child: it must see the chip
+    env.pop("JAX_PLATFORMS", None)
+    env["PALLAS_AXON_POOL_IPS"] = env.get("TFOS_AXON_IPS", "127.0.0.1")
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f)
+    return subprocess.run(
+        [sys.executable, os.path.join(_ROOT, script)] + list(args),
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=_ROOT)
+
+
+def test_flash_kernels_on_chip():
+    """Mosaic-compiled flash fwd/bwd parity + S=4096 memory win."""
+    out = _run_onchip("scripts/flash_on_chip.py")
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-1000:]
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["parity_ok"] is True, summary
+
+
+def test_bench_fed_on_chip():
+    """The north-star number: cluster-fed throughput on the real chip."""
+    out = _run_onchip("bench.py")
+    assert out.returncode == 0, out.stderr[-1000:]
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result.get("error") is None, result
+    assert result["value"] > 0, result

@@ -29,10 +29,20 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run_onchip(script, *args, timeout=1800):
-    env = {k: v for k, v in os.environ.items()}
-    # undo the conftest CPU pin for the child: it must see the chip
+    # The conftest stashed the real pool address (TFOS_AXON_IPS) before
+    # blanking PALLAS_AXON_POOL_IPS; without it the child would target
+    # nothing (or the wrong host) and hang until the subprocess timeout.
+    pool = os.environ.get("TFOS_AXON_IPS")
+    if not pool:
+        pytest.fail(
+            "TFOS_ON_CHIP=1 but no pool address: export TFOS_AXON_IPS "
+            "(the PALLAS_AXON_POOL_IPS value outside the test harness)")
+    env = dict(os.environ)
+    # undo the conftest CPU pin for the child: it must see the chip, and
+    # multi-node bootstrap must keep its NON-test default
     env.pop("JAX_PLATFORMS", None)
-    env["PALLAS_AXON_POOL_IPS"] = env.get("TFOS_AXON_IPS", "127.0.0.1")
+    env.pop("TFOS_TPU_DISTRIBUTED", None)
+    env["PALLAS_AXON_POOL_IPS"] = pool
     env["XLA_FLAGS"] = " ".join(
         f for f in env.get("XLA_FLAGS", "").split()
         if "host_platform_device_count" not in f)
@@ -52,7 +62,11 @@ def test_flash_kernels_on_chip():
 def test_bench_fed_on_chip():
     """The north-star number: cluster-fed throughput on the real chip."""
     out = _run_onchip("bench.py")
-    assert out.returncode == 0, out.stderr[-1000:]
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-1000:]
     result = json.loads(out.stdout.strip().splitlines()[-1])
     assert result.get("error") is None, result
+    # bench silently downgrades to the CPU smoke off-chip — a green run
+    # must prove it actually measured the chip
+    assert result["metric"] == \
+        "resnet50_cluster_fed_images_per_sec_per_chip", result
     assert result["value"] > 0, result

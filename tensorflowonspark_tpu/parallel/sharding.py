@@ -105,3 +105,20 @@ WIDEDEEP_TP_RULES = (
     (r"mlp_0/bias", ("model",)),
     (r"mlp_1/kernel", ("model", None)),
 )
+
+
+#: TP rules for models/decoder.py (the KV-cache generation LM): the
+#: megatron split — q/k/v projections shard the head axis, the output
+#: projection merges over heads (input-sharded), the MLP follows the
+#: up/down convention. Decode works UNCHANGED under these rules: the
+#: attention cache inherits the head sharding from the sharded k/v
+#: activations, and generation output is bitwise-identical to the
+#: replicated run (tests/test_generation.py).
+DECODER_TP_RULES = (
+    (r"attn/(query|key|value)/kernel", (None, "model", None)),  # [H, N, D]
+    (r"attn/(query|key|value)/bias", ("model", None)),          # [N, D]
+    (r"attn/out/kernel", ("model", None, None)),                # [N, D, H]
+    (r"mlp_in/kernel", (None, "model")),
+    (r"mlp_in/bias", ("model",)),
+    (r"mlp_out/kernel", ("model", None)),
+)

@@ -26,7 +26,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ.setdefault("TFOS_TPU_TEST_MODE", "1")
 # Single-host harness: each trainer process owns a private virtual CPU
 # device set, so the multi-node jax.distributed bootstrap (default ON for
-# real clusters) must be disabled.
+# real clusters) must be disabled. Stash any OPERATOR-set value first so
+# the on-chip hooks can restore it (same treatment as TFOS_AXON_IPS).
+if "TFOS_TPU_DISTRIBUTED" in os.environ:
+    os.environ.setdefault("TFOS_TPU_DISTRIBUTED_ORIG",
+                          os.environ["TFOS_TPU_DISTRIBUTED"])
 os.environ["TFOS_TPU_DISTRIBUTED"] = "0"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -39,9 +39,14 @@ def _run_onchip(script, *args, timeout=1800):
             "(the PALLAS_AXON_POOL_IPS value outside the test harness)")
     env = dict(os.environ)
     # undo the conftest CPU pin for the child: it must see the chip, and
-    # multi-node bootstrap must keep its NON-test default
+    # multi-node bootstrap reverts to the operator's pre-harness value
+    # (conftest stashed it) or the non-test default
     env.pop("JAX_PLATFORMS", None)
-    env.pop("TFOS_TPU_DISTRIBUTED", None)
+    orig = env.pop("TFOS_TPU_DISTRIBUTED_ORIG", None)
+    if orig is not None:
+        env["TFOS_TPU_DISTRIBUTED"] = orig
+    else:
+        env.pop("TFOS_TPU_DISTRIBUTED", None)
     env["PALLAS_AXON_POOL_IPS"] = pool
     env["XLA_FLAGS"] = " ".join(
         f for f in env.get("XLA_FLAGS", "").split()

@@ -39,12 +39,17 @@ def init_cache(model, batch, total_len):
 
 
 def generate(model, params, prompt, max_new_tokens, temperature=0.0,
-             rng=None):
+             rng=None, top_k=None, eos_token=None, pad_token=0):
     """[B, S] prompt -> [B, S + max_new_tokens] generated tokens.
 
     ``model`` must be a decode-mode instance (``decode=True``) whose
     ``max_len >= S + max_new_tokens``. Deterministic (greedy) when
-    ``temperature == 0``; otherwise ``rng`` is required.
+    ``temperature == 0``; otherwise ``rng`` is required. ``top_k``
+    restricts sampling to the k highest logits. ``eos_token`` freezes a
+    sequence once emitted — output positions after it become
+    ``pad_token`` — with STATIC shapes (every sequence still runs
+    ``max_new_tokens`` steps; finished ones just stop changing, the
+    TPU-correct formulation of early stop).
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     b, s = prompt.shape
@@ -55,6 +60,8 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
                 model.max_len, s, max_new_tokens))
     if temperature and rng is None:
         raise ValueError("temperature sampling needs a PRNG key")
+    if top_k is not None and int(top_k) < 1:
+        raise ValueError("top_k must be >= 1, got {}".format(top_k))
     if rng is None:
         rng = jax.random.PRNGKey(0)
     cache = init_cache(model, b, model.max_len)
@@ -75,44 +82,64 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         prompt.T)
 
     def pick(logits, key):
+        if top_k is not None:
+            kth = jax.lax.top_k(logits, int(top_k))[0][:, -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
         if temperature:
             return jax.random.categorical(key, logits / temperature, axis=-1)
         return jnp.argmax(logits, axis=-1)
 
-    def decode_step(carry, key):
-        cache, logits = carry
+    def pick_frozen(logits, key, done):
+        """pick(), but finished sequences emit pad and stay finished."""
         token = pick(logits, key).astype(jnp.int32)
+        if eos_token is None:
+            return token, done
+        token = jnp.where(done, jnp.int32(pad_token), token)
+        return token, done | (token == eos_token)
+
+    done0 = jnp.zeros((b,), bool)
+
+    def decode_step(carry, key):
+        cache, logits, done = carry
+        token, done = pick_frozen(logits, key, done)
         cache, next_logits = one_token(cache, token[:, None])
-        return (cache, next_logits), token
+        return (cache, next_logits, done), token
 
     # the LAST token needs no cache-advancing forward: scan N-1 steps,
     # then pick once from the carried logits (N forwards would waste one)
     keys = jax.random.split(rng, max_new_tokens)
     if max_new_tokens > 1:
-        (cache, logits), body_tokens = jax.lax.scan(
-            decode_step, (cache, logits), keys[:-1])
+        (cache, logits, done0), body_tokens = jax.lax.scan(
+            decode_step, (cache, logits, done0), keys[:-1])
     else:
         body_tokens = jnp.zeros((0, b), jnp.int32)
-    last = pick(logits, keys[-1]).astype(jnp.int32)
+    last, _ = pick_frozen(logits, keys[-1], done0)
     new_tokens = jnp.concatenate([body_tokens, last[None]], axis=0)
     return jnp.concatenate([prompt, new_tokens.T], axis=1)
 
 
 @functools.lru_cache(maxsize=64)
-def _jitted_generate(model, max_new_tokens, temperature):
-    # flax Modules are frozen dataclasses (hashable), so (model, N, T)
-    # keys a REUSED jitted fn — a fresh jax.jit(lambda) per call would
-    # recompile every time
+def _jitted_generate(model, max_new_tokens, temperature, top_k, eos_token,
+                     pad_token):
+    # flax Modules are frozen dataclasses (hashable), so the option
+    # tuple keys a REUSED jitted fn — a fresh jax.jit(lambda) per call
+    # would recompile every time
     return jax.jit(
         lambda params, tokens, key: generate(
-            model, params, tokens, max_new_tokens, temperature, key))
+            model, params, tokens, max_new_tokens, temperature, key,
+            top_k=top_k, eos_token=eos_token, pad_token=pad_token))
 
 
 def generate_jit(model, params, prompt, max_new_tokens, temperature=0.0,
-                 rng=None):
-    """jit-compiled :func:`generate`: one compile per (model,
-    max_new_tokens, temperature) x input-shape signature, cached across
-    calls."""
-    fn = _jitted_generate(model, int(max_new_tokens), float(temperature))
+                 rng=None, top_k=None, eos_token=None, pad_token=0):
+    """jit-compiled :func:`generate`: one compile per option tuple x
+    input-shape signature, cached across calls."""
+    # normalize to hashable python scalars: array-typed eos_token (a
+    # natural way to pass it) would crash lru_cache, and 5.0 vs 5 would
+    # key two compiles of the identical program
+    fn = _jitted_generate(model, int(max_new_tokens), float(temperature),
+                          None if top_k is None else int(top_k),
+                          None if eos_token is None else int(eos_token),
+                          int(pad_token))
     return fn(params, prompt,
               rng if rng is not None else jax.random.PRNGKey(0))

@@ -123,3 +123,38 @@ def test_tp_sharded_decode_matches_replicated(lm):
     with mesh:
         tp = generation.generate(decode_model, sparams, prompt, 5)
     np.testing.assert_array_equal(np.asarray(base), np.asarray(tp))
+
+
+def test_top_k_restricts_to_greedy_at_k1(lm):
+    """top_k=1 with any temperature must equal greedy decoding."""
+    _, decode_model, params = lm
+    prompt = jnp.ones((2, 4), jnp.int32)
+    greedy = generation.generate(decode_model, params, prompt, 5)
+    k1 = generation.generate(decode_model, params, prompt, 5,
+                             temperature=1.5, rng=jax.random.PRNGKey(9),
+                             top_k=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+
+def test_eos_freezes_sequences(lm):
+    """After eos, a sequence emits pad_token for every later position,
+    while other sequences keep generating (static shapes throughout)."""
+    _, decode_model, params = lm
+    prompt = jnp.asarray(
+        np.random.RandomState(3).randint(0, V, (3, 4)), jnp.int32)
+    base = generation.generate(decode_model, params, prompt, 8)
+    # choose as "eos" a token the greedy rollout actually emits early
+    gen_part = np.asarray(base[:, 4:])
+    eos = int(gen_part[0, 1])
+    out = np.asarray(generation.generate(
+        decode_model, params, prompt, 8, eos_token=eos, pad_token=7))
+    for row in out[:, 4:]:
+        hits = np.where(row == eos)[0]
+        if hits.size:
+            after = row[hits[0] + 1:]
+            assert np.all(after == 7), (row, eos)
+    # the frozen run matches the base rollout UP TO each eos position
+    for brow, frow in zip(gen_part, out[:, 4:]):
+        hits = np.where(frow == eos)[0]
+        upto = hits[0] + 1 if hits.size else len(frow)
+        np.testing.assert_array_equal(brow[:upto], frow[:upto])

@@ -73,6 +73,7 @@ def main(argv=None):
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    loss = float("nan")  # --steps 0: decode-only run, loss never computed
     for i in range(args.steps):
         params, opt_state, loss = step(params, opt_state, batch())
         if i % 50 == 0:

@@ -73,7 +73,7 @@ def main(argv=None):
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    loss = float("nan")  # --steps 0: decode-only run, loss never computed
+    loss = None  # --steps 0: decode-only run, loss never computed
     for i in range(args.steps):
         params, opt_state, loss = step(params, opt_state, batch())
         if i % 50 == 0:
@@ -91,7 +91,7 @@ def main(argv=None):
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
         with open(args.out, "w") as f:
-            json.dump({"loss": float(loss),
+            json.dump({"loss": None if loss is None else float(loss),
                        "prompt": np.asarray(prompt[0]).tolist(),
                        "generated": generated}, f)
 

@@ -39,13 +39,16 @@ def init_cache(model, batch, total_len):
 
 
 def generate(model, params, prompt, max_new_tokens, temperature=0.0,
-             rng=None, top_k=None, eos_token=None, pad_token=0):
+             rng=None, top_k=None, top_p=None, eos_token=None,
+             pad_token=0):
     """[B, S] prompt -> [B, S + max_new_tokens] generated tokens.
 
     ``model`` must be a decode-mode instance (``decode=True``) whose
     ``max_len >= S + max_new_tokens``. Deterministic (greedy) when
     ``temperature == 0``; otherwise ``rng`` is required. ``top_k``
-    restricts sampling to the k highest logits. ``eos_token`` freezes a
+    restricts sampling to the k highest logits; ``top_p`` to the
+    smallest nucleus whose probability mass reaches p (composable:
+    top_k filters first). ``eos_token`` freezes a
     sequence once emitted — output positions after it become
     ``pad_token`` — with STATIC shapes (every sequence still runs
     ``max_new_tokens`` steps; finished ones just stop changing, the
@@ -62,6 +65,8 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         raise ValueError("temperature sampling needs a PRNG key")
     if top_k is not None and int(top_k) < 1:
         raise ValueError("top_k must be >= 1, got {}".format(top_k))
+    if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+        raise ValueError("top_p must be in (0, 1], got {}".format(top_p))
     if rng is None:
         rng = jax.random.PRNGKey(0)
     cache = init_cache(model, b, model.max_len)
@@ -81,10 +86,31 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         prefill_step, (cache, jnp.zeros((b, model.vocab), jnp.float32)),
         prompt.T)
 
+    rows = jnp.arange(b)[:, None]
+
     def pick(logits, key):
+        # both filters mask by INDEX, not by value threshold: a value
+        # cutoff keeps every token tied with the boundary logit, which
+        # degenerates to a no-op on tied/uniform logits
         if top_k is not None:
-            kth = jax.lax.top_k(logits, int(top_k))[0][:, -1:]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
+            _, idx_k = jax.lax.top_k(logits, int(top_k))
+            keep = jnp.zeros(logits.shape, bool).at[rows, idx_k].set(True)
+            logits = jnp.where(keep, logits, -jnp.inf)
+        if top_p is not None and top_p < 1.0:
+            # nucleus: smallest prefix of the sorted distribution whose
+            # mass reaches top_p (the head token always survives).
+            # top_p >= 1.0 is an exact no-op by construction — the
+            # cumsum formulation would drop tail tokens once float32
+            # saturates at 1.0.
+            idx = jnp.argsort(logits, axis=-1)[:, ::-1]
+            sorted_logits = jnp.take_along_axis(logits, idx, axis=-1)
+            probs = jax.nn.softmax(sorted_logits / (temperature or 1.0),
+                                   axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep_sorted = cum - probs < top_p  # mass BEFORE this token
+            keep = jnp.zeros(logits.shape, bool).at[rows, idx].set(
+                keep_sorted)
+            logits = jnp.where(keep, logits, -jnp.inf)
         if temperature:
             return jax.random.categorical(key, logits / temperature, axis=-1)
         return jnp.argmax(logits, axis=-1)
@@ -119,19 +145,21 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
 
 
 @functools.lru_cache(maxsize=64)
-def _jitted_generate(model, max_new_tokens, temperature, top_k, eos_token,
-                     pad_token):
+def _jitted_generate(model, max_new_tokens, temperature, top_k, top_p,
+                     eos_token, pad_token):
     # flax Modules are frozen dataclasses (hashable), so the option
     # tuple keys a REUSED jitted fn — a fresh jax.jit(lambda) per call
     # would recompile every time
     return jax.jit(
         lambda params, tokens, key: generate(
             model, params, tokens, max_new_tokens, temperature, key,
-            top_k=top_k, eos_token=eos_token, pad_token=pad_token))
+            top_k=top_k, top_p=top_p, eos_token=eos_token,
+            pad_token=pad_token))
 
 
 def generate_jit(model, params, prompt, max_new_tokens, temperature=0.0,
-                 rng=None, top_k=None, eos_token=None, pad_token=0):
+                 rng=None, top_k=None, top_p=None, eos_token=None,
+                 pad_token=0):
     """jit-compiled :func:`generate`: one compile per option tuple x
     input-shape signature, cached across calls."""
     # normalize to hashable python scalars: array-typed eos_token (a
@@ -139,6 +167,7 @@ def generate_jit(model, params, prompt, max_new_tokens, temperature=0.0,
     # key two compiles of the identical program
     fn = _jitted_generate(model, int(max_new_tokens), float(temperature),
                           None if top_k is None else int(top_k),
+                          None if top_p is None else float(top_p),
                           None if eos_token is None else int(eos_token),
                           int(pad_token))
     return fn(params, prompt,

@@ -38,6 +38,33 @@ def init_cache(model, batch, total_len):
                         shapes["cache"])
 
 
+def filter_logits(logits, top_k=None, top_p=None, temperature=0.0):
+    """Apply top-k then nucleus filtering to ``[B, V]`` logits.
+
+    Both filters mask by INDEX, not by value threshold: a value cutoff
+    keeps every token tied with the boundary logit, which degenerates to
+    a no-op on tied/uniform logits. ``top_p >= 1.0`` is an exact no-op
+    by construction — the cumsum formulation would drop tail tokens once
+    float32 saturates at 1.0. The nucleus keeps the smallest sorted
+    prefix whose mass reaches p (the head token always survives).
+    """
+    rows = jnp.arange(logits.shape[0])[:, None]
+    if top_k is not None:
+        _, idx_k = jax.lax.top_k(logits, int(top_k))
+        keep = jnp.zeros(logits.shape, bool).at[rows, idx_k].set(True)
+        logits = jnp.where(keep, logits, -jnp.inf)
+    if top_p is not None and top_p < 1.0:
+        idx = jnp.argsort(logits, axis=-1)[:, ::-1]
+        sorted_logits = jnp.take_along_axis(logits, idx, axis=-1)
+        probs = jax.nn.softmax(sorted_logits / (temperature or 1.0),
+                               axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = cum - probs < top_p  # mass BEFORE this token
+        keep = jnp.zeros(logits.shape, bool).at[rows, idx].set(keep_sorted)
+        logits = jnp.where(keep, logits, -jnp.inf)
+    return logits
+
+
 def generate(model, params, prompt, max_new_tokens, temperature=0.0,
              rng=None, top_k=None, top_p=None, eos_token=None,
              pad_token=0):
@@ -86,31 +113,9 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         prefill_step, (cache, jnp.zeros((b, model.vocab), jnp.float32)),
         prompt.T)
 
-    rows = jnp.arange(b)[:, None]
-
     def pick(logits, key):
-        # both filters mask by INDEX, not by value threshold: a value
-        # cutoff keeps every token tied with the boundary logit, which
-        # degenerates to a no-op on tied/uniform logits
-        if top_k is not None:
-            _, idx_k = jax.lax.top_k(logits, int(top_k))
-            keep = jnp.zeros(logits.shape, bool).at[rows, idx_k].set(True)
-            logits = jnp.where(keep, logits, -jnp.inf)
-        if top_p is not None and top_p < 1.0:
-            # nucleus: smallest prefix of the sorted distribution whose
-            # mass reaches top_p (the head token always survives).
-            # top_p >= 1.0 is an exact no-op by construction — the
-            # cumsum formulation would drop tail tokens once float32
-            # saturates at 1.0.
-            idx = jnp.argsort(logits, axis=-1)[:, ::-1]
-            sorted_logits = jnp.take_along_axis(logits, idx, axis=-1)
-            probs = jax.nn.softmax(sorted_logits / (temperature or 1.0),
-                                   axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            keep_sorted = cum - probs < top_p  # mass BEFORE this token
-            keep = jnp.zeros(logits.shape, bool).at[rows, idx].set(
-                keep_sorted)
-            logits = jnp.where(keep, logits, -jnp.inf)
+        logits = filter_logits(logits, top_k=top_k, top_p=top_p,
+                               temperature=temperature)
         if temperature:
             return jax.random.categorical(key, logits / temperature, axis=-1)
         return jnp.argmax(logits, axis=-1)

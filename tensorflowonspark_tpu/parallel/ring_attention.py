@@ -76,9 +76,49 @@ def _merge_partials(out_a, lse_a, out_b, lse_b):
     return out_a * w_a + out_b * w_b, lse
 
 
+def zigzag_order(axis_size):
+    """Half-block placement for the load-balanced causal layout.
+
+    Returns the global half-block index held at each position of the
+    zigzag layout: shard ``r`` holds half-blocks ``(r, 2P-1-r)`` — one
+    early, one mirrored late — so under causal masking every shard has
+    the same amount of live attention work at EVERY ring step, instead
+    of early shards idling while late shards bound each lockstep step.
+    """
+    order = []
+    for r in range(axis_size):
+        order += [r, 2 * axis_size - 1 - r]
+    return order
+
+
+def to_zigzag(x, axis_size, axis=1):
+    """Permute a [.., S, ..] global array into the zigzag layout (so a
+    contiguous ``seq``-sharding gives each shard its early+late pair).
+    S must divide by 2*axis_size. Inverse: :func:`from_zigzag`."""
+    s = x.shape[axis]
+    hb = 2 * axis_size
+    if s % hb:
+        raise ValueError(
+            "sequence {} not divisible by 2*axis_size={}".format(s, hb))
+    parts = jnp.split(x, hb, axis=axis)
+    return jnp.concatenate([parts[i] for i in zigzag_order(axis_size)],
+                           axis=axis)
+
+
+def from_zigzag(x, axis_size, axis=1):
+    """Inverse of :func:`to_zigzag`."""
+    hb = 2 * axis_size
+    order = zigzag_order(axis_size)
+    inverse = [0] * hb
+    for pos, blk in enumerate(order):
+        inverse[blk] = pos
+    parts = jnp.split(x, hb, axis=axis)
+    return jnp.concatenate([parts[i] for i in inverse], axis=axis)
+
+
 def ring_flash_attention(q, k, v, mesh, seq_axis="seq", causal=False,
                          scale=None, block_q=None, block_k=None,
-                         interpret=None):
+                         interpret=None, layout="contiguous"):
     """Ring attention with the fused flash kernel as the block engine.
 
     Same contract and ppermute schedule as :func:`ring_attention`, but
@@ -94,6 +134,14 @@ def ring_flash_attention(q, k, v, mesh, seq_axis="seq", causal=False,
     diagonal (standard local causal), or fully-masked (kv strictly
     future) — selected with ``lax.switch`` on the rotating source rank,
     no global-position support needed in the kernel.
+
+    ``layout="zigzag"`` (causal only): inputs/outputs are in the
+    :func:`to_zigzag` permutation — each shard holds an early half-block
+    and its mirrored late half-block, so every shard does the SAME
+    amount of live work each ring step. The contiguous layout's causal
+    wall time is bounded by the busiest shard (a full block per step,
+    ~2x the average work); zigzag makes each step cost ~one half-block
+    pair everywhere, recovering the factor-2.
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -106,6 +154,17 @@ def ring_flash_attention(q, k, v, mesh, seq_axis="seq", causal=False,
     block_k = block_k or DEFAULT_BLOCK_K
     axis_size = mesh.shape[seq_axis]
     spec = P(None, seq_axis, None, None)
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError("layout must be 'contiguous' or 'zigzag'")
+    if layout == "zigzag" and not causal:
+        raise ValueError(
+            "zigzag layout only helps (and is only implemented for) "
+            "causal attention — non-causal work is already balanced")
+
+    def _flash(qb, kb, vb, diag):
+        return flash_attention_lse(qb, kb, vb, causal=diag, scale=scale,
+                                   block_q=block_q, block_k=block_k,
+                                   interpret=interpret)
 
     @functools.partial(
         shard_map, mesh=mesh, in_specs=(spec, spec, spec),
@@ -116,23 +175,18 @@ def ring_flash_attention(q, k, v, mesh, seq_axis="seq", causal=False,
 
         def flash_full(args):
             qb, kb, vb = args
-            return flash_attention_lse(qb, kb, vb, causal=False,
-                                       scale=scale, block_q=block_q,
-                                       block_k=block_k,
-                                       interpret=interpret)
+            return _flash(qb, kb, vb, False)
 
         def flash_diag(args):
             qb, kb, vb = args
-            return flash_attention_lse(qb, kb, vb, causal=True,
-                                       scale=scale, block_q=block_q,
-                                       block_k=block_k,
-                                       interpret=interpret)
+            return _flash(qb, kb, vb, True)
 
         def masked(args):
             qb, _, _ = args
             return (jnp.zeros_like(qb),
-                    jnp.full((b, n, s_local), -jnp.inf, jnp.float32))
+                    jnp.full((b, n, qb.shape[1]), -jnp.inf, jnp.float32))
 
+        branches = (masked, flash_diag, flash_full)
         perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
         def step(t, carry):
@@ -143,8 +197,7 @@ def ring_flash_attention(q, k, v, mesh, seq_axis="seq", causal=False,
                 idx = jnp.int32(1) + jnp.sign(rank - src_rank).astype(
                     jnp.int32)
                 out_t, lse_t = jax.lax.switch(
-                    idx, (masked, flash_diag, flash_full),
-                    (q_blk, k_cur, v_cur))
+                    idx, branches, (q_blk, k_cur, v_cur))
             else:
                 out_t, lse_t = flash_full((q_blk, k_cur, v_cur))
             out, lse = _merge_partials(out, lse, out_t.astype(jnp.float32),
@@ -153,12 +206,71 @@ def ring_flash_attention(q, k, v, mesh, seq_axis="seq", causal=False,
             v_nxt = jax.lax.ppermute(v_cur, seq_axis, perm)
             return out, lse, k_nxt, v_nxt
 
-        out0 = jnp.zeros((b, s_local, n, d), jnp.float32)
-        lse0 = jnp.full((b, n, s_local), -jnp.inf, jnp.float32)
-        out, lse, _, _ = jax.lax.fori_loop(
-            0, axis_size, step, (out0, lse0, k_blk, v_blk))
+        def step_zigzag(t, carry):
+            # local halves: a = early block (id rank), b = mirrored late
+            # block (id 2P-1-rank); received kv halves carry ids
+            # (src_rank, 2P-1-src_rank). The qa/kb pair is masked by
+            # construction (kb is always later), and qb/ka is always
+            # fully visible — so each step costs ~one half-pair of live
+            # work on EVERY shard, the whole point of the layout. The
+            # accumulators stay SPLIT through the loop carry; one
+            # concatenate happens after fori_loop.
+            out_a, out_b, lse_a, lse_b, k_cur, v_cur = carry
+            src_rank = (rank - t) % axis_size
+            h = s_local // 2
+            qa, qb = q_blk[:, :h], q_blk[:, h:]
+            ka, kb = k_cur[:, :h], k_cur[:, h:]
+            va, vb = v_cur[:, :h], v_cur[:, h:]
+
+            # qa vs ka: ids (rank, src) — past/diag/future by sign
+            idx_a = jnp.int32(1) + jnp.sign(rank - src_rank).astype(
+                jnp.int32)
+            o, s_ = jax.lax.switch(idx_a, branches, (qa, ka, va))
+            out_a, lse_a = _merge_partials(out_a, lse_a,
+                                           o.astype(jnp.float32), s_)
+            # qb vs ka: qb id >= P > ka id — always fully visible
+            o, s_ = flash_full((qb, ka, va))
+            out_b, lse_b = _merge_partials(out_b, lse_b,
+                                           o.astype(jnp.float32), s_)
+            # qb vs kb: ids (2P-1-rank, 2P-1-src) — order flips
+            idx_b = jnp.int32(1) + jnp.sign(src_rank - rank).astype(
+                jnp.int32)
+            o, s_ = jax.lax.switch(idx_b, branches, (qb, kb, vb))
+            out_b, lse_b = _merge_partials(out_b, lse_b,
+                                           o.astype(jnp.float32), s_)
+            # qa vs kb: kb is strictly later than qa for every rank pair
+
+            k_nxt = jax.lax.ppermute(k_cur, seq_axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, seq_axis, perm)
+            return out_a, out_b, lse_a, lse_b, k_nxt, v_nxt
+
+        if layout == "zigzag":
+            h = s_local // 2
+            oh = jnp.zeros((b, h, n, d), jnp.float32)
+            lh = jnp.full((b, n, h), -jnp.inf, jnp.float32)
+            out_a, out_b, lse_a, lse_b, _, _ = jax.lax.fori_loop(
+                0, axis_size, step_zigzag, (oh, oh, lh, lh, k_blk, v_blk))
+            out = jnp.concatenate([out_a, out_b], axis=1)
+        else:
+            out0 = jnp.zeros((b, s_local, n, d), jnp.float32)
+            lse0 = jnp.full((b, n, s_local), -jnp.inf, jnp.float32)
+            out, lse, _, _ = jax.lax.fori_loop(
+                0, axis_size, step, (out0, lse0, k_blk, v_blk))
         return out.astype(q_blk.dtype)
 
+    if layout == "zigzag":
+        s_local = q.shape[1] // axis_size
+        if s_local % 2:
+            raise ValueError(
+                "zigzag needs an even per-shard length, got {}".format(
+                    s_local))
+        half = s_local // 2
+        if half % block_q or half % block_k:
+            # the flash kernel sees HALF-length sequences under zigzag;
+            # fail here instead of a confusing kernel assert downstream
+            raise ValueError(
+                "zigzag half-block length {} must be divisible by "
+                "block_q={} and block_k={}".format(half, block_q, block_k))
     return _ring(q, k, v)
 
 

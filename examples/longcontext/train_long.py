@@ -24,13 +24,18 @@ def main(argv=None):
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--period", type=int, default=37)
+    ap.add_argument("--layout", choices=["contiguous", "zigzag"],
+                    default="contiguous",
+                    help="zigzag: load-balanced causal ring (~2x less "
+                         "causal wall time on a real ring)")
     args = ap.parse_args(argv)
 
     from examples.longcontext import long_dist
 
     first, last = long_dist.train(
         seq_len=args.seq_len, batch=args.batch, steps=args.steps,
-        hidden=args.hidden, layers=args.layers, period=args.period)
+        hidden=args.hidden, layers=args.layers, period=args.period,
+        layout=args.layout)
     print("first loss %.4f -> last loss %.4f" % (first, last))
     if last >= first:
         raise SystemExit("loss did not improve")

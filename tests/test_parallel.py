@@ -479,3 +479,19 @@ def test_zigzag_rejects_bad_configs(jax):
         ring_flash_attention(q, q, q, mesh, causal=True, layout="spiral")
     with _pytest.raises(ValueError, match="divisible"):
         to_zigzag(np.zeros((1, 24, 2, 8), np.float32), 8)
+
+
+def test_longcontext_zigzag_matches_contiguous(jax):
+    """The long-context LM trains identically (same loss trajectory, up
+    to float reassociation) in zigzag and contiguous layouts — the
+    permutation must be semantics-free end to end."""
+    from examples.longcontext import long_dist
+
+    kwargs = dict(seq_len=8 * 32, batch=1, vocab=16, hidden=32, heads=2,
+                  layers=1, period=11, steps=6, block=16, interpret=True,
+                  log_every=0)
+    f_c, l_c = long_dist.train(layout="contiguous", **kwargs)
+    f_z, l_z = long_dist.train(layout="zigzag", **kwargs)
+    assert abs(f_c - f_z) < 1e-3, (f_c, f_z)
+    assert abs(l_c - l_z) < 5e-2 * max(abs(l_c), 1e-3), (l_c, l_z)
+    assert l_z < f_z  # and it actually learns in the zigzag layout

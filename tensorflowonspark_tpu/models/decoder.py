@@ -1,11 +1,14 @@
 """Decoder-only causal LM with KV-cache decode support.
 
 The inference-side sibling of the sequence-parallel training LM
-(examples/longcontext/long_dist.py): same decoder-only shape, but the
-attention is flax's ``MultiHeadDotProductAttention`` whose ``decode``
-mode maintains the standard KV cache ("cache" variable collection), so
+(examples/longcontext/long_dist.py). Full-sequence (training) passes
+run the fused flash attention kernel (ops/flash_attention.py — Pallas
+on TPU, O(S) attention memory; XLA reference elsewhere); ``decode``
+mode maintains an explicit KV cache ("cache" variable collection) so
 autoregressive generation (generation.py) costs O(S) per new token
-instead of re-running the O(S^2) prefix.
+instead of re-running the O(S^2) prefix. The attention parameter tree
+matches flax's ``MultiHeadDotProductAttention`` layout, so the
+DECODER_TP_RULES catalog and checkpoints are layout-stable.
 
 The reference framework has no generation story at all (its inference
 is batch scoring — SURVEY.md §3.3); this is a don't-stop-at-parity
@@ -13,8 +16,91 @@ addition shaped for TPU: static shapes everywhere (cache pre-allocated
 at ``max_len``), decode steps under ``lax.scan``.
 """
 
+import functools
+
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+
+
+class CausalSelfAttention(nn.Module):
+    """Causal attention: fused flash kernel for training, explicit KV
+    cache for decode.
+
+    Parameter structure deliberately matches flax's
+    ``MultiHeadDotProductAttention`` (query/key/value DenseGeneral with
+    [H, N, D] kernels, out with [N, D, H]) so TP rule catalogs
+    (DECODER_TP_RULES) and existing checkpoints keep working — only the
+    attention COMPUTATION differs: full-sequence passes run
+    ``ops.flash_attention`` (Pallas on TPU, O(S) memory; XLA reference
+    elsewhere) instead of materializing the [S, S] score matrix, and
+    decode-mode single-token steps attend against this module's own
+    cache variables (cached_key/cached_value/cache_index).
+    """
+
+    num_heads: int
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        import importlib
+
+        fa = importlib.import_module(
+            "tensorflowonspark_tpu.ops.flash_attention")
+
+        b, s, h = x.shape
+        if h % self.num_heads:
+            raise ValueError(
+                "hidden size {} not divisible by num_heads {}".format(
+                    h, self.num_heads))
+        head_dim = h // self.num_heads
+        dg = functools.partial(nn.DenseGeneral,
+                               features=(self.num_heads, head_dim), axis=-1)
+        q = dg(name="query")(x)
+        k = dg(name="key")(x)
+        v = dg(name="value")(x)
+
+        if self.decode:
+            is_initialized = self.has_variable("cache", "cached_key")
+            cached_key = self.variable(
+                "cache", "cached_key", jnp.zeros, k.shape, k.dtype)
+            cached_value = self.variable(
+                "cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+            cache_index = self.variable(
+                "cache", "cache_index",
+                lambda: jnp.zeros((), jnp.int32))
+            if is_initialized:
+                # one token per step against the cache prefix
+                if s != 1:
+                    raise ValueError(
+                        "decode mode feeds one token per call, got "
+                        "length {}".format(s))
+                idx = cache_index.value
+                max_len = cached_key.value.shape[1]
+                ck = cached_key.value.at[:, idx].set(k[:, 0])
+                cv = cached_value.value.at[:, idx].set(v[:, 0])
+                cached_key.value = ck
+                cached_value.value = cv
+                cache_index.value = idx + 1
+                scale = head_dim ** -0.5
+                logits = jnp.einsum("bqnd,bknd->bnqk", q, ck,
+                                    preferred_element_type=jnp.float32)
+                logits = logits * scale
+                visible = jnp.arange(max_len) <= idx
+                logits = jnp.where(visible[None, None, None, :], logits,
+                                   jnp.finfo(jnp.float32).min)
+                probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
+                ctx = jnp.einsum("bnqk,bknd->bqnd", probs, cv)
+            else:
+                # cache creation pass (full-length dummy): shapes only
+                ctx = v
+        elif s % fa.DEFAULT_BLOCK_Q == 0:
+            ctx = fa.flash_attention(q, k, v, causal=True)
+        else:
+            # the Pallas kernel needs seq % block == 0 on TPU; short or
+            # oddly-shaped sequences take the exact XLA reference
+            ctx = fa._reference(q, k, v, True, head_dim ** -0.5)
+        return nn.DenseGeneral(h, axis=(-2, -1), name="out")(ctx)
 
 
 class DecoderBlock(nn.Module):
@@ -22,14 +108,13 @@ class DecoderBlock(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, mask=None):
-        h = x.shape[-1]
+    def __call__(self, x):
         y = nn.LayerNorm(name="ln1")(x)
-        y = nn.MultiHeadDotProductAttention(
-            num_heads=self.num_heads, qkv_features=h,
-            decode=self.decode, name="attn")(y, y, mask=mask)
+        y = CausalSelfAttention(self.num_heads, decode=self.decode,
+                                name="attn")(y)
         x = x + y
         y = nn.LayerNorm(name="ln2")(x)
+        h = x.shape[-1]
         y = nn.Dense(4 * h, name="mlp_in")(y)
         y = nn.gelu(y)
         y = nn.Dense(h, name="mlp_out")(y)
@@ -73,12 +158,12 @@ class DecoderLM(nn.Module):
                 (s, self.hidden))[None]
             if not initializing:
                 pos_idx.value = pos_idx.value + s
-            mask = None  # the attention cache masks up to its own index
         else:
             x = x + pos_embed[:s][None]
-            mask = nn.make_causal_mask(tokens)
+        # causality lives inside CausalSelfAttention (flash kernel /
+        # cache visibility) — no mask threading
         for i in range(self.num_layers):
             x = DecoderBlock(self.num_heads, decode=self.decode,
-                             name="block_%d" % i)(x, mask=mask)
+                             name="block_%d" % i)(x)
         x = nn.LayerNorm(name="ln_f")(x)
         return nn.Dense(self.vocab, name="head")(x)

@@ -71,7 +71,10 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     """[B, S] prompt -> [B, S + max_new_tokens] generated tokens.
 
     ``model`` must be a decode-mode instance (``decode=True``) whose
-    ``max_len >= S + max_new_tokens``. Deterministic (greedy) when
+    ``max_len >= S + max_new_tokens``. Prompts must be REAL tokens of
+    uniform length — there is no padding mask in the decode cache, so a
+    padded ragged batch would silently attend its pad positions; bucket
+    ragged prompts by length instead. Deterministic (greedy) when
     ``temperature == 0``; otherwise ``rng`` is required. ``top_k``
     restricts sampling to the k highest logits; ``top_p`` to the
     smallest nucleus whose probability mass reaches p (composable:

@@ -300,3 +300,49 @@ def test_dfutil_roundtrip(tmp_path, request):
         assert abs(g["weight"] - w["weight"]) < 1e-6
         assert g["text"] == w["text"]
         assert np.allclose(g["vec"], w["vec"])
+
+
+def test_fuzz_native_vs_python_roundtrip(tmp_path, monkeypatch):
+    """Seeded fuzz: random feature dicts (empty lists, zero-length
+    bytes, negative/64-bit ints, float specials, many features) written
+    once, then parsed identically by the native and pure-python paths."""
+    rng = np.random.RandomState(1234)
+
+    def rand_value(kind):
+        n = int(rng.randint(0, 6))
+        if kind == 0:  # bytes, incl. zero-length blobs
+            return [bytes(rng.randint(0, 256, size=rng.randint(0, 32),
+                                      dtype=np.uint8).tobytes())
+                    for _ in range(n)]
+        if kind == 1:  # floats incl. specials
+            pool = [0.0, -0.0, 1.5e38, -1.5e-38, 3.25, -7.0]
+            return [float(pool[rng.randint(len(pool))]) for _ in range(n)]
+        # int64 incl. negatives and 2^62-scale magnitudes
+        pool = [0, 1, -1, 2**31, -(2**31), 2**62, -(2**62), 255]
+        return [int(pool[rng.randint(len(pool))]) for _ in range(n)]
+
+    path = str(tmp_path / "fuzz.tfrecord")
+    examples = []
+    with tfrecord.TFRecordWriter(path) as w:
+        for _ in range(200):
+            feats = {}
+            for j in range(int(rng.randint(0, 8))):
+                feats["f%d_%d" % (j, rng.randint(3))] = rand_value(
+                    int(rng.randint(3)))
+            examples.append(feats)
+            w.write(tfrecord.encode_example(feats))
+
+    monkeypatch.setattr(tfrecord, "_NATIVE", True)
+    native = [tfrecord.parse_example(r)
+              for r in tfrecord.tfrecord_iterator(path)]
+    monkeypatch.setattr(tfrecord, "_NATIVE", False)
+    pure = [tfrecord.parse_example(r)
+            for r in tfrecord.tfrecord_iterator(path)]
+    assert len(native) == len(pure) == 200
+    for a, b in zip(native, pure):
+        assert a.keys() == b.keys()
+        for name in a:
+            ka, va = a[name]
+            kb, vb = b[name]
+            assert ka == kb
+            np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))

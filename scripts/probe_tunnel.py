@@ -8,47 +8,31 @@ relay port actually accepts, so the 1-core box isn't taxed while
 waiting. On success, run `make onchip` IMMEDIATELY.
 """
 
-import socket
-import subprocess
+import os
 import sys
 import time
 
-PORTS = [8082, 8083, 8087, 8092, 8093, 8097, 8102, 8103, 8107, 8112,
-         8113, 8117]
-CODE = ("import jax, jax.numpy as jnp; x=jnp.ones((128,128)); "
-        "print('OK', float((x@x)[0,0]))")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tensorflowonspark_tpu import util
 
 
-def port_up():
-    for p in PORTS:
-        s = socket.socket()
-        s.settimeout(2)
-        try:
-            s.connect(("127.0.0.1", p))
-            return True
-        except OSError:
-            pass
-        finally:
-            s.close()
-    return False
-
-
-def main(max_minutes=1200):
-    for attempt in range(max_minutes):
-        if port_up():
-            print("ports up at attempt", attempt, "- trying matmul",
+def main(max_hours=20.0):
+    deadline = time.monotonic() + max_hours * 3600
+    attempt = 0
+    while time.monotonic() < deadline:
+        if util.axon_port_up():
+            print("ports up at attempt", attempt, "- probing compute",
                   flush=True)
-            try:
-                out = subprocess.run([sys.executable, "-c", CODE],
-                                     capture_output=True, text=True,
-                                     timeout=300)
-                if "OK" in out.stdout:
-                    print("TPU COMPUTE LIVE - run `make onchip` NOW",
-                          flush=True)
-                    return 0
-                print("matmul failed rc", out.returncode, flush=True)
-            except subprocess.TimeoutExpired:
-                print("matmul timeout", flush=True)
+            ok, detail = util.axon_compute_probe()
+            if ok:
+                # the probe asserts the backend is a real TPU platform,
+                # so a CPU fallback can never read as tunnel health
+                print("TPU COMPUTE LIVE - run `make onchip` NOW",
+                      flush=True)
+                return 0
+            print("compute probe failed:", detail, flush=True)
+        attempt += 1
         time.sleep(60)
     return 1
 

@@ -91,3 +91,54 @@ def single_node_env(num_devices=1):
     """
     os.environ.setdefault("OMP_NUM_THREADS", "1")
     os.environ.setdefault("OPENBLAS_NUM_THREADS", "1")
+
+
+#: the axon tunnel's relay ports (BASELINE.md hardware notes) — shared
+#: by every tunnel-health probe so the lists cannot drift apart
+AXON_RELAY_PORTS = (8082, 8083, 8087, 8092, 8093, 8097, 8102, 8103,
+                    8107, 8112, 8113, 8117)
+
+
+def axon_port_up(timeout=2.0):
+    """True when any tunnel relay port accepts a TCP connection.
+
+    Necessary but NOT sufficient for working compute: the round-4
+    half-dead regime accepted connections while every device op hung —
+    callers needing certainty must follow up with a timeout-bounded
+    matmul in a subprocess (scripts/probe_tunnel.py's pattern).
+    """
+    import socket
+
+    for port in AXON_RELAY_PORTS:
+        s = socket.socket()
+        s.settimeout(timeout)
+        try:
+            s.connect(("127.0.0.1", port))
+            return True
+        except OSError:
+            pass
+        finally:
+            s.close()
+    return False
+
+
+def axon_compute_probe(timeout=240):
+    """(ok, detail): run a tiny matmul on the tunnel in a THROWAWAY
+    subprocess (bounded by ``timeout``) and confirm it actually executed
+    on a TPU backend — a CPU fallback must not read as tunnel health."""
+    import subprocess
+    import sys
+
+    code = ("import jax, jax.numpy as jnp; "
+            "assert jax.devices()[0].platform in ('tpu', 'axon'), "
+            "jax.devices()[0].platform; "
+            "x = jnp.ones((128, 128)); print('OK', float((x @ x)[0, 0]))")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False, "compute probe timed out after {}s".format(timeout)
+    if "OK" in out.stdout:
+        return True, "ok"
+    return False, (out.stderr or out.stdout)[-300:].strip()

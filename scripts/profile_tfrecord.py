@@ -53,40 +53,55 @@ def main(tmp="/tmp/tfos-tfrec-bench"):
                 {"dense": feats[i], "label": [i % 3]}))
     dense_bytes = os.path.getsize(dense)
 
+    # _native_ok(), not _tfrecord_native.available(): it also honors the
+    # TFOS_TFRECORD_NATIVE=0 operator opt-out
+    have_native = tfrecord._native_ok()
+
     results = []
-    for use_native in (False, True):
-        tfrecord._NATIVE = use_native
-        label = "native" if use_native else "python"
+    try:
+        for use_native in (False, True):
+            label = "native" if use_native else "python"
+            if use_native and not have_native:
+                # don't force _NATIVE past the availability probe: on a
+                # host without g++/the .so the forced path would crash
+                # instead of reporting
+                print(json.dumps({"path": "native",
+                                  "unavailable": True}))
+                continue
+            tfrecord._NATIVE = use_native
 
-        dt = _time(lambda: sum(1 for _ in tfrecord.tfrecord_iterator(bulk)))
-        results.append({"regime": "bulk_iterate", "path": label,
-                        "records_per_sec": round(n_bulk / dt),
-                        "mb_per_sec": round(bulk_bytes / dt / 1e6, 1)})
+            dt = _time(lambda: sum(
+                1 for _ in tfrecord.tfrecord_iterator(bulk)))
+            results.append({"regime": "bulk_iterate", "path": label,
+                            "records_per_sec": round(n_bulk / dt),
+                            "mb_per_sec": round(bulk_bytes / dt / 1e6, 1)})
 
-        dt = _time(lambda: sum(
-            1 for _ in tfrecord.read_examples(dense)))
-        results.append({"regime": "dense_parse", "path": label,
-                        "records_per_sec": round(n_dense / dt),
-                        "mb_per_sec": round(dense_bytes / dt / 1e6, 1)})
+            dt = _time(lambda: sum(
+                1 for _ in tfrecord.read_examples(dense)))
+            results.append({"regime": "dense_parse", "path": label,
+                            "records_per_sec": round(n_dense / dt),
+                            "mb_per_sec": round(dense_bytes / dt / 1e6, 1)})
 
-        dt = _time(lambda: tfrecord.read_batch(
-            dense, {"dense": ("float32", 40), "label": ("int64", 1)}))
-        results.append({"regime": "dense_read_batch", "path": label,
-                        "records_per_sec": round(n_dense / dt),
-                        "mb_per_sec": round(dense_bytes / dt / 1e6, 1)})
-    tfrecord._NATIVE = None
+            dt = _time(lambda: tfrecord.read_batch(
+                dense, {"dense": ("float32", 40), "label": ("int64", 1)}))
+            results.append({"regime": "dense_read_batch", "path": label,
+                            "records_per_sec": round(n_dense / dt),
+                            "mb_per_sec": round(dense_bytes / dt / 1e6, 1)})
+    finally:
+        tfrecord._NATIVE = None  # never leave the probe override behind
 
     for r in results:
         print(json.dumps(r))
-    ratios = {}
-    for regime in ("bulk_iterate", "dense_parse", "dense_read_batch"):
-        py = next(r for r in results
-                  if r["regime"] == regime and r["path"] == "python")
-        nat = next(r for r in results
-                   if r["regime"] == regime and r["path"] == "native")
-        ratios[regime] = round(
-            nat["records_per_sec"] / py["records_per_sec"], 1)
-    print(json.dumps({"speedup_native_vs_python": ratios}))
+    if have_native:
+        ratios = {}
+        for regime in ("bulk_iterate", "dense_parse", "dense_read_batch"):
+            py = next(r for r in results
+                      if r["regime"] == regime and r["path"] == "python")
+            nat = next(r for r in results
+                       if r["regime"] == regime and r["path"] == "native")
+            ratios[regime] = round(
+                nat["records_per_sec"] / py["records_per_sec"], 1)
+        print(json.dumps({"speedup_native_vs_python": ratios}))
 
 
 if __name__ == "__main__":

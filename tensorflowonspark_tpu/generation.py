@@ -86,6 +86,9 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     b, s = prompt.shape
+    if int(max_new_tokens) < 0:
+        raise ValueError(
+            "max_new_tokens must be >= 0, got {}".format(max_new_tokens))
     total = s + int(max_new_tokens)
     if model.max_len < total:
         raise ValueError(
@@ -99,6 +102,12 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         raise ValueError("top_p must be in (0, 1], got {}".format(top_p))
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    if int(max_new_tokens) == 0:
+        # nothing to decode; returning the prompt keeps the output
+        # contract ([B, S + N]) instead of crashing in split(rng, 0).
+        # Placed AFTER the argument checks so N=0 rejects the same
+        # invalid top_k/top_p/max_len calls every nonzero N does.
+        return prompt
     cache = init_cache(model, b, model.max_len)
 
     def one_token(cache, token):

@@ -39,6 +39,28 @@ class _BadRequest(ValueError):
     pass
 
 
+def _as_array(name, value):
+    """Client JSON column -> ndarray; ragged/mistyped rows are a 400.
+
+    np.asarray turns rows of differing lengths into a ValueError (or,
+    worse, a dtype=object array that explodes inside the model apply) —
+    both are the client's malformed request, not a server fault."""
+    try:
+        arr = np.asarray(value)
+    except ValueError as e:
+        raise _BadRequest("input %r is ragged or mistyped: %s" % (name, e))
+    if arr.dtype == object:
+        raise _BadRequest(
+            "input %r rows have inconsistent shapes or types" % name)
+    if arr.dtype.kind in "USV":
+        # mixed numeric/string rows coerce to a numpy str dtype rather
+        # than object; the exported apply_fn is a jnp program with no
+        # string tensors, so any non-numeric dtype is a client fault
+        raise _BadRequest(
+            "input %r is non-numeric (dtype %s)" % (name, arr.dtype))
+    return arr
+
+
 def _to_batch(payload, signature):
     """TF-Serving request JSON -> {name: ndarray} batch dict."""
     if not isinstance(payload, dict):
@@ -63,15 +85,15 @@ def _to_batch(payload, signature):
                 raise _BadRequest(
                     "unnamed instances need a single-input signature")
             cols = {inputs[0]: rows}
-        return {n: np.asarray(v) for n, v in cols.items()}
+        return {n: _as_array(n, v) for n, v in cols.items()}
     if "inputs" in payload:
         cols = payload["inputs"]
         if isinstance(cols, dict):
-            return {n: np.asarray(v) for n, v in cols.items()}
+            return {n: _as_array(n, v) for n, v in cols.items()}
         inputs = signature.get("inputs") or ["x"]
         if len(inputs) != 1:
             raise _BadRequest("unnamed inputs need a single-input signature")
-        return {inputs[0]: np.asarray(cols)}
+        return {inputs[0]: _as_array(inputs[0], cols)}
     raise _BadRequest("request needs 'instances' or 'inputs'")
 
 

@@ -149,12 +149,16 @@ def tfrecord_iterator(path, verify_crc=True):
 
     Fast path: when the native codec builds (``_tfrecord_native``) AND
     the path is a local regular file, the file is mmapped and framing +
-    both CRCs are validated in one C scan before the first yield,
-    producing zero-copy payload views. Note the eagerness tradeoff: the
-    whole file is validated up front, so consuming only the first
-    records of a huge file is cheaper via :func:`first_record` or the
-    python loop below — which remains the canonical fallback and the
-    only remote-stream path (it never buffers the file in RAM)."""
+    both CRCs are validated in one C scan before the first yield. Each
+    record is materialised as ``bytes`` either way, so the yielded type
+    never depends on whether the host could build the codec (zero-copy
+    views stay internal to :func:`read_batch`, where the native dense
+    decode consumes them without the copy). Note the eagerness
+    tradeoff: the whole file is validated up front, so consuming only
+    the first records of a huge file is cheaper via
+    :func:`first_record` or the python loop below — which remains the
+    canonical fallback and the only remote-stream path (it never
+    buffers the file in RAM)."""
     from tensorflowonspark_tpu import fs
     f = fs.open(path, "rb")
     buf = _try_mmap(f) if _native_ok() else None
@@ -162,7 +166,7 @@ def tfrecord_iterator(path, verify_crc=True):
         from tensorflowonspark_tpu import _tfrecord_native
         f.close()
         for view in _tfrecord_native.iter_records(buf, verify_crc):
-            yield view
+            yield bytes(view)
         return
     with f:
         for data in _iter_stream(f, verify_crc):
@@ -372,8 +376,8 @@ def parse_example(data):
             feat = ("empty", [])
             for ef, ew, ev in _iter_fields(entry):
                 if ef == 1:
-                    # bytes() no-ops on bytes; the native iterator hands
-                    # zero-copy memoryviews through here
+                    # bytes() no-ops on bytes records and materialises
+                    # the memoryview slices _iter_fields produces
                     name = bytes(ev).decode("utf-8")
                 elif ef == 2:
                     feat = _decode_feature(ev)

@@ -92,6 +92,17 @@ def test_temperature_sampling_deterministic_per_key(lm):
                             rng=None)
 
 
+def test_zero_new_tokens_returns_prompt(lm):
+    # max_new_tokens=0 used to crash in jax.random.split(rng, 0); the
+    # contract ([B, S + N]) degenerates to the prompt itself
+    _, decode_model, params = lm
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    got = generation.generate(decode_model, params, prompt, 0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(prompt))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generation.generate(decode_model, params, prompt, -1)
+
+
 def test_generate_rejects_overlong(lm):
     _, decode_model, params = lm
     prompt = jnp.ones((1, MAXLEN - 1), jnp.int32)

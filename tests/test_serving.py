@@ -89,6 +89,22 @@ def test_predict_bad_request(server):
     assert err.value.code == 400
 
 
+def test_predict_ragged_rows_are_400(server):
+    # rows of differing lengths are the CLIENT's malformed request —
+    # they must map to 400, not surface as a 500 from np.asarray or the
+    # model apply (advisor r4 finding)
+    for payload in (
+            {"instances": [[1.0, 2.0], [3.0]]},
+            {"instances": [{"x": [1.0, 2.0]}, {"x": [3.0]}]},
+            {"inputs": {"x": [[1.0, 2.0], [3.0]]}},
+            {"instances": [[1.0, "not-a-number-row"], [3.0, 0.0]]}):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(server + "/v1/models/lin:predict", payload)
+        assert err.value.code == 400, payload
+        body = json.loads(err.value.read())
+        assert "error" in body
+
+
 def test_unknown_model_404(server):
     with pytest.raises(urllib.error.HTTPError) as err:
         _get(server + "/v1/models/nope/metadata")

@@ -395,6 +395,15 @@ class Context(object):
                 logger.warning("killing unresponsive executor pid %s", proc.pid)
                 proc.kill()
                 proc.wait(timeout=5)
+        if self._procs:
+            # local executors shared this host: reap any shm feed rings
+            # their processes left behind (SIGKILL skips atexit paths)
+            try:
+                from tensorflowonspark_tpu import shm
+                if shm.available():
+                    shm.sweep_stale()
+            except Exception:  # noqa: BLE001 - cleanup is best effort
+                logger.debug("stale ring sweep failed", exc_info=True)
 
     def __enter__(self):
         return self

@@ -100,6 +100,11 @@ def main(argv=None):
         level=args.log_level,
         format="%(asctime)s %(levelname)s exec[{}] %(name)s: %(message)s".format(
             args.executor_id))
+    # kill -USR1 <executor pid> dumps every thread's stack to the log —
+    # the first tool to reach for when a feed wedges on a remote host
+    import faulthandler
+    import signal as _signal
+    faulthandler.register(_signal.SIGUSR1, file=sys.stderr)
     host, port = args.driver.rsplit(":", 1)
     with open(args.authkey_file, "rb") as f:
         authkey = f.read()

@@ -258,10 +258,8 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         authkey = bytes.fromhex(cluster_meta["authkey"])
         _register_filesystems(cluster_meta)
 
-        # 1. queue broker for this node (the process-boundary bridge).
-        # The extra 'probe' queue exists only for the transport micro-
-        # probe below; it costs one empty Queue object.
-        mgr = manager.start(authkey, list(queues) + ["probe"],
+        # 1. queue broker for this node (the process-boundary bridge)
+        mgr = manager.start(authkey, list(queues),
                             mode=cluster_meta.get("manager_mode", "local"),
                             host=host)
 
@@ -283,8 +281,13 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             from tensorflowonspark_tpu import shm
             probe_rates = None
             if shm.available():
-                ring_name = "/tfos-{}-{}".format(
-                    cluster_meta["id"][-10:], executor_id)
+                # the creator pid in the name is what lets sweep_stale
+                # prove a segment's owner died (SIGKILL leaves no other
+                # cleanup path); the sweep clears THIS slot's leftovers
+                # from any earlier cluster before we allocate
+                shm.sweep_stale(executor_id)
+                ring_name = "/tfos-{}-{}.{}".format(
+                    cluster_meta["id"][-10:], executor_id, os.getpid())
                 shm._load().shmring_unlink(ring_name.encode())  # clear stale
                 try:
                     ring = shm.ShmRing.create(ring_name)
@@ -292,8 +295,7 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                     probe_rates = {"error": "ring create failed: %s" % e}
                     logger.warning("shm ring disabled (%s); using queues", e)
                 if ring is not None and transport == "auto":
-                    choice, probe_rates = _probe_feed_transport(
-                        mgr.address, authkey, ring)
+                    choice, probe_rates = _probe_feed_transport(ring)
                     # the probe moved real bytes through the ring, and a
                     # failed leg may leave a consumer thread behind:
                     # recreate the segment either way so the trainer can
@@ -451,6 +453,53 @@ def _trainer_main(payload):
     _trainer_main_fork(*serializer.loads(payload))
 
 
+def _close_inherited_sockets():
+    """Close every socket fd a forked trainer inherited from the executor.
+
+    Fork duplicates the executor's fds — including its engine-driver
+    connection and the queue broker's *listen* socket — and those
+    duplicates break failure detection from the grave (found by the
+    chaos suite, VERDICT r4 task 7): when the executor is SIGKILLed,
+    (a) the driver never sees EOF on its executor connection because
+    the trainer's copy keeps the TCP stream established, so the engine
+    hangs instead of failing the task; and (b) the trainer's own broker
+    reconnect SUCCEEDS against the inherited listen socket that nothing
+    accepts on, parking the error path in recv() forever. The trainer
+    needs none of these — it builds every connection it uses fresh
+    (broker by address, ring by name) — so owning zero inherited
+    sockets restores the invariant that a process's death closes its
+    endpoints.
+
+    dup2(/dev/null) rather than close(): the forked copies of the
+    executor's python socket objects still reference these fd numbers,
+    and a bare close would free the numbers for reuse — a stale
+    object's destructor could then close an unrelated fd the trainer
+    opened later. dup2 drops the kernel socket reference (what we
+    need) while keeping the slot occupied by /dev/null, which the
+    stale destructors may close harmlessly.
+    """
+    import stat as stat_mod
+    fds = None
+    for fd_dir in ("/proc/self/fd", "/dev/fd"):  # linux, then macOS/BSD
+        try:
+            fds = [int(f) for f in os.listdir(fd_dir)]
+            break
+        except OSError:
+            continue
+    if fds is None:  # no fd listing on this platform: nothing safe to do
+        return
+    devnull = os.open(os.devnull, os.O_RDWR)
+    for fd in fds:
+        if fd < 3 or fd == devnull:
+            continue
+        try:
+            if stat_mod.S_ISSOCK(os.fstat(fd).st_mode):
+                os.dup2(devnull, fd)
+        except OSError:
+            continue
+    os.close(devnull)
+
+
 def _trainer_main_fork(fn, tf_args, executor_id, job_name, task_index,
                        cluster_info, cluster_meta, mgr_addr):
     """Entry of the trainer process — the TPU owner.
@@ -459,6 +508,7 @@ def _trainer_main_fork(fn, tf_args, executor_id, job_name, task_index,
     push the traceback to the 'error' queue so ``shutdown()`` can re-raise
     it on the driver (SURVEY.md §3.5).
     """
+    _close_inherited_sockets()
     logging.basicConfig(
         level=os.environ.get("TFOS_LOG_LEVEL", "INFO"),
         format="%(asctime)s %(levelname)s trainer[{}] %(name)s: %(message)s"
@@ -642,17 +692,17 @@ def _feed_partition(iterator, mgr, qname, feed_timeout, cancel=None):
     return count
 
 
-def _probe_feed_transport(address, authkey, ring, reps=4, records=32):
+def _probe_feed_transport(ring, reps=4, records=32):
     """Measured-at-startup transport pick; returns ('shm'|'queue', rates).
 
     VERDICT r4 weak #1: a static shm-when-local default had the one
     driver-captured smoke showing the ring *losing* to the queue. This
-    pushes the same representative columnar chunk through BOTH
-    transports exactly the way the production plane moves it — the
-    queue leg through fresh TCP manager proxies (what a feeder process
-    pays; the broker's in-process fast path would flatter the queue),
-    the shm leg through write_obj/read_obj on the live ring — and picks
-    the measured winner. Ties break toward shm: equal copy cost still
+    pushes the same representative columnar chunk through both
+    transports' dominant cost paths — the queue leg as pickle + TCP
+    loopback round trips (what the manager-proxy hop pays per chunk;
+    see the in-function note for why not real proxies), the shm leg
+    through write_obj/read_obj on the live ring — and picks the
+    measured winner. Ties break toward shm: equal copy cost still
     leaves the manager socket free for control traffic. Any probe
     failure keeps shm (the pre-probe default) so a broken probe can
     never disable the fast path.
@@ -693,7 +743,7 @@ def _probe_feed_transport(address, authkey, ring, reps=4, records=32):
                 errs[0] if errs else "consumer timeout"))
         return time.monotonic() - t0
 
-    rq = None
+    listener = None
     try:
         def shm_read():
             if ring.read_obj(timeout=10.0) is None:
@@ -701,26 +751,62 @@ def _probe_feed_transport(address, authkey, ring, reps=4, records=32):
 
         t_shm = timed(lambda: ring.write_obj(chunk, timeout=10.0), shm_read)
 
-        # one proxy client per side: proxies are not shared across the
-        # producer/consumer threads, mirroring the two real processes
-        wq = manager.connect(address, authkey).get_queue("probe")
-        rq = manager.connect(address, authkey).get_queue("probe")
+        # Queue leg: a raw TCP Connection pair over loopback — the same
+        # pickle + TCP wire cost the manager-proxy path pays per chunk,
+        # WITHOUT touching the live broker. Deliberately not manager
+        # proxies: a BaseProxy plants an mp Finalize whose _decref does
+        # blocking connect+challenge I/O at GC/exit time against this
+        # process's own single-accepter server — under feed load that
+        # wedged the accepter mid-Thread.start() and starved the
+        # trainer's handshake (found via the deep-partition test).
+        # A fresh authkey keeps the HMAC challenge on the pair (an
+        # unauthenticated listener would unpickle whatever local peer
+        # connected first), and SO_SNDTIMEO bounds the writes so a dead
+        # consumer can't wedge bootstrap in send().
+        import socket as _socket
+        import struct as _struct
+        from multiprocessing.connection import Client as _ConnClient
+        from multiprocessing.connection import Listener as _Listener
+
+        probe_key = os.urandom(16)
+        listener = _Listener(("127.0.0.1", 0), authkey=probe_key)
+        rconn_box = {}
+
+        def _accept():
+            rconn_box["c"] = listener.accept()
+
+        # the authkey handshake is synchronous on BOTH ends, so accept
+        # must already be in flight when Client() connects
+        acceptor = threading.Thread(target=_accept, daemon=True)
+        acceptor.start()
+        wconn = _ConnClient(listener.address, authkey=probe_key)
+        acceptor.join(timeout=10)
+        if "c" not in rconn_box:
+            raise RuntimeError("probe pair handshake timed out")
+        _socket.socket(fileno=os.dup(wconn.fileno())).setsockopt(
+            _socket.SOL_SOCKET, _socket.SO_SNDTIMEO,
+            _struct.pack("ll", 10, 0))
 
         def q_read():
-            rq.get(True, 10.0)
-            rq.task_done()
+            rconn_box["c"].recv()
 
-        t_queue = timed(lambda: wq.put(chunk), q_read)
+        def q_write():
+            wconn.send(chunk)
+
+        try:
+            t_queue = timed(q_write, q_read)
+        finally:
+            wconn.close()
+            if "c" in rconn_box:
+                rconn_box["c"].close()
     except Exception as e:  # noqa: BLE001 - probe is advisory
         logger.warning("transport probe failed (%s); keeping shm", e)
         return "shm", {"error": str(e)}
     finally:
-        if rq is not None:
-            try:  # a failed leg must not park MBs in the broker for life
-                while True:
-                    rq.get(False)
-                    rq.task_done()
-            except Exception:  # noqa: BLE001 - empty or broker gone
+        if listener is not None:
+            try:
+                listener.close()
+            except Exception:  # noqa: BLE001
                 pass
 
     rate = lambda t: round(reps * nbytes / t / 1e6, 1) if t > 0 else float("inf")  # noqa: E731,E501

@@ -97,6 +97,48 @@ def _load():
         return lib
 
 
+def sweep_stale(executor_id=None):
+    """Unlink rings whose creating process is dead; returns names removed.
+
+    SIGKILL is the one exit the atexit/shutdown cleanups cannot cover
+    (VERDICT r4 task 7): a feeder killed -9 leaves its segment behind,
+    and since ring names embed the cluster id, a *new* cluster would
+    never reuse (and thus never clear) the old name. Ring names embed
+    the creator pid (``/tfos-<id>-<eid>.<pid>``, node.py) precisely so
+    this sweep can test liveness: dead pid -> stale segment. Scoped to
+    one executor slot at node bootstrap (never touching a concurrent
+    cluster's live rings, whose pids are alive); unscoped from the
+    engine driver's stop() on hosts it owns. pid-less legacy names are
+    left alone — liveness is unknowable for them.
+    """
+    import glob
+    import re
+
+    pat = ("/dev/shm/tfos-*-{}.*".format(executor_id)
+           if executor_id is not None else "/dev/shm/tfos-*.*")
+    removed = []
+    for path in glob.glob(pat):
+        base = os.path.basename(path)
+        m = re.match(r".+\.(\d+)$", base)
+        if not m:
+            continue
+        pid = int(m.group(1))
+        try:
+            os.kill(pid, 0)
+            continue  # creator alive: the ring is (or may be) live
+        except ProcessLookupError:
+            pass
+        except OSError:
+            continue  # EPERM etc.: can't prove death, leave it
+        try:
+            _load().shmring_unlink(("/" + base).encode())
+            removed.append("/" + base)
+            logger.info("swept stale shm ring %s (dead pid %d)", base, pid)
+        except Exception:  # noqa: BLE001 - best effort
+            pass
+    return removed
+
+
 def available():
     """True if the native ring can be built/loaded on this host."""
     try:

@@ -1,0 +1,96 @@
+"""Minimal in-process fake of the pyspark surface the adapter touches.
+
+The image has no pyspark (SURVEY.md §7.3), so the spark_adapter contract
+is proven against this stand-in: same lazy RDD semantics (transforms
+chain, nothing runs until an action), same method names and shapes as
+``pyspark.SparkContext`` / ``pyspark.RDD``. Tests import it as
+``pyspark`` via a sys.path entry — nothing here ships in the package.
+"""
+
+
+class RDD(object):
+    def __init__(self, sc, partitions, transform=None):
+        self._sc = sc
+        self._partitions = partitions  # list[list]
+        self._transform = transform    # fn(iter) -> iter, or None
+
+    # -- transforms (lazy) ------------------------------------------------
+
+    def mapPartitions(self, f):
+        prev = self._transform
+
+        def chained(it, _prev=prev, _f=f):
+            return _f(_prev(it) if _prev else it)
+
+        return RDD(self._sc, self._partitions, chained)
+
+    def map(self, f):
+        return self.mapPartitions(lambda it: (f(x) for x in it))
+
+    def union(self, other):
+        # materialize both sides' transforms into fresh partitions, like
+        # spark's union of two lineages
+        return RDD(self._sc,
+                   self._compute_partitions() + other._compute_partitions())
+
+    # -- actions ----------------------------------------------------------
+
+    def _compute_partitions(self):
+        if self._transform is None:
+            return [list(p) for p in self._partitions]
+        return [list(self._transform(iter(p))) for p in self._partitions]
+
+    def getNumPartitions(self):
+        return len(self._partitions)
+
+    def collect(self):
+        return [x for p in self._compute_partitions() for x in p]
+
+    def count(self):
+        return len(self.collect())
+
+    def take(self, n):
+        return self.collect()[:n]
+
+    def foreachPartition(self, f):
+        for p in self._partitions:
+            it = iter(p)
+            result = f(self._transform(it) if self._transform else it)
+            if result is not None:  # spark consumes generator results
+                for _ in result:
+                    pass
+
+
+class SparkContext(object):
+    _active = None
+
+    def __init__(self, master="local[2]", appName="fake"):
+        self.master = master
+        self.appName = appName
+        self.defaultParallelism = 2
+        SparkContext._active = self
+
+    @classmethod
+    def getOrCreate(cls):
+        return cls._active or cls()
+
+    def parallelize(self, data, numSlices=None):
+        data = list(data)
+        n = max(1, min(numSlices or self.defaultParallelism,
+                       len(data) or 1))
+        size, extra = divmod(len(data), n)
+        parts, start = [], 0
+        for i in range(n):
+            end = start + size + (1 if i < extra else 0)
+            parts.append(data[start:end])
+            start = end
+        return RDD(self, parts)
+
+    def union(self, rdds):
+        out = rdds[0]
+        for r in rdds[1:]:
+            out = out.union(r)
+        return out
+
+    def stop(self):
+        SparkContext._active = None

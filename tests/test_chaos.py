@@ -1,0 +1,165 @@
+"""Chaos pass on the feed plane (VERDICT r4 task 7).
+
+SIGKILL is the one exit that runs no handlers: no atexit, no except, no
+queue puts. These tests kill real processes at the worst moments —
+trainer mid-shm-write (feeder blocked inside the ring), trainer
+mid-queue-join, the whole feeder/executor process mid-feed — and assert
+the three survival properties the reference's feed plane lacked
+(SURVEY.md §5 failure detection): no wedged feeder, a driver-side error
+that names the death, and no leaked /dev/shm segments afterwards.
+"""
+
+import glob
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import cluster, shm
+from tensorflowonspark_tpu.engine import Context
+from tensorflowonspark_tpu.engine.context import TaskError
+
+pytestmark = pytest.mark.skipif(
+    not shm.available(), reason="native shm ring unavailable")
+
+RING_CAPACITY = 64 * 1024 * 1024  # the MIN_USEFUL_CAPACITY floor
+
+
+def _rings():
+    return glob.glob("/dev/shm/tfos-*")
+
+
+def _sc(tmp_path, transport, n=1):
+    return Context(
+        num_executors=n, work_root=str(tmp_path / "engine"),
+        executor_env={"TFOS_FEED_TRANSPORT": transport,
+                      "TFOS_SHM_CAPACITY": str(RING_CAPACITY)})
+
+
+def test_trainer_sigkill_mid_shm_write(tmp_path):
+    """Feeder blocked INSIDE ring.write when the trainer dies: the bounded
+    write's state check must abort the feed (no wedge), shutdown must
+    surface the kill, and the ring must not leak."""
+    def read_one_then_sigkill(args, ctx):
+        # trainer: prove the feed is live, then die the ugly way
+        feed = ctx.get_data_feed(train_mode=True)
+        feed.next_batch(8)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    sc = _sc(tmp_path, "shm")
+    try:
+        tfc = cluster.run(sc, read_one_then_sigkill, {}, num_executors=1,
+                          input_mode=cluster.InputMode.SPARK)
+        # > capacity + one in-flight chunk, so the feeder is guaranteed
+        # to be blocked in a ring write when the trainer is gone:
+        # 1536 x 64KB float32 rows = 96MB vs a 64MB ring
+        rows = [np.zeros(16384, np.float32) for _ in range(1536)]
+        t0 = time.monotonic()
+        # train-path contract: the feeder ABORTS its blocked write when
+        # the watchdog flips state (no wedge, no 60s timeout burn) and
+        # returns — the real error surfaces at shutdown() below
+        tfc.train(sc.parallelize(rows, 2), feed_timeout=60)
+        assert time.monotonic() - t0 < 45, "feeder wedged past its bounds"
+        with pytest.raises(RuntimeError, match=r"-9|killed"):
+            tfc.shutdown(grace_secs=1)
+    finally:
+        sc.stop()
+    assert not _rings(), _rings()
+
+
+def test_trainer_sigkill_mid_queue_join(tmp_path):
+    """Feeder parked in the queue join when the trainer dies: the chunked
+    join's state check must return (the reference's bare queue.join()
+    hangs here forever), and shutdown must name the exit code."""
+    def read_one_then_sigkill_after(args, ctx):
+        # consume one batch, give the feeder time to finish writing and
+        # enter its join, then die
+        feed = ctx.get_data_feed(train_mode=True)
+        feed.next_batch(8)
+        time.sleep(args["linger_s"])
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    sc = _sc(tmp_path, "queue")
+    try:
+        tfc = cluster.run(sc, read_one_then_sigkill_after,
+                          {"linger_s": 3.0}, num_executors=1,
+                          input_mode=cluster.InputMode.SPARK)
+        # small feed: fully written long before the trainer dies, so the
+        # feeder is inside _join_feed when the kill lands
+        t0 = time.monotonic()
+        tfc.train(sc.parallelize(list(range(200)), 2), feed_timeout=60)
+        assert time.monotonic() - t0 < 75, "join wedged past its bounds"
+        with pytest.raises(RuntimeError, match=r"-9|killed"):
+            tfc.shutdown(grace_secs=1)
+    finally:
+        sc.stop()
+    assert not _rings(), _rings()
+
+
+def test_feeder_executor_sigkill_leaves_no_ring(tmp_path):
+    """SIGKILL the whole executor (feeder + broker + ring owner) mid-feed:
+    the driver must surface the death, the orphaned trainer must abort on
+    its own (dead broker), and engine stop must sweep the leaked ring."""
+    def record_pid_and_crawl(args, ctx):
+        # after the first real batch proves the feed is flowing, publish
+        # our pid (the test's kill signal), then consume slowly so the
+        # feeder stays mid-write when the executor is shot
+        feed = ctx.get_data_feed(train_mode=True)
+        feed.next_batch(1)
+        with open(args["pid_file"], "w") as f:
+            f.write(str(os.getpid()))
+        while not feed.should_stop():
+            feed.next_batch(1)
+            time.sleep(0.05)
+
+    pid_file = str(tmp_path / "trainer.pid")
+    sc = _sc(tmp_path, "shm")
+    try:
+        tfc = cluster.run(sc, record_pid_and_crawl,
+                          {"pid_file": pid_file}, num_executors=1,
+                          input_mode=cluster.InputMode.SPARK)
+        assert _rings(), "ring should exist while the cluster is live"
+        # small enough that the orphan can drain the ring's leftovers
+        # (at its crawl pace) and reach the dead-broker abort within the
+        # deadline; the blocked-mid-write abort is test 1's job
+        rows = [np.zeros(16384, np.float32) for _ in range(256)]
+        executor_pid = sc._procs[0].pid
+
+        import threading
+
+        def assassin():
+            # wait for the trainer to prove the feed is flowing, then
+            # shoot the executor while its feed task is mid-write
+            deadline = time.monotonic() + 30
+            while not os.path.exists(pid_file):
+                if time.monotonic() > deadline:
+                    return
+                time.sleep(0.1)
+            time.sleep(0.5)
+            os.kill(executor_pid, signal.SIGKILL)
+
+        killer = threading.Thread(target=assassin, daemon=True)
+        killer.start()
+        with pytest.raises(TaskError, match="died|connection lost"):
+            tfc.train(sc.parallelize(rows, 2), feed_timeout=60)
+        killer.join(timeout=35)
+        # the kill skipped every cleanup: the segment is leaked right now
+        assert _rings(), "expected the SIGKILLed executor's ring to linger"
+
+        # the orphaned trainer must notice its broker is gone and exit
+        trainer_pid = int(open(pid_file).read())
+        deadline = time.monotonic() + 45
+        while time.monotonic() < deadline:
+            try:
+                os.kill(trainer_pid, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail("orphaned trainer still alive after 45s")
+    finally:
+        sc.stop()
+    # stop() swept the dead executor's ring (pid-liveness check)
+    assert not _rings(), _rings()

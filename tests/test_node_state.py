@@ -105,20 +105,13 @@ def test_shipped_closures_share_live_module_state(node_env):
                     reason="native shm ring unavailable")
 def test_transport_probe_measures_both_legs(tmp_path):
     """The startup micro-probe (VERDICT r4 weak #1) must move real bytes
-    through BOTH transports and return a decision with measured rates."""
-    from tensorflowonspark_tpu import manager
-
-    authkey = os.urandom(20)
-    mgr = manager.start(authkey, ["input", "probe"])
+    through BOTH transport cost paths and return measured rates."""
     ring = shm.ShmRing.create("/tfos-probe-test")
     try:
-        choice, rates = node._probe_feed_transport(
-            mgr.address, authkey, ring)
+        choice, rates = node._probe_feed_transport(ring)
         assert choice in ("shm", "queue")
         assert rates["shm_mb_s"] > 0 and rates["queue_mb_s"] > 0
         assert ring.pending() == 0, "probe must fully drain the ring"
-        assert mgr.get_queue("probe").qsize() == 0, \
-            "probe must fully drain its queue"
     finally:
         ring.close()
         ring.unlink()
@@ -133,8 +126,7 @@ def test_transport_probe_failure_keeps_shm():
         def read_obj(self, timeout=None):
             raise OSError("ring gone")
 
-    choice, rates = node._probe_feed_transport(
-        ("127.0.0.1", 1), b"x", _DeadRing())
+    choice, rates = node._probe_feed_transport(_DeadRing())
     assert choice == "shm"
     assert "error" in rates
 

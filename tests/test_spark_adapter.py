@@ -40,6 +40,14 @@ def test_parallelize_union_mappartitions(fake_sc):
     u = eng.union([rdd, rdd])
     assert u.count() == 20
     assert eng.defaultParallelism == fake_sc.defaultParallelism
+    # real pyspark materializes EMPTY partitions when slices > records;
+    # the contract (and user fns) must tolerate them
+    sparse = eng.parallelize(range(2), 5)
+    assert sparse.getNumPartitions() == 5
+    assert sorted(sparse.collect()) == [0, 1]
+    seen = []
+    sparse.foreachPartition(lambda it: seen.append(len(list(it))))
+    assert sorted(seen) == [0, 0, 0, 1, 1]
 
 
 def test_num_executors_default(fake_sc):

@@ -47,7 +47,7 @@ def synthetic_criteo_lines(n, seed=0):
     return lines
 
 
-def etl(line):
+def etl(line, buckets=BUCKETS):
     """One raw line -> (dense[13] float32, cat[26] int64, label) tuple."""
     from tensorflowonspark_tpu.models.widedeep import hash_categorical
 
@@ -55,11 +55,11 @@ def etl(line):
     label = int(parts[0])
     dense = np.array([np.log1p(float(v)) if v else 0.0
                       for v in parts[1:14]], np.float32)
-    cat = hash_categorical(parts[14:40], BUCKETS)
+    cat = hash_categorical(parts[14:40], buckets)
     return dense, cat, label
 
 
-def save_tfrecords(lines, out_dir, shards=4):
+def save_tfrecords(lines, out_dir, shards=4, buckets=BUCKETS):
     """ETL once, materialize dense tensors as TFRecord shards — the
     reference workflow of persisting the ETL output for repeated
     training runs (dfutil.saveAsTFRecords analog, dense schema)."""
@@ -72,7 +72,7 @@ def save_tfrecords(lines, out_dir, shards=4):
         tfrecord.write_tfrecords(
             os.path.join(out_dir, "part-%05d" % s),
             ({"dense": dense, "cat": cat, "label": [label]}
-             for dense, cat, label in map(etl, rows)))
+             for dense, cat, label in (etl(r, buckets) for r in rows)))
 
 
 def _build_trainer(args, ctx):
@@ -81,13 +81,57 @@ def _build_trainer(args, ctx):
     from tensorflowonspark_tpu import training
     from tensorflowonspark_tpu.models import widedeep
 
-    ctx.initialize_jax()
-    mesh = ctx.mesh()
-    model = widedeep.WideDeep(hash_buckets=BUCKETS, embed_dim=16,
+    devices = ctx.initialize_jax()
+    tp = int(args.get("tp", 1))
+    if tp > 1:
+        # DP x TP mesh: the fused embedding tables (the dominant params
+        # at recommender scale — hash_buckets x 26 rows) row-shard over
+        # the model axis per WIDEDEEP_TP_RULES, so each chip holds
+        # rows/tp and XLA emits the sharded-gather + psum pattern
+        mesh = ctx.mesh({"data": len(devices) // tp, "model": tp})
+    else:
+        mesh = ctx.mesh()
+    model = widedeep.WideDeep(hash_buckets=args.get("hash_buckets", BUCKETS),
+                              embed_dim=args.get("embed_dim", 16),
                               mlp_sizes=(64, 32))
     return mesh, training.Trainer(model, optax.adam(args["lr"]), mesh,
                                   loss_fn=widedeep.ctr_loss,
-                                  input_keys=("dense", "cat"))
+                                  input_keys=("dense", "cat"),
+                                  constrain_state=(tp <= 1))
+
+
+def _shard_params(state, mesh, args):
+    """Row-shard the embedding tables over the model axis (tp > 1).
+
+    The optimizer moments mirror the params tree and dominate memory at
+    recommender scale (adam: 2x the table again), so they re-lay with
+    the SAME rule tree — sharding only params would leave 2/3 of the
+    table bytes replicated and defeat TP's memory point. (init() itself
+    still materializes one replicated copy transiently; a real-chip 10M
+    run at the memory edge should init under jit with these shardings
+    as out_shardings.)"""
+    if int(args.get("tp", 1)) <= 1:
+        return state
+    import jax
+
+    from tensorflowonspark_tpu.parallel.sharding import (
+        WIDEDEEP_TP_RULES, tree_shardings)
+
+    shardings = tree_shardings(state["params"], mesh, WIDEDEEP_TP_RULES)
+    pdef = jax.tree.structure(state["params"])
+
+    def params_like(node):
+        try:
+            return jax.tree.structure(node) == pdef
+        except TypeError:
+            return False
+
+    state["params"] = jax.device_put(state["params"], shardings)
+    state["opt_state"] = jax.tree.map(
+        lambda sub: jax.device_put(sub, shardings)
+        if params_like(sub) else sub,
+        state["opt_state"], is_leaf=params_like)
+    return state
 
 
 def _write_stats(args, ctx, payload):
@@ -154,11 +198,14 @@ def map_fun_tfrecord(args, ctx):
 
     sample = {"dense": np.zeros((8, 13), np.float32),
               "cat": np.zeros((8, 26), np.int64)}
-    state = trainer.init(jax.random.PRNGKey(0), sample)
+    state = _shard_params(trainer.init(jax.random.PRNGKey(0), sample),
+                          mesh, args)
     state, steps, rate = trainer.train_loop(
         state, infeed.sharded_batches(batches(), mesh), log_every=20)
     _write_stats(args, ctx, {"steps": steps, "examples_per_sec": rate,
                              "reader_records_per_sec": read_rate,
+                             "table_rows": 26 * args.get("hash_buckets",
+                                                         BUCKETS),
                              "input": "tfrecord"})
 
 
@@ -183,10 +230,14 @@ def map_fun(args, ctx):
 
     sample = {"dense": np.zeros((8, 13), np.float32),
               "cat": np.zeros((8, 26), np.int64)}
-    state = trainer.init(jax.random.PRNGKey(0), sample)
+    state = _shard_params(trainer.init(jax.random.PRNGKey(0), sample),
+                          mesh, args)
     state, steps, rate = trainer.train_loop(
         state, infeed.sharded_batches(batches(), mesh), log_every=20)
     _write_stats(args, ctx, {"steps": steps, "examples_per_sec": rate,
+                             "feed_stats": feed.stats(),
+                             "table_rows": 26 * args.get("hash_buckets",
+                                                         BUCKETS),
                              "input": "spark-etl"})
 
 
@@ -197,6 +248,14 @@ def main(argv=None):
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--num_examples", type=int, default=2048)
     ap.add_argument("--lr", type=float, default=1e-2)
+    ap.add_argument("--hash_buckets", type=int, default=BUCKETS,
+                    help="buckets per categorical slot; the fused table "
+                         "holds 26x this many rows (385000 ~= a 10M-row "
+                         "table)")
+    ap.add_argument("--embed_dim", type=int, default=16)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="model-axis size; >1 row-shards the embedding "
+                         "tables over the mesh (WIDEDEEP_TP_RULES)")
     ap.add_argument("--data", default=None,
                     help="path to a Criteo-format text file (default: "
                          "synthetic)")
@@ -219,7 +278,8 @@ def main(argv=None):
 
     if args.save_tfrecords:
         save_tfrecords(load_lines(), args.save_tfrecords,
-                       shards=max(4, args.cluster_size))
+                       shards=max(4, args.cluster_size),
+                       buckets=args.hash_buckets)
         print("wrote dense TFRecord shards to", args.save_tfrecords)
         return
 
@@ -237,7 +297,9 @@ def main(argv=None):
                           num_executors=args.cluster_size,
                           input_mode=cluster.InputMode.SPARK)
         # Spark-ETL stage: raw lines -> hashed tensors, on the executors
-        rdd = sc.parallelize(load_lines(), args.cluster_size * 2).map(etl)
+        buckets = args.hash_buckets
+        rdd = sc.parallelize(load_lines(), args.cluster_size * 2).map(
+            lambda line, _b=buckets: etl(line, _b))
         tfc.train(rdd, num_epochs=args.epochs)
         tfc.shutdown()
     finally:

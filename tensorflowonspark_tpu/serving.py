@@ -29,6 +29,7 @@ users' JVM-side HTTP code ports by changing the URL.
 import json
 import logging
 import threading
+import time
 
 import numpy as np
 
@@ -119,10 +120,201 @@ def _to_json(outputs, row_format):
         {name: cols[name][i] for name in names} for i in range(n)]}
 
 
-class ModelServer(object):
-    """HTTP server exposing one exported model, TF-Serving REST shaped."""
+class _Batcher(object):
+    """Cross-request batching window for the accelerator's benefit.
 
-    def __init__(self, model_dir, name="model", host="127.0.0.1", port=8501):
+    Concurrent small requests (the generative path's typical shape: one
+    prompt per HTTP call) serialize through the single-owner lock as N
+    model calls of batch 1 — the worst way to use a TPU. With a window,
+    the first request opens a ~`window_ms` collection period; everything
+    that arrives with the SAME input signature (names, trailing dims,
+    dtypes) is concatenated along axis 0 into ONE apply, and the outputs
+    are split back per request. Requests with a different signature run
+    in their own group — batching never changes results, only the call
+    count.
+    """
+
+    def __init__(self, apply_fn, variables, window_ms, max_batch=64,
+                 submit_timeout=600.0):
+        import queue as _q
+
+        self._apply = apply_fn
+        self._variables = variables
+        self._window_s = window_ms / 1000.0
+        self._max_batch = max_batch
+        self._submit_timeout = submit_timeout
+        self._stopping = False
+        self._q = _q.Queue()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="tfos-serving-batcher")
+        self._thread.start()
+
+    def submit(self, batch):
+        """Blocking: returns this request's slice of the batched outputs.
+
+        Validates the batch SHAPE here, before it can reach the shared
+        batcher thread: an empty dict or a 0-d input would otherwise
+        crash the loop and brick every queued request. The wait is
+        bounded for the same reason — a dead batcher must surface as
+        per-request 500s, never as silently hung clients."""
+        if not batch:
+            raise _BadRequest("empty input batch")
+        lens = set()
+        for k, v in batch.items():
+            if getattr(v, "ndim", 0) < 1:
+                raise _BadRequest(
+                    "input %r is 0-d; batchable inputs need a leading "
+                    "batch axis" % k)
+            lens.add(len(v))
+        if len(lens) != 1:
+            raise _BadRequest(
+                "inputs disagree on batch size: %s" % sorted(lens))
+        if self._stopping:
+            raise RuntimeError("server is stopping")
+        done = threading.Event()
+        item = {"batch": batch, "done": done}
+        self._q.put(item)
+        if not done.wait(self._submit_timeout):
+            raise RuntimeError(
+                "batched predict timed out after {}s".format(
+                    self._submit_timeout))
+        if "error" in item:
+            raise item["error"]
+        return item["out"]
+
+    @staticmethod
+    def _sig(batch):
+        return tuple(sorted((k, v.shape[1:], str(v.dtype))
+                            for k, v in batch.items()))
+
+    @staticmethod
+    def _rows(item):
+        return len(next(iter(item["batch"].values())))
+
+    def _loop(self):
+        import queue as _q
+
+        while True:
+            first = self._q.get()
+            if first is None:
+                return
+            group = [first]
+            try:
+                deadline = time.monotonic() + self._window_s
+                sig = self._sig(first["batch"])
+                group_rows = self._rows(first)
+                passed_on = []
+                while group_rows < self._max_batch:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    try:
+                        nxt = self._q.get(timeout=left)
+                    except _q.Empty:
+                        break
+                    if nxt is None:
+                        passed_on.append(None)
+                        break
+                    # admission is clamped by remaining capacity so the
+                    # padded bucket never exceeds max_batch (the compile-
+                    # cache bound below depends on it)
+                    if (self._sig(nxt["batch"]) == sig and
+                            group_rows + self._rows(nxt) <=
+                            self._max_batch):
+                        group.append(nxt)
+                        group_rows += self._rows(nxt)
+                    else:
+                        passed_on.append(nxt)  # next round
+                for item in passed_on:
+                    self._q.put(item)
+            except Exception as e:  # noqa: BLE001 - never kill the loop
+                for item in group:
+                    item["error"] = e
+                    item["done"].set()
+                continue
+            self._run_group(group)
+
+    def _run_group(self, group):
+        try:
+            rows = [len(next(iter(i["batch"].values()))) for i in group]
+            if len(group) == 1:
+                merged = group[0]["batch"]
+            else:
+                names = group[0]["batch"].keys()
+                merged = {n: np.concatenate([i["batch"][n] for i in group])
+                          for n in names}
+            # pad the merged batch up to a power-of-two bucket (by
+            # repeating the last row; the padding is sliced off below):
+            # a jitted apply compiles per input SHAPE, so free-running
+            # batch sizes would compile once per distinct size — buckets
+            # cap the cache at log2(max_batch) programs for all grouped
+            # traffic. A SINGLE request larger than max_batch runs at
+            # its natural size, exactly as it would without the window.
+            total = sum(rows)
+            bucket = 1
+            while bucket < total:
+                bucket *= 2
+            if total > self._max_batch:
+                bucket = total
+            if bucket > total:
+                merged = {n: np.concatenate(
+                    [v, np.repeat(v[-1:], bucket - total, axis=0)])
+                    for n, v in merged.items()}
+            outputs = self._apply(self._variables, merged)
+            if bucket > total:
+                outputs = _slice_outputs(outputs, 0, total)
+            if len(group) == 1:
+                group[0]["out"] = outputs
+            else:
+                lo = 0
+                for item, n in zip(group, rows):
+                    item["out"] = _slice_outputs(outputs, lo, lo + n)
+                    lo += n
+        except Exception as e:  # noqa: BLE001 - delivered per request
+            for item in group:
+                item["error"] = e
+        finally:
+            for item in group:
+                item["done"].set()
+
+    def stop(self):
+        import queue as _q
+
+        self._stopping = True
+        self._q.put(None)
+        self._thread.join(timeout=10)
+        # a request that raced stop() past the sentinel would wait its
+        # full submit timeout; fail it now instead
+        while True:
+            try:
+                item = self._q.get(False)
+            except _q.Empty:
+                break
+            if item is not None:
+                item["error"] = RuntimeError("server stopped")
+                item["done"].set()
+
+
+def _slice_outputs(outputs, lo, hi):
+    """Row-slice an apply_fn result of any supported shape."""
+    if isinstance(outputs, dict):
+        return {k: v[lo:hi] for k, v in outputs.items()}
+    if isinstance(outputs, (tuple, list)):
+        return type(outputs)(v[lo:hi] for v in outputs)
+    return outputs[lo:hi]
+
+
+class ModelServer(object):
+    """HTTP server exposing one exported model, TF-Serving REST shaped.
+
+    ``batch_window_ms``: 0 (default) serves each request as its own
+    model call behind the single-owner lock; > 0 coalesces concurrent
+    same-signature requests inside the window into one batched call
+    (see :class:`_Batcher`) — the generative path's throughput lever.
+    """
+
+    def __init__(self, model_dir, name="model", host="127.0.0.1", port=8501,
+                 batch_window_ms=0):
         from tensorflowonspark_tpu import export as export_lib
 
         apply_fn, variables, signature = export_lib.load_model(model_dir)
@@ -131,6 +323,8 @@ class ModelServer(object):
         self._apply = apply_fn
         self._variables = variables
         self._lock = threading.Lock()  # one owner: requests serialize
+        self._batcher = (_Batcher(apply_fn, variables, batch_window_ms)
+                         if batch_window_ms else None)
         self._httpd = None
         self._thread = None
         self._host, self._port = host, port
@@ -141,8 +335,11 @@ class ModelServer(object):
         """{'instances'|'inputs': ...} -> TF-Serving response dict."""
         row_format = "instances" in payload
         batch = _to_batch(payload, self.signature)
-        with self._lock:
-            outputs = self._apply(self._variables, batch)
+        if self._batcher is not None:
+            outputs = self._batcher.submit(batch)
+        else:
+            with self._lock:
+                outputs = self._apply(self._variables, batch)
         return _to_json(outputs, row_format)
 
     def metadata(self):
@@ -214,6 +411,9 @@ class ModelServer(object):
             self._httpd.server_close()
             self._thread.join(timeout=10)
             self._httpd = None
+        if self._batcher is not None:
+            self._batcher.stop()
+            self._batcher = None
 
     def __enter__(self):
         self.start()
@@ -232,10 +432,16 @@ def main(argv=None):
     ap.add_argument("--name", default="model")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8501)
+    ap.add_argument("--batch-window-ms", type=float, default=0,
+                    help="coalesce concurrent same-shape requests into "
+                         "one batched model call inside this window "
+                         "(0 = off); the generative path's throughput "
+                         "lever")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     server = ModelServer(args.model_dir, name=args.name,
-                         host=args.host, port=args.port)
+                         host=args.host, port=args.port,
+                         batch_window_ms=args.batch_window_ms)
     host, port = server.start()
     print("serving %s at http://%s:%d/v1/models/%s" % (
         args.model_dir, host, port, args.name))

@@ -24,6 +24,8 @@ class WideDeep(nn.Module):
     embed_dim: int = 32
     mlp_sizes: Sequence[int] = (256, 128, 64)
     dtype: Any = jnp.bfloat16
+    #: inference-path int8 tables (see QuantizedEmbed / quantize_embeddings)
+    quantized: bool = False
 
     @nn.compact
     def __call__(self, dense, cat):
@@ -33,8 +35,11 @@ class WideDeep(nn.Module):
         ids = cat + offsets[None, :]
         table_size = self.hash_buckets * self.num_cat
 
-        # deep: [B, 26, E] -> concat with dense -> MLP
-        deep_emb = nn.Embed(table_size, self.embed_dim, dtype=self.dtype,
+        # deep: [B, 26, E] -> concat with dense -> MLP. Only the DEEP
+        # table quantizes: the wide table's rows are 1 element, where a
+        # per-row f32 scale would make int8 LARGER than f32 (5B vs 4B).
+        deep_cls = QuantizedEmbed if self.quantized else nn.Embed
+        deep_emb = deep_cls(table_size, self.embed_dim, dtype=self.dtype,
                             name="deep_embeddings")(ids)
         deep_in = jnp.concatenate(
             [deep_emb.reshape(deep_emb.shape[0], -1),
@@ -46,6 +51,7 @@ class WideDeep(nn.Module):
         deep_logit = nn.Dense(1, dtype=jnp.float32, name="deep_head")(h)
 
         # wide: linear over the same categorical ids + dense features
+        # (always f32 params — see the quantization note above)
         wide_emb = nn.Embed(table_size, 1, dtype=jnp.float32,
                             name="wide_embeddings")(ids)
         wide_logit = wide_emb.sum(axis=(1, 2), keepdims=False)[:, None]
@@ -53,6 +59,57 @@ class WideDeep(nn.Module):
             1, dtype=jnp.float32, name="wide_dense")(dense)
 
         return (deep_logit + wide_logit).squeeze(-1)  # [B] logits
+
+
+class QuantizedEmbed(nn.Module):
+    """int8 embedding lookup: per-row symmetric scales, dequant-on-gather.
+
+    SURVEY.md §2.2 names "quantized embedding lookups for the Wide&Deep
+    config" as the optional hot path: at recommender scale the fused
+    table IS the model's memory (10M rows x 16 f32 = 640MB before
+    optimizer state), and serving replicas pay it per chip. int8 rows +
+    one f32 scale per row cut table HBM ~4x vs f32 while the gather
+    moves a quarter of the bytes; XLA fuses the dequant multiply into
+    the gather consumer, so no Pallas kernel is needed — the op is a
+    [B, slots, E] gather, trivially fusible, not a reduction.
+
+    Inference-path module: tables live in the ``quant`` collection
+    (produced by :func:`quantize_embeddings` from trained f32 params),
+    deliberately outside ``params`` so no optimizer ever touches int8.
+    """
+
+    num_embeddings: int
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, ids):
+        table = self.variable(
+            "quant", "table",
+            lambda: jnp.zeros((self.num_embeddings, self.features),
+                              jnp.int8))
+        scale = self.variable(
+            "quant", "scale",
+            lambda: jnp.ones((self.num_embeddings, 1), jnp.float32))
+        rows = jnp.take(table.value, ids, axis=0)
+        s = jnp.take(scale.value, ids, axis=0)
+        return rows.astype(self.dtype) * s.astype(self.dtype)
+
+
+def quantize_embeddings(params):
+    """Trained WideDeep ``params`` -> (slim params, ``quant`` collection).
+
+    Per-row symmetric int8: ``scale = max(|row|) / 127``,
+    ``q = round(row / scale)``. Only the deep table moves out of params
+    (the wide table's 1-element rows would GROW under per-row scales —
+    5B vs 4B — so it stays f32); every other parameter is unchanged.
+    """
+    slim = {k: v for k, v in params.items() if k != "deep_embeddings"}
+    w = jnp.asarray(params["deep_embeddings"]["embedding"], jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(w), axis=1, keepdims=True),
+                        1e-12) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return slim, {"deep_embeddings": {"table": q, "scale": scale}}
 
 
 def ctr_loss(logits, batch):

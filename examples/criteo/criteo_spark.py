@@ -75,6 +75,18 @@ def save_tfrecords(lines, out_dir, shards=4, buckets=BUCKETS):
              for dense, cat, label in (etl(r, buckets) for r in rows)))
 
 
+def _make_model(args, quantized=False):
+    """The ONE WideDeep constructor both training and export use — a
+    config drift between them would surface as a flax shape mismatch at
+    serve time, the worst place to find it."""
+    from tensorflowonspark_tpu.models import widedeep
+
+    return widedeep.WideDeep(
+        hash_buckets=args.get("hash_buckets", BUCKETS),
+        embed_dim=args.get("embed_dim", 16),
+        mlp_sizes=(64, 32), quantized=quantized)
+
+
 def _build_trainer(args, ctx):
     import optax
 
@@ -91,10 +103,8 @@ def _build_trainer(args, ctx):
         mesh = ctx.mesh({"data": len(devices) // tp, "model": tp})
     else:
         mesh = ctx.mesh()
-    model = widedeep.WideDeep(hash_buckets=args.get("hash_buckets", BUCKETS),
-                              embed_dim=args.get("embed_dim", 16),
-                              mlp_sizes=(64, 32))
-    return mesh, training.Trainer(model, optax.adam(args["lr"]), mesh,
+    return mesh, training.Trainer(_make_model(args), optax.adam(args["lr"]),
+                                  mesh,
                                   loss_fn=widedeep.ctr_loss,
                                   input_keys=("dense", "cat"),
                                   constrain_state=(tp <= 1))
@@ -132,6 +142,54 @@ def _shard_params(state, mesh, args):
         if params_like(sub) else sub,
         state["opt_state"], is_leaf=params_like)
     return state
+
+
+def _quantize_export(args, ctx, state, mesh):
+    """Chief-only: post-training int8 table quantization + model export.
+
+    The recommender serving journey (SURVEY §2.2 quantized lookups):
+    trained f32 params -> quantize_embeddings -> export; serve with
+    `tfos-serve --model-dir DIR` and the logits track f32 within
+    quantization error (tests/test_serving.py proves the parity).
+    Rerunnable: an existing export dir is replaced, like --model_dir.
+    """
+    out_dir = args.get("quantize_export")
+    if not out_dir or ctx.job_name != "chief":
+        return
+    import shutil
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from tensorflowonspark_tpu import export
+    from tensorflowonspark_tpu.models import widedeep
+
+    # TP-sharded params span processes in a real distributed run; a
+    # bare device_get on non-addressable shards raises. Replicate
+    # through a jitted identity first (XLA emits the all-gather), then
+    # fetch the now-addressable copies.
+    replicated = NamedSharding(mesh, PartitionSpec())
+    params = jax.device_get(jax.jit(
+        lambda p: p, out_shardings=replicated)(state["params"]))
+    slim, quant = widedeep.quantize_embeddings(params)
+    cfg = {k: args.get(k) for k in
+           ("hash_buckets", "embed_dim") if args.get(k) is not None}
+
+    def apply_fn(variables, batch, _cfg=cfg):
+        import numpy as np
+
+        qmodel = _make_model(dict(_cfg), quantized=True)
+        return {"ctr_logit": qmodel.apply(
+            variables, np.asarray(batch["dense"], np.float32),
+            np.asarray(batch["cat"], np.int32))}
+
+    out = ctx.absolute_path(out_dir)
+    if os.path.isdir(out):
+        shutil.rmtree(out)
+    export.save_model(out, apply_fn,
+                      {"params": slim, "quant": quant},
+                      signature={"inputs": ["dense", "cat"],
+                                 "outputs": ["ctr_logit"]})
 
 
 def _write_stats(args, ctx, payload):
@@ -207,6 +265,7 @@ def map_fun_tfrecord(args, ctx):
                              "table_rows": 26 * args.get("hash_buckets",
                                                          BUCKETS),
                              "input": "tfrecord"})
+    _quantize_export(args, ctx, state, mesh)
 
 
 def map_fun(args, ctx):
@@ -239,6 +298,7 @@ def map_fun(args, ctx):
                              "table_rows": 26 * args.get("hash_buckets",
                                                          BUCKETS),
                              "input": "spark-etl"})
+    _quantize_export(args, ctx, state, mesh)
 
 
 def main(argv=None):
@@ -256,6 +316,10 @@ def main(argv=None):
     ap.add_argument("--tp", type=int, default=1,
                     help="model-axis size; >1 row-shards the embedding "
                          "tables over the mesh (WIDEDEEP_TP_RULES)")
+    ap.add_argument("--quantize_export", default=None, metavar="DIR",
+                    help="after training, quantize the deep embedding "
+                         "table to int8 and export a servable model to "
+                         "DIR (chief only; serve with tfos-serve)")
     ap.add_argument("--data", default=None,
                     help="path to a Criteo-format text file (default: "
                          "synthetic)")

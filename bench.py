@@ -282,6 +282,35 @@ def _probe_platform():
     return lines[-1], None
 
 
+def _device_only_subprocess(timeout_s):
+    """Run the device-only stage in a killable child process.
+
+    A PJRT call wedged inside C code (the round-5 tunnel death mode)
+    never returns to the Python eval loop, so SIGALRM-style in-process
+    timeouts cannot fire; killing a child is the only reliable bound.
+    The child is this script with the fed stage disabled, so it reuses
+    the exact measurement path. Returns ``(rate, mfu, error)``.
+    """
+    import subprocess
+    env = dict(os.environ, TFOS_BENCH_FED="0", TFOS_BENCH_NO_FALLBACK="1",
+               TFOS_BENCH_DEVICE_TIMEOUT="0")
+    try:
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             capture_output=True, text=True,
+                             timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired:
+        return None, None, ("device-only stage exceeded {}s "
+                            "(TPU tunnel wedged?)".format(timeout_s))
+    try:
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception:  # noqa: BLE001 - any malformed child output
+        return None, None, "device-only stage rc={}: {}".format(
+            out.returncode, (out.stderr or "")[-300:].strip())
+    if rec.get("error"):
+        return None, None, rec["error"]
+    return rec.get("value"), rec.get("mfu"), None
+
+
 def _cpu_smoke_fallback():
     """Re-run this bench pinned to CPU so an outage round still carries
     fed-plane evidence (VERDICT r3: a dead tunnel must not zero the
@@ -323,13 +352,21 @@ def main():
         batch, image, steps, warmup, fed_steps = 256, 224, 30, 5, 12
     else:  # CPU smoke mode so the bench is runnable anywhere
         batch, image, steps, warmup, fed_steps = 16, 32, 5, 2, 4
-    def _env_int(name, default):
-        try:
-            return int(os.environ.get(name) or 0) or default
-        except ValueError:
-            print("ignoring malformed {}={!r}".format(
-                name, os.environ[name]), file=sys.stderr)
+    def _env_int(name, default, allow_zero=False):
+        """int env knob; unset/malformed -> default. allow_zero keeps an
+        explicit 0 (= disabled) instead of treating it as unset."""
+        raw = os.environ.get(name)
+        if not raw:
             return default
+        try:
+            v = int(raw)
+        except ValueError:
+            print("ignoring malformed {}={!r}".format(name, raw),
+                  file=sys.stderr)
+            return default
+        if allow_zero:
+            return max(0, v)
+        return v or default
 
     batch = _env_int("TFOS_BENCH_BATCH", batch)
     fed_steps = _env_int("TFOS_BENCH_FED_STEPS", fed_steps)
@@ -356,30 +393,64 @@ def main():
         fed_shm = _fed_median("shm")
         fed_queue = _fed_median("queue")
 
-    device_only, mfu = _device_only(on_tpu, batch, image, steps, warmup)
+    # The device-only spin has no engine timeouts around it: a tunnel
+    # that dies mid-run (observed round 5 — it served the fed runs then
+    # wedged on the very next client, inside a C-level PJRT call that no
+    # Python signal can interrupt) would hang the driver's end-of-round
+    # bench forever and zero the artifact. A killable subprocess is the
+    # only reliable bound; on expiry the fed numbers still publish.
+    # TFOS_BENCH_DEVICE_TIMEOUT=0 disables the bound (long profiling
+    # sessions); default 1200s on TPU, unbounded on CPU (the smoke's
+    # outer `timeout` governs there).
+    device_only = mfu = None
+    device_error = None
+    timeout_s = _env_int("TFOS_BENCH_DEVICE_TIMEOUT",
+                         1200 if on_tpu else 0, allow_zero=True)
+    if timeout_s:
+        device_only, mfu, device_error = _device_only_subprocess(timeout_s)
+    else:
+        try:
+            device_only, mfu = _device_only(on_tpu, batch, image, steps,
+                                            warmup)
+        except Exception as e:  # noqa: BLE001 - report, not die
+            device_error = str(e)
+    if device_error:
+        print("device_only failed: {}".format(device_error), file=sys.stderr)
 
+    metric_name = ("resnet50_cluster_fed_images_per_sec_per_chip"
+                   if fed_enabled else
+                   "resnet50_device_only_images_per_sec_per_chip") if on_tpu \
+        else "tiny_resnet_cpu_smoke_images_per_sec"
     best_fed = max((f for f in (fed_shm, fed_queue) if f is not None),
                    default=0.0)
     if fed_enabled and not best_fed:
         # Both transports broken must NOT masquerade as a healthy fed run.
         print(json.dumps({
-            "metric": "resnet50_cluster_fed_images_per_sec_per_chip",
+            "metric": metric_name,
             "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
-            "device_only": round(device_only, 2),
+            "device_only": round(device_only, 2)
+            if device_only is not None else None,
+            "device_error": device_error,
             "error": "both cluster-fed transports failed",
         }))
         return
     value = best_fed if fed_enabled else device_only
+    if value is None:  # device-only mode with a dead device stage
+        print(json.dumps({
+            "metric": metric_name,
+            "value": 0.0, "unit": "images/sec/chip", "vs_baseline": 0.0,
+            "error": device_error or "device-only stage failed",
+        }))
+        return
     vs = (value / BASELINE_IMAGES_PER_SEC) if BASELINE_IMAGES_PER_SEC else 1.0
     print(json.dumps({
-        "metric": ("resnet50_cluster_fed_images_per_sec_per_chip"
-                   if fed_enabled else
-                   "resnet50_device_only_images_per_sec_per_chip") if on_tpu
-                  else "tiny_resnet_cpu_smoke_images_per_sec",
+        "metric": metric_name,
         "value": round(value, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(vs, 3),
-        "device_only": round(device_only, 2),
+        "device_only": round(device_only, 2)
+        if device_only is not None else None,
+        "device_error": device_error,
         "cluster_fed_shm": round(fed_shm, 2) if fed_shm else None,
         "cluster_fed_queue": round(fed_queue, 2) if fed_queue else None,
         "fed_frac_of_device": round(best_fed / device_only, 3)

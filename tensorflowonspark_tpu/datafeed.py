@@ -87,6 +87,19 @@ class DataFeed(object):
         # feed-plane visibility the reference lacked (SURVEY.md §5
         # tracing): how long the consumer sat blocked on the queue.
         self._stats = {"records": 0, "chunks": 0, "wait_s": 0.0}
+        # Progress heartbeat: a throttled batches-served counter in the
+        # broker kv. node.shutdown() re-arms its termination grace while
+        # this advances, so a trainer legitimately stepping through a deep
+        # buffered backlog (slow steps: big models, remote-tunnel dispatch)
+        # is not killed as "unresponsive" mid-progress (found on-chip,
+        # round 5: the 60s hard join cap killed a live trainer whose steps
+        # ran ~4s/batch over the PJRT tunnel). Counting non-empty batches
+        # SERVED — not queue items — matters: chunks are buffered into
+        # _pending as they arrive, so the final batches step with no
+        # queue traffic; and post-end-of-feed empty batches count as no
+        # progress at all.
+        self._hb_at = 0.0
+        self._hb_batches = 0
 
     def next_batch(self, batch_size):
         """Next batch of up to ``batch_size`` records.
@@ -137,7 +150,26 @@ class DataFeed(object):
             self._stats["records"] += _seg_len(seg)
             self._stats["chunks"] += 1
             self._item_done()
+        if count:
+            # Non-empty batches only: an empty batch after end-of-feed is
+            # not progress, and must not re-arm the shutdown grace (a
+            # buggy map_fun spinning on empty next_batch calls would
+            # otherwise hold off termination forever).
+            self._hb_batches += 1
+            self._heartbeat()
         return self._combine(segs)
+
+    def _heartbeat(self):
+        """Publish batches-served progress to the kv, at most every 2s
+        (one small RPC — negligible against a chunk's payload)."""
+        now = time.monotonic()
+        if now - self._hb_at < 2.0:
+            return
+        self._hb_at = now
+        try:
+            self.mgr.set("feed_hb", self._hb_batches)
+        except Exception:  # noqa: BLE001 - kv store may be gone at teardown
+            pass
 
     def _combine(self, segs):
         """Assemble consumed segments into the user-facing batch shape."""

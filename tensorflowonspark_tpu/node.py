@@ -1029,13 +1029,53 @@ def shutdown(cluster_info, cluster_meta, queues=("input",), grace_secs=0):
         proc = st.get("trainer_proc")
         we_terminated = False
         if proc is not None:
-            proc.join(timeout=max(grace_secs, 60))
+            # Progress-aware join: the grace window is a NO-PROGRESS bound,
+            # not a wall-clock cap. While the trainer's DataFeed heartbeat
+            # (kv "feed_hb", a batches-served counter) keeps advancing,
+            # the deadline re-arms — a trainer slowly draining a deep feed
+            # backlog (slow steps: big models, remote-tunnel dispatch) is
+            # alive, not wedged. Found on-chip in round 5: a hard 60s join
+            # killed a live trainer whose steps ran ~4s/batch over the
+            # PJRT tunnel. An explicit grace_secs is authoritative (tests
+            # use small ones); the 60s floor applies only to the default.
+            # Hard floor of 5s regardless: the heartbeat is throttled to
+            # one publish per 2s, so a window at or under the throttle
+            # structurally cannot observe a live trainer's progress.
+            grace = grace_secs if grace_secs and grace_secs > 0 else 60
+            grace = max(grace, 5)
+            def _hb():
+                try:
+                    return mgr.get("feed_hb")
+                except Exception:  # noqa: BLE001 - broker may be gone
+                    return None
+            last_hb = _hb()
+            deadline = time.monotonic() + grace
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                proc.join(timeout=min(2.0, remaining))
+                if not proc.is_alive():
+                    break
+                hb = _hb()
+                if hb is not None and hb != last_hb:
+                    last_hb = hb
+                    deadline = time.monotonic() + grace
             if proc.is_alive():
-                logger.warning("trainer pid %d unresponsive; terminating",
-                               proc.pid)
+                logger.warning("trainer pid %d unresponsive (no feed "
+                               "progress for %.0fs); terminating",
+                               proc.pid, grace)
                 we_terminated = True
                 proc.terminate()
                 proc.join(timeout=10)
+                if proc.is_alive():
+                    # SIGTERM can't be delivered to a process wedged in a
+                    # C-level call (the very mode that gets here); leaking
+                    # it would hold the chip and the shm ring open.
+                    logger.warning("trainer pid %d survived SIGTERM; "
+                                   "killing", proc.pid)
+                    proc.kill()
+                    proc.join(timeout=5)
         tb_pid = st.get("tb_pid")
         if tb_pid:
             try:

@@ -200,3 +200,65 @@ def test_ps_and_evaluator_roles(tmp_path):
     # every node agrees: only the chief joins the device collective
     for parts in roles.values():
         assert parts == ["chief"], roles
+
+
+def test_shutdown_grace_rearms_on_feed_progress(tmp_path):
+    """A trainer slowly stepping through its buffered backlog outlives a
+    grace window shorter than the drain, because the DataFeed heartbeat
+    re-arms the no-progress deadline (round-5 on-chip find: the old hard
+    join cap killed a live trainer whose steps ran ~4s over the tunnel).
+    Chunks land in DataFeed._pending long before the last batch is
+    served, so this exercises the no-queue-traffic drain phase."""
+    out = str(tmp_path / "done.json")
+
+    def map_fun(args, ctx):
+        import time as _t
+        feed = ctx.get_data_feed(train_mode=True)
+        total = 0
+        while not feed.should_stop():
+            batch = feed.next_batch(4)
+            total += sum(batch)
+            _t.sleep(0.8)  # slow "step": full drain ~8s >> 4s grace
+        # the file is the proof the trainer was NOT killed mid-drain
+        with open(args["out"], "w") as f:
+            json.dump({"total": total}, f)
+
+    sc = Context(num_executors=1, work_root=str(tmp_path / "engine"))
+    try:
+        tfc = cluster.run(sc, map_fun, {"out": out}, num_executors=1,
+                          input_mode=cluster.InputMode.SPARK)
+        tfc.train(sc.parallelize(range(40), 1))
+        tfc.shutdown(grace_secs=4)
+    finally:
+        sc.stop()
+    assert json.load(open(out))["total"] == sum(range(40))
+
+
+def test_shutdown_still_kills_wedged_trainer(tmp_path):
+    """The progress-aware grace is still a liveness bound: a trainer that
+    stops serving batches (wedged in user code) is terminated once the
+    heartbeat goes stale, and shutdown returns promptly."""
+    import time as _time
+    out = str(tmp_path / "never.json")
+
+    def map_fun(args, ctx):
+        import time as _t
+        feed = ctx.get_data_feed(train_mode=True)
+        while not feed.should_stop():
+            feed.next_batch(4)  # prompt consumption: the feed join returns
+        _t.sleep(120)  # wedge AFTER the feed: heartbeat goes stale
+        with open(args["out"], "w") as f:
+            f.write("{}")
+
+    sc = Context(num_executors=1, work_root=str(tmp_path / "engine"))
+    try:
+        tfc = cluster.run(sc, map_fun, {"out": out}, num_executors=1,
+                          input_mode=cluster.InputMode.SPARK)
+        tfc.train(sc.parallelize(range(40), 1))
+        t0 = _time.monotonic()
+        tfc.shutdown(grace_secs=3)
+        elapsed = _time.monotonic() - t0
+    finally:
+        sc.stop()
+    assert elapsed < 30, "wedged trainer not reaped within grace bounds"
+    assert not os.path.exists(out)

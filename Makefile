@@ -41,25 +41,36 @@ smoke:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PYTHON) bench.py
 
-# Everything that needs the real chip, in priority order (VERDICT r3):
-# fed bench -> device sweep -> flash kernels on Mosaic -> step analysis.
-# Run the moment the tunnel serves compute; each stage appends to
-# .onchip/ so a mid-run outage keeps earlier results. '-' prefixes keep
-# later stages running past an earlier failure; pipefail keeps each
-# stage's failure VISIBLE instead of laundered through tee.
+# Everything that needs the real chip, in priority order:
+# transfer roofline (cheapest, names the link ceiling) -> fed bench ->
+# device sweep -> flash kernels on Mosaic -> step analysis -> offline
+# fed-vs-wire merge. Run the moment the tunnel serves compute; each
+# stage appends to .onchip/ so a mid-run outage keeps earlier results.
+# '-' prefixes keep later stages running past an earlier failure;
+# pipefail keeps each stage's failure VISIBLE instead of laundered
+# through tee. Every device-touching stage is timeout-bounded: the
+# round-5 window died mid-run with a client wedged in a C-level PJRT
+# call, and an unbounded stage would have hung the target forever.
 onchip:
 	mkdir -p .onchip && rm -f .onchip/*.rc
-	-set -o pipefail; TFOS_BENCH_VERBOSE=1 $(PYTHON) bench.py \
+	-set -o pipefail; timeout 900 $(PYTHON) scripts/transfer_roofline.py \
+	  2>.onchip/roofline.stderr | tee .onchip/roofline.json \
+	  || echo $$? > .onchip/roofline.rc
+	-set -o pipefail; TFOS_BENCH_VERBOSE=1 timeout 3600 $(PYTHON) bench.py \
 	  2>.onchip/bench.stderr | tee .onchip/bench.json \
 	  || echo $$? > .onchip/bench.rc
 	-set -o pipefail; bash scripts/perf_sweep.sh 2>&1 \
 	  | tee .onchip/sweep.txt || echo $$? > .onchip/sweep.rc
-	-set -o pipefail; $(PYTHON) scripts/flash_on_chip.py \
+	-set -o pipefail; timeout 1800 $(PYTHON) scripts/flash_on_chip.py \
 	  2>.onchip/flash.stderr | tee .onchip/flash.json \
 	  || echo $$? > .onchip/flash.rc
-	-set -o pipefail; $(PYTHON) scripts/perf_analysis.py --batch 256 \
-	  --trace .onchip/trace 2>.onchip/perf_analysis.stderr \
+	-set -o pipefail; timeout 1800 $(PYTHON) scripts/perf_analysis.py \
+	  --batch 256 --trace .onchip/trace 2>.onchip/perf_analysis.stderr \
 	  | tee .onchip/perf_analysis.json || echo $$? > .onchip/perf.rc
+	-set -o pipefail; timeout 60 $(PYTHON) scripts/transfer_roofline.py \
+	  --from .onchip/roofline.json --fed-json .onchip/bench.json \
+	  2>>.onchip/roofline.stderr | tee .onchip/fed_vs_wire.json \
+	  || echo $$? > .onchip/merge.rc
 	@if ls .onchip/*.rc >/dev/null 2>&1; then \
 	  echo "onchip stages FAILED:" .onchip/*.rc; exit 1; fi
 

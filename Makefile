@@ -53,21 +53,21 @@ smoke:
 # call, and an unbounded stage would have hung the target forever.
 onchip:
 	mkdir -p .onchip && rm -f .onchip/*.rc
-	-set -o pipefail; timeout 900 $(PYTHON) scripts/transfer_roofline.py \
+	-set -o pipefail; timeout -k 30 900 $(PYTHON) scripts/transfer_roofline.py \
 	  2>.onchip/roofline.stderr | tee .onchip/roofline.json \
 	  || echo $$? > .onchip/roofline.rc
-	-set -o pipefail; TFOS_BENCH_VERBOSE=1 timeout 3600 $(PYTHON) bench.py \
+	-set -o pipefail; TFOS_BENCH_VERBOSE=1 timeout -k 30 3600 $(PYTHON) bench.py \
 	  2>.onchip/bench.stderr | tee .onchip/bench.json \
 	  || echo $$? > .onchip/bench.rc
 	-set -o pipefail; bash scripts/perf_sweep.sh 2>&1 \
 	  | tee .onchip/sweep.txt || echo $$? > .onchip/sweep.rc
-	-set -o pipefail; timeout 1800 $(PYTHON) scripts/flash_on_chip.py \
+	-set -o pipefail; timeout -k 30 1800 $(PYTHON) scripts/flash_on_chip.py \
 	  2>.onchip/flash.stderr | tee .onchip/flash.json \
 	  || echo $$? > .onchip/flash.rc
-	-set -o pipefail; timeout 1800 $(PYTHON) scripts/perf_analysis.py \
+	-set -o pipefail; timeout -k 30 1800 $(PYTHON) scripts/perf_analysis.py \
 	  --batch 256 --trace .onchip/trace 2>.onchip/perf_analysis.stderr \
 	  | tee .onchip/perf_analysis.json || echo $$? > .onchip/perf.rc
-	-set -o pipefail; timeout 60 $(PYTHON) scripts/transfer_roofline.py \
+	-set -o pipefail; timeout -k 30 60 $(PYTHON) scripts/transfer_roofline.py \
 	  --from .onchip/roofline.json --fed-json .onchip/bench.json \
 	  2>>.onchip/roofline.stderr | tee .onchip/fed_vs_wire.json \
 	  || echo $$? > .onchip/merge.rc

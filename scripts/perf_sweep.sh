@@ -3,18 +3,29 @@
 # Each config runs in a fresh process (TPU single-owner discipline); the
 # fed plane is off here — this sweeps the device-step ceiling. Takes the
 # best cell to BASELINE.md "Measured results".
+#
+# Order is most-promising-first (bn bf16 at large batch — the r2
+# analysis's predicted MFU lever), so a short hardware window (the
+# round-5 window lasted ~45 min and died mid-stage) banks the configs
+# that matter before the baselines; the fp32 cells exist to isolate the
+# bn-dtype delta, the remat cells to open HBM headroom past batch 1024.
 set -u
 cd "$(dirname "$0")/.."
-for batch in 256 512 1024; do
-  for bn in float32 bfloat16; do
-    echo "=== batch=$batch bn_dtype=$bn ==="
-    TFOS_BENCH_FED=0 TFOS_BENCH_BATCH=$batch TFOS_BENCH_BN_DTYPE=$bn \
-      timeout 900 python bench.py 2>/dev/null | tail -1
-  done
-done
-# remat opens headroom past the HBM ceiling at the largest batches
-for batch in 1024 2048; do
-  echo "=== batch=$batch bn_dtype=bfloat16 remat=1 ==="
-  TFOS_BENCH_FED=0 TFOS_BENCH_BATCH=$batch TFOS_BENCH_BN_DTYPE=bfloat16 \
-    TFOS_BENCH_REMAT=1 timeout 900 python bench.py 2>/dev/null | tail -1
-done
+run_cfg() {
+  echo "=== batch=$1 bn_dtype=$2 remat=${3:-0} ==="
+  # DEVICE_TIMEOUT=0: the outer timeout is the bound here — the inner
+  # subprocess guard would only add a redundant process per cell. -k:
+  # escalate to SIGKILL for processes wedged in C with a TERM handler
+  # installed (the handler can never run in a stuck eval loop)
+  TFOS_BENCH_FED=0 TFOS_BENCH_DEVICE_TIMEOUT=0 TFOS_BENCH_BATCH=$1 \
+    TFOS_BENCH_BN_DTYPE=$2 TFOS_BENCH_REMAT=${3:-0} \
+    timeout -k 30 900 python bench.py 2>/dev/null | tail -1
+}
+run_cfg 512 bfloat16
+run_cfg 1024 bfloat16
+run_cfg 256 bfloat16
+run_cfg 1024 bfloat16 1
+run_cfg 2048 bfloat16 1
+run_cfg 512 float32
+run_cfg 256 float32
+run_cfg 1024 float32

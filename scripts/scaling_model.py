@@ -102,12 +102,19 @@ def _sweep(ns):
                            (out.stderr or "")[-400:].strip()})
             continue
         # the per-n report is pretty-printed JSON: parse from the first
-        # brace (any stray stdout noise precedes it)
-        rec = json.loads(out.stdout[out.stdout.index("{"):])
-        points.append({k: rec[k] for k in
-                       ("mesh_devices", "hlo_allreduce_bytes",
-                        "hlo_allreduce_ops", "allreduce_vs_params",
-                        "step_executed")})
+        # brace (any stray stdout noise precedes it); a child whose
+        # stdout is unparseable records an error point like the other
+        # failure branches instead of killing the whole sweep
+        try:
+            rec = json.loads(out.stdout[out.stdout.index("{"):])
+            points.append({k: rec[k] for k in
+                           ("mesh_devices", "hlo_allreduce_bytes",
+                            "hlo_allreduce_ops", "allreduce_vs_params",
+                            "step_executed")})
+        except (ValueError, KeyError) as e:
+            points.append({"mesh_devices": n, "error":
+                           "unparseable report: {}: {!r}".format(
+                               e, out.stdout[-200:])})
     ratios = [p["allreduce_vs_params"] for p in points if "error" not in p]
     all_ok = all("error" not in p and p["step_executed"] for p in points)
     report = {

@@ -119,10 +119,14 @@ class TFCluster(object):
             feed_queues = tuple(q for q in self.queues
                                 if q not in ("output", "error")) or ("input",)
             try:
+                # fail_fast=False: EndFeed must reach EVERY executor even
+                # if one node's shutdown task raises — aborting siblings
+                # would strand their trainers on a queue that never ends.
                 workers.foreachPartitionAsync(
                     node.shutdown(self.cluster_info, self.cluster_meta,
                                   queues=feed_queues, grace_secs=grace_secs),
-                    one_task_per_executor=True).get(timeout=timeout)
+                    one_task_per_executor=True,
+                    fail_fast=False).get(timeout=timeout)
             except Exception as e:  # noqa: BLE001 - re-raised after cleanup
                 shutdown_error = e
 
@@ -143,7 +147,8 @@ class TFCluster(object):
                 workers.foreachPartitionAsync(
                     node.shutdown(self.cluster_info, self.cluster_meta,
                                   queues=(), grace_secs=grace_secs),
-                    one_task_per_executor=True).get(timeout=timeout)
+                    one_task_per_executor=True,
+                    fail_fast=False).get(timeout=timeout)
             except Exception as e:  # noqa: BLE001
                 if bootstrap_error is None:
                     shutdown_error = e

@@ -37,12 +37,25 @@ class TaskError(RuntimeError):
 class AsyncResult(object):
     """Handle to a running job (analog of Spark's ASyncRDDActions result)."""
 
-    def __init__(self, num_tasks):
+    def __init__(self, num_tasks, fail_fast=True):
         self._results = [None] * num_tasks
         self._pending = num_tasks
         self._errors = []
         self._lock = threading.Lock()
         self._done = threading.Event()
+        self._fail_fast = fail_fast
+        # ``fail_fast=False`` keeps run-every-task semantics for jobs whose
+        # siblings matter even after one fails — cleanup/shutdown jobs
+        # (EndFeed to executor k must still be delivered when executor j's
+        # shutdown task raised).
+        # Set on the FIRST failure, while other tasks may still be running:
+        # the job is already lost (failed-task-fails-the-job), so waiters
+        # must not keep blocking on tasks whose only remaining purpose is
+        # to time out. Observed on-chip (round 5, window 2): a trainer
+        # wedged in a C-level PJRT compile made every later feed task burn
+        # its full 600s feed_timeout before the driver heard about the
+        # task-1 failure it had been holding for half an hour.
+        self._failed = threading.Event()
 
     def _complete(self, task_id, value):
         with self._lock:
@@ -57,6 +70,8 @@ class AsyncResult(object):
             self._pending -= 1
             if self._pending == 0:
                 self._done.set()
+        if self._fail_fast:
+            self._failed.set()
 
     def done(self):
         return self._done.is_set()
@@ -71,9 +86,22 @@ class AsyncResult(object):
             return self._errors[0] if self._errors else None
 
     def get(self, timeout=None):
-        """Block for completion; re-raise the first task error if any."""
-        if not self._done.wait(timeout):
-            raise TimeoutError("job did not complete within {}s".format(timeout))
+        """Block until the job completes OR its first task fails.
+
+        Fail-fast is the Spark-parity contract: one failed task aborts the
+        job, so the driver re-raises the moment the first error arrives
+        rather than waiting out tasks that are already doomed (undispatched
+        tasks of a failed job are skipped by the dispatch loop). Tasks
+        still running when this raises are bounded by ``Context.stop``'s
+        terminate-with-escalation."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._done.is_set() and not self._failed.is_set():
+            left = 1.0 if deadline is None \
+                else min(1.0, deadline - time.monotonic())
+            if left <= 0:
+                raise TimeoutError(
+                    "job did not complete within {}s".format(timeout))
+            self._done.wait(left)
         if self._errors:
             task_id, error = self._errors[0]
             raise TaskError("task {} failed: {}".format(task_id, error))
@@ -116,6 +144,15 @@ class _ExecutorHandle(object):
                 task = self._next_task()
                 if task is _STOP:
                     break
+                if task["result"]._failed.is_set():
+                    # Job already lost: don't ship a task whose only
+                    # possible outcome is burning its own timeout (e.g. a
+                    # feed task pushing 600s into a ring nobody drains).
+                    task["result"]._fail(
+                        task["task_id"],
+                        "job aborted: an earlier task already failed")
+                    task = None
+                    continue
                 self.conn.send({"type": "task", "job_id": task["job_id"],
                                 "task_id": task["task_id"], "func": task["func"],
                                 "payload": task["payload"]})
@@ -328,10 +365,15 @@ class Context(object):
             out = out.union(r)
         return out
 
-    def run_job(self, rdd, func, one_task_per_executor=False):
-        """Ship ``func`` over every partition; returns :class:`AsyncResult`."""
+    def run_job(self, rdd, func, one_task_per_executor=False,
+                fail_fast=True):
+        """Ship ``func`` over every partition; returns :class:`AsyncResult`.
+
+        ``fail_fast=False`` opts a job out of abort-on-first-failure:
+        every task still runs and ``get()`` waits for all of them
+        (cleanup/shutdown jobs)."""
         partitions = rdd._partitions
-        result = AsyncResult(len(partitions))
+        result = AsyncResult(len(partitions), fail_fast=fail_fast)
         with self._lock:
             self._job_counter += 1
             job_id = self._job_counter

@@ -128,21 +128,25 @@ class RDD(object):
         """Run f over every partition; blocks; re-raises executor errors."""
         self.foreachPartitionAsync(f).get()
 
-    def foreachPartitionAsync(self, f, one_task_per_executor=False):
+    def foreachPartitionAsync(self, f, one_task_per_executor=False,
+                              fail_fast=True):
         """Async partition job -> :class:`AsyncResult` (reference:
         ``nodeRDD.foreachPartitionAsync(TFSparkNode.run(...))``).
 
         ``one_task_per_executor`` pins task i to executor i — the cluster
         bootstrap job needs exactly one node task resident per executor
         (SURVEY.md §3.1), a placement Spark gets from its scheduler and we
-        make explicit.
+        make explicit. ``fail_fast=False`` opts out of
+        abort-on-first-failure (cleanup jobs that must reach every
+        executor).
         """
         def run_and_discard(it, _f=f):
             _f(it)
             return None
 
         return self.ctx.run_job(self, run_and_discard,
-                                one_task_per_executor=one_task_per_executor)
+                                one_task_per_executor=one_task_per_executor,
+                                fail_fast=fail_fast)
 
     def saveAsTextFile(self, path):
         """Write one ``part-NNNNN`` file per partition under ``path``."""

@@ -120,10 +120,12 @@ class SparkRDDAdapter(object):
     def foreachPartition(self, f):
         self.foreachPartitionAsync(f).get()
 
-    def foreachPartitionAsync(self, f, one_task_per_executor=False):
+    def foreachPartitionAsync(self, f, one_task_per_executor=False,
+                              fail_fast=True):
         """Async partition job; see module docstring for the placement
         contract behind ``one_task_per_executor``."""
         del one_task_per_executor  # honored by partition count + spark conf
+        del fail_fast  # Spark's own scheduler governs job abort semantics
 
         def run_and_discard(it, _f=f):
             _f(it)

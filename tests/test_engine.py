@@ -202,3 +202,93 @@ def test_standalone_mode_with_external_executors(tmp_path):
                 p.kill()
         for log in logs:
             log.close()
+
+
+def test_failed_job_raises_fast_without_waiting_on_slow_tasks(tmp_path):
+    """Fail-fast get(): the first task failure re-raises on the driver
+    immediately, not after every sibling task finishes or times out.
+
+    Round-5 on-chip capture: a trainer wedged in a C-level PJRT compile
+    made each queued feed task burn its full 600s feed_timeout; the
+    driver sat on a failure it had known about for half an hour."""
+    import time
+
+    ctx = Context(num_executors=2, work_root=str(tmp_path / "failfast"))
+    try:
+        def work(it):
+            items = list(it)
+            if items and items[0] == 0:
+                raise ValueError("doomed partition")
+            time.sleep(8)
+
+        start = time.monotonic()
+        with pytest.raises(TaskError) as ei:
+            ctx.parallelize([0, 1], 2).foreachPartition(work)
+        elapsed = time.monotonic() - start
+        assert "doomed partition" in str(ei.value)
+        assert elapsed < 5, (
+            "driver waited {:.1f}s for a job it knew had failed".format(
+                elapsed))
+    finally:
+        ctx.stop()
+
+
+def test_job_abort_skips_undispatched_tasks(tmp_path):
+    """After the first failure the dispatch loop must not ship the job's
+    remaining tasks: each would only burn its own timeout (a feed task
+    pushing into a ring nobody drains). They resolve as aborted instead."""
+    import time
+
+    marker_dir = tmp_path / "markers"
+    marker_dir.mkdir()
+    ctx = Context(num_executors=1, work_root=str(tmp_path / "abort"))
+    try:
+        def work(it, _dir=str(marker_dir)):
+            items = list(it)
+            open(os.path.join(_dir, "ran-%d" % items[0]), "w").close()
+            if items[0] == 0:
+                raise ValueError("first task fails")
+
+        res = ctx.parallelize([0, 1, 2], 3).foreachPartitionAsync(work)
+        with pytest.raises(TaskError) as ei:
+            res.get(timeout=30)
+        assert "first task fails" in str(ei.value)
+        # The one executor runs tasks in order: task 0 failed, so 1 and 2
+        # must be aborted at dispatch, never executed.
+        deadline = time.monotonic() + 10
+        while not res.done() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert res.done(), "aborted tasks never resolved"
+        assert sorted(os.listdir(str(marker_dir))) == ["ran-0"]
+        errors = [res.first_error()]
+        assert errors[0][0] == 0  # the real failure stays first
+    finally:
+        ctx.stop()
+
+
+def test_fail_fast_false_runs_every_task(tmp_path):
+    """Cleanup jobs opt out of abort-on-first-failure: EndFeed must reach
+    executor k even when executor j's shutdown task raised."""
+    import time
+
+    marker_dir = tmp_path / "markers"
+    marker_dir.mkdir()
+    ctx = Context(num_executors=1, work_root=str(tmp_path / "noff"))
+    try:
+        def work(it, _dir=str(marker_dir)):
+            items = list(it)
+            if items[0] == 0:
+                raise ValueError("first task fails")
+            time.sleep(0.5)
+            open(os.path.join(_dir, "ran-%d" % items[0]), "w").close()
+
+        res = ctx.parallelize([0, 1, 2], 3).foreachPartitionAsync(
+            work, fail_fast=False)
+        with pytest.raises(TaskError) as ei:
+            res.get(timeout=30)
+        # get() waited for ALL tasks: the later ones really ran.
+        assert res.done()
+        assert "first task fails" in str(ei.value)
+        assert sorted(os.listdir(str(marker_dir))) == ["ran-1", "ran-2"]
+    finally:
+        ctx.stop()

@@ -9,23 +9,47 @@
 # round-5 window lasted ~45 min and died mid-stage) banks the configs
 # that matter before the baselines; the fp32 cells exist to isolate the
 # bn-dtype delta, the remat cells to open HBM headroom past batch 1024.
+# Mode (arg 1): "first" runs only the single most-promising cell —
+# make onchip places it right after the roofline so a minutes-long
+# tunnel window still banks an MFU number; "rest" runs the remaining
+# cells; "all" (default) runs everything.
 set -u
+set -o pipefail
+MODE="${1:-all}"
+FAILED=0
 cd "$(dirname "$0")/.."
 run_cfg() {
   echo "=== batch=$1 bn_dtype=$2 remat=${3:-0} ==="
   # DEVICE_TIMEOUT=0: the outer timeout is the bound here — the inner
   # subprocess guard would only add a redundant process per cell. -k:
   # escalate to SIGKILL for processes wedged in C with a TERM handler
-  # installed (the handler can never run in a stuck eval loop)
-  TFOS_BENCH_FED=0 TFOS_BENCH_DEVICE_TIMEOUT=0 TFOS_BENCH_BATCH=$1 \
+  # installed (the handler can never run in a stuck eval loop).
+  # A dead cell must FAIL the script (pipefail keeps the bench's exit
+  # code through `tail`), not be laundered into a silent empty line —
+  # the onchip target's all-stages-passed gate relies on it. Later
+  # cells still run; the script's exit reports the sweep as a whole.
+  local line
+  line=$(TFOS_BENCH_FED=0 TFOS_BENCH_DEVICE_TIMEOUT=0 TFOS_BENCH_BATCH=$1 \
     TFOS_BENCH_BN_DTYPE=$2 TFOS_BENCH_REMAT=${3:-0} \
-    timeout -k 30 900 python bench.py 2>/dev/null | tail -1
+    timeout -k 30 900 python bench.py 2>/dev/null | tail -1) \
+    || { echo "CELL FAILED (exit $?)"; FAILED=1; return; }
+  echo "$line"
+  # bench exits 0 even for its structured outage report — a cell only
+  # counts when it carries a real rate, not {"value": 0.0, "error": ...}
+  case "$line" in
+    ''|*'"value": 0.0'*) echo "CELL FAILED (no usable number)"; FAILED=1;;
+  esac
 }
-run_cfg 512 bfloat16
-run_cfg 1024 bfloat16
-run_cfg 256 bfloat16
-run_cfg 1024 bfloat16 1
-run_cfg 2048 bfloat16 1
-run_cfg 512 float32
-run_cfg 256 float32
-run_cfg 1024 float32
+if [ "$MODE" != "rest" ]; then
+  run_cfg 512 bfloat16
+fi
+if [ "$MODE" != "first" ]; then
+  run_cfg 1024 bfloat16
+  run_cfg 256 bfloat16
+  run_cfg 1024 bfloat16 1
+  run_cfg 2048 bfloat16 1
+  run_cfg 512 float32
+  run_cfg 256 float32
+  run_cfg 1024 float32
+fi
+exit $FAILED

@@ -56,6 +56,10 @@ class AsyncResult(object):
         # its full 600s feed_timeout before the driver heard about the
         # task-1 failure it had been holding for half an hour.
         self._failed = threading.Event()
+        # _wake fires at either terminal event (all tasks resolved, or
+        # first failure of a fail-fast job) so get() is one blocking wait,
+        # not a poll — the bootstrap job is awaited for days at a time.
+        self._wake = threading.Event()
 
     def _complete(self, task_id, value):
         with self._lock:
@@ -63,6 +67,7 @@ class AsyncResult(object):
             self._pending -= 1
             if self._pending == 0:
                 self._done.set()
+                self._wake.set()
 
     def _fail(self, task_id, error):
         with self._lock:
@@ -72,6 +77,8 @@ class AsyncResult(object):
                 self._done.set()
         if self._fail_fast:
             self._failed.set()
+        if self._fail_fast or self._done.is_set():
+            self._wake.set()
 
     def done(self):
         return self._done.is_set()
@@ -94,14 +101,9 @@ class AsyncResult(object):
         tasks of a failed job are skipped by the dispatch loop). Tasks
         still running when this raises are bounded by ``Context.stop``'s
         terminate-with-escalation."""
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while not self._done.is_set() and not self._failed.is_set():
-            left = 1.0 if deadline is None \
-                else min(1.0, deadline - time.monotonic())
-            if left <= 0:
-                raise TimeoutError(
-                    "job did not complete within {}s".format(timeout))
-            self._done.wait(left)
+        if not self._wake.wait(timeout):
+            raise TimeoutError(
+                "job did not complete within {}s".format(timeout))
         if self._errors:
             task_id, error = self._errors[0]
             raise TaskError("task {} failed: {}".format(task_id, error))

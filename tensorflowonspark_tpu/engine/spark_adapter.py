@@ -125,16 +125,42 @@ class SparkRDDAdapter(object):
         """Async partition job; see module docstring for the placement
         contract behind ``one_task_per_executor``."""
         del one_task_per_executor  # honored by partition count + spark conf
-        del fail_fast  # Spark's own scheduler governs job abort semantics
 
-        def run_and_discard(it, _f=f):
-            _f(it)
-            return iter(())
+        if fail_fast:
+            # Spark's native semantics already abort the job on a failed
+            # task (after task retries), which is exactly fail-fast.
+            def run_and_discard(it, _f=f):
+                _f(it)
+                return iter(())
 
-        rdd = self._rdd.mapPartitions(run_and_discard)
-        # pyspark evaluates lazily: count() is the canonical cheap action
-        # that forces every partition exactly once
-        return SparkAsyncResult(rdd.count)
+            rdd = self._rdd.mapPartitions(run_and_discard)
+            # pyspark evaluates lazily: count() is the canonical cheap
+            # action that forces every partition exactly once
+            return SparkAsyncResult(rdd.count)
+
+        # fail_fast=False (cleanup jobs: EndFeed must reach EVERY
+        # executor): a raising task would make Spark cancel the stage's
+        # remaining tasks, so no task may ever raise — each partition
+        # catches its own error and returns it as data; the collected
+        # errors re-raise on the driver after all partitions ran.
+        def run_catching(it, _f=f):
+            try:
+                _f(it)
+                return iter(())
+            except Exception:  # noqa: BLE001 - re-raised collected below
+                import traceback
+                return iter([traceback.format_exc()])
+
+        rdd = self._rdd.mapPartitions(run_catching)
+
+        def collect_then_raise(_rdd=rdd):
+            errors = _rdd.collect()
+            if errors:
+                raise RuntimeError(
+                    "{} partition task(s) failed; first:\n{}".format(
+                        len(errors), errors[0]))
+
+        return SparkAsyncResult(collect_then_raise)
 
 
 class SparkEngineAdapter(object):

@@ -130,3 +130,26 @@ def test_cluster_run_over_spark_adapter(fake_sc, tmp_path, monkeypatch):
             ring.unlink()
             ring.close()
         node._NODE_STATE.clear()
+
+
+def test_foreach_partition_async_fail_fast_false_runs_all(fake_sc, tmp_path):
+    """fail_fast=False (cleanup jobs): one raising partition must not stop
+    the others — on real Spark a raising task would cancel the stage, so
+    the adapter catches per-partition and re-raises collected errors
+    after every partition ran (EndFeed must reach every executor)."""
+    eng = spark_adapter.SparkEngineAdapter(fake_sc, num_executors=3)
+    marker_dir = tmp_path / "ran"
+    marker_dir.mkdir()
+
+    def work(it, _dir=str(marker_dir)):
+        items = list(it)
+        open(os.path.join(_dir, "p-%d" % items[0]), "w").close()
+        if items[0] == 1:
+            raise ValueError("partition 1 exploded")
+
+    res = eng.parallelize([0, 1, 2], 3).foreachPartitionAsync(
+        work, fail_fast=False)
+    with pytest.raises(RuntimeError) as ei:
+        res.get(timeout=60)
+    assert "partition 1 exploded" in str(ei.value)
+    assert sorted(os.listdir(str(marker_dir))) == ["p-0", "p-1", "p-2"]

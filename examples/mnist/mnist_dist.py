@@ -68,9 +68,11 @@ def _feed_batches(feed, batch_size):
     """
     for records in feed.numpy_batches(batch_size):
         parsed = [_parse_csv_row(r) for r in records]
-        n = len(parsed)
-        if n < batch_size:  # pad the tail to the compiled batch shape
-            parsed.extend(parsed[: batch_size - n])
+        while len(parsed) < batch_size:
+            # pad the tail to the compiled batch shape; modular repetition
+            # because a tail can be smaller than half a batch (one extend
+            # would still come up short)
+            parsed.extend(parsed[: batch_size - len(parsed)])
         yield {"x": np.stack([p["x"] for p in parsed]),
                "y": np.asarray([p["y"] for p in parsed], np.int64)}
 

@@ -2,6 +2,7 @@
 
 Reference examples (SURVEY.md §2.1): MNIST LeNet (``examples/mnist``),
 ResNet (``examples/resnet``), Inception-v3 (``examples/imagenet``),
+U-Net (``examples/segmentation``),
 plus the BASELINE.json configs (BERT-base SQuAD, Wide&Deep Criteo).
 The reference imported these from TF models / Keras; here they are
 first-party flax modules designed for the MXU: NHWC conv layouts,

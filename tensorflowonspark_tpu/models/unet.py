@@ -1,0 +1,100 @@
+"""U-Net encoder/decoder for dense prediction (semantic segmentation).
+
+Reference: ``examples/segmentation`` (the TF2 U-Net-ish tutorial port,
+SURVEY.md §2.1 v2.x era) — the reference's only dense-prediction model
+family. Built TPU-first rather than translated:
+
+- NHWC, 3x3 convs throughout — every conv tiles onto the MXU.
+- bfloat16 activations, float32 params/BatchNorm stats (same dtype
+  policy as the ResNet family).
+- Downsampling via strided conv (not max-pool + conv: one MXU op
+  instead of a bandwidth-bound pool followed by a conv) and upsampling
+  via ``ConvTranspose`` — both static-shaped, fusion-friendly.
+- Skip connections concatenate on the channel (minor-most) axis, the
+  layout XLA prefers for NHWC concat fusions.
+- No python control flow in the forward; depth is a static config.
+"""
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBlock(nn.Module):
+    """Two 3x3 conv+BN+relu — the per-resolution workhorse."""
+
+    filters: int
+    dtype: Any = jnp.bfloat16
+    bn_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.bn_dtype,
+                       param_dtype=jnp.float32)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        x = nn.relu(norm()(conv(self.filters, (3, 3))(x)))
+        x = nn.relu(norm()(conv(self.filters, (3, 3))(x)))
+        return x
+
+
+class UNet(nn.Module):
+    """U-Net: encoder pyramid, bottleneck, decoder with skip concats.
+
+    ``features=(32, 64, 128)`` gives a 3-level net whose bottleneck sees
+    1/8 resolution; inputs must be divisible by ``2**len(features)``.
+    Returns per-pixel logits ``[N, H, W, num_classes]`` in float32.
+    """
+
+    num_classes: int
+    features: Sequence[int] = (32, 64, 128)
+    dtype: Any = jnp.bfloat16
+    bn_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        block = partial(ConvBlock, dtype=self.dtype, bn_dtype=self.bn_dtype)
+        x = x.astype(self.dtype)
+
+        skips = []
+        for f in self.features:
+            x = block(f)(x, train=train)
+            skips.append(x)
+            # strided conv downsample: one MXU matmul, no pooling pass
+            x = nn.Conv(f, (3, 3), strides=(2, 2), use_bias=False,
+                        dtype=self.dtype)(x)
+
+        x = block(self.features[-1] * 2)(x, train=train)
+
+        for f, skip in zip(reversed(self.features), reversed(skips)):
+            x = nn.ConvTranspose(f, (2, 2), strides=(2, 2),
+                                 use_bias=False, dtype=self.dtype)(x)
+            x = jnp.concatenate([x, skip.astype(x.dtype)], axis=-1)
+            x = block(f)(x, train=train)
+
+        # float32 logits: the loss/softmax is the numerically-sensitive op
+        return nn.Conv(self.num_classes, (1, 1),
+                       dtype=jnp.float32)(x.astype(jnp.float32))
+
+
+def segmentation_loss(logits, batch):
+    """Mean per-pixel softmax cross-entropy; ``batch['y']`` is [N,H,W] int."""
+    import optax
+
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["y"]).mean()
+
+
+def mean_iou(logits, labels, num_classes):
+    """Mean intersection-over-union across classes (nan-safe macro mean)."""
+    preds = jnp.argmax(logits, axis=-1)
+    ious = []
+    for c in range(num_classes):
+        p = preds == c
+        t = labels == c
+        inter = jnp.sum(p & t)
+        union = jnp.sum(p | t)
+        ious.append(jnp.where(union > 0, inter / jnp.maximum(union, 1), 1.0))
+    return jnp.mean(jnp.stack(ious))

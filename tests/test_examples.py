@@ -133,3 +133,34 @@ def test_lm_generate(tmp_path):
 def test_longcontext(tmp_path):
     _run("examples/longcontext/train_long.py", "--seq_len", "256",
          "--steps", "4", "--batch", "1", "--hidden", "32", "--layers", "1")
+
+
+def test_segmentation_spark(tmp_path):
+    """U-Net dense prediction through the SPARK feed (the reference's
+    examples/segmentation family)."""
+    model = str(tmp_path / "seg")
+    _run("examples/segmentation/segmentation_spark.py", "--cluster_size", "2",
+         "--num_examples", "192", "--batch_size", "16", "--image_size", "32",
+         "--model_dir", model)
+    stats = _stats(model)
+    assert stats["steps"] > 0
+    # 3-class problem: random guessing sits near ~0.2 macro IoU; even a
+    # dozen smoke steps separates shapes from background
+    assert stats["val_mean_iou"] > 0.3
+
+
+def test_mnist_pipeline(tmp_path):
+    """ML Pipeline API at example level: TFEstimator.fit spins the
+    cluster from a DataFrame, TFModel.transform serves the export
+    (reference examples/mnist/{keras,estimator} family)."""
+    out = _run("examples/mnist/mnist_pipeline.py", "--cluster_size", "2",
+               "--images", str(tmp_path / "mnist"),
+               "--num_train", "768", "--epochs", "2",
+               "--export_dir", str(tmp_path / "export"))
+    line = [ln for ln in out.stdout.splitlines()
+            if "test accuracy" in ln][-1]
+    acc = float(line.split("test accuracy")[1].split()[0])
+    # load_digits upscaled; LeNet reaches ~0.85 in two smoke epochs.
+    # Anything below coin-flip-on-10-classes x5 means the pipeline fed
+    # garbage (mapping/order bugs), which is what this guards.
+    assert acc > 0.5, line

@@ -97,10 +97,7 @@ def map_fun(args, ctx):
 
     def batches():
         B = args["batch_size"]
-        for records in feed.numpy_batches(B):
-            records = list(records)
-            while len(records) < B:  # pad tail to the compiled shape
-                records.extend(records[: B - len(records)])
+        for records in feed.numpy_batches(B, pad_to_batch=True):
             ids = np.zeros((B, SEQ), np.int32)
             mask = np.zeros((B, SEQ), bool)
             start = np.zeros((B,), np.int32)

@@ -279,10 +279,7 @@ def map_fun(args, ctx):
 
     def batches():
         B = args["batch_size"]
-        for records in feed.numpy_batches(B):
-            records = list(records)
-            while len(records) < B:  # tail may be far smaller than B
-                records.extend(records[: B - len(records)])
+        for records in feed.numpy_batches(B, pad_to_batch=True):
             yield {"dense": np.stack([r[0] for r in records]),
                    "cat": np.stack([r[1] for r in records]),
                    "label": np.array([r[2] for r in records], np.int32)}

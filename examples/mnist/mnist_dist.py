@@ -62,17 +62,11 @@ def map_fun(args, ctx):
 def _feed_batches(feed, batch_size):
     """DataFeed records (CSV rows) -> stacked {'x','y'} device batches.
 
-    Drops ragged tails smaller than the device count so the batch dim
-    always splits over the mesh (static shapes keep XLA recompiles away:
-    pad-to-batch instead of shape-per-tail).
+    pad_to_batch keeps one static batch shape so the batch dim always
+    splits over the mesh and XLA never recompiles for a ragged tail.
     """
-    for records in feed.numpy_batches(batch_size):
+    for records in feed.numpy_batches(batch_size, pad_to_batch=True):
         parsed = [_parse_csv_row(r) for r in records]
-        while len(parsed) < batch_size:
-            # pad the tail to the compiled batch shape; modular repetition
-            # because a tail can be smaller than half a batch (one extend
-            # would still come up short)
-            parsed.extend(parsed[: batch_size - len(parsed)])
         yield {"x": np.stack([p["x"] for p in parsed]),
                "y": np.asarray([p["y"] for p in parsed], np.int64)}
 

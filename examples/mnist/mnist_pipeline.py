@@ -47,12 +47,8 @@ def train_fn(args, ctx):
     feed = ctx.get_data_feed(train_mode=True)
 
     def batches():
-        for records in feed.numpy_batches(args.batch_size):
-            rows = list(records)
-            while len(rows) < args.batch_size:
-                # modular repetition: one extend comes up short when the
-                # partition tail is smaller than half a batch
-                rows.extend(rows[: args.batch_size - len(rows)])
+        for rows in feed.numpy_batches(args.batch_size,
+                                       pad_to_batch=True):
             # input_mapping order: (image, label)
             x = np.asarray([r[0] for r in rows], np.float32)
             yield {"x": (x / 255.0).reshape(-1, 28, 28, 1),

@@ -70,7 +70,8 @@ def map_fun(args, ctx):
     if args["imagenet"]:
         model, image, classes = ResNet50(), 224, 1000
     else:
-        model = ResNet(stage_sizes=[2, 2, 2], num_classes=10, width=16)
+        model = ResNet(stage_sizes=[2, 2, 2], num_classes=10, width=16,
+                       cifar_stem=True)
         image, classes = 32, 10
 
     trainer = training.Trainer(

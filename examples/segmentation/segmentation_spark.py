@@ -75,13 +75,8 @@ def map_fun(args, ctx):
     feed = ctx.get_data_feed(train_mode=True)
 
     def batches():
-        for records in feed.numpy_batches(args["batch_size"]):
-            records = list(records)
-            while len(records) < args["batch_size"]:
-                # pad tail to the compiled shape; modular repetition
-                # because a partition tail can be smaller than half a
-                # batch (one extend would still come up short)
-                records.extend(records[: args["batch_size"] - len(records)])
+        for records in feed.numpy_batches(args["batch_size"],
+                                          pad_to_batch=True):
             yield {"x": np.stack([r["x"] for r in records])
                    .astype(np.float32) / 255.0,
                    "y": np.stack([r["y"] for r in records])

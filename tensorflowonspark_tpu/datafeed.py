@@ -245,11 +245,19 @@ class DataFeed(object):
                 cols[name].append(v)
         return {name: np.asarray(vs) for name, vs in cols.items()}
 
-    def numpy_batches(self, batch_size):
+    def numpy_batches(self, batch_size, pad_to_batch=False):
         """Generator of non-empty batches until end-of-feed.
 
         The TPU-idiomatic consumption loop: wrap in ``infeed.prefetch`` to
         overlap host->HBM transfer with the device step.
+
+        ``pad_to_batch=True`` repeats a short batch's own records
+        (modularly — partition tails can be smaller than half a batch)
+        until it reaches ``batch_size``: jit-compiled steps want one
+        static batch shape, and a repeated tail record only biases the
+        last step of an epoch marginally — the same trade every
+        drop-remainder/pad input pipeline makes. Applies to both record
+        lists and (via column-wise ``np.resize``) mapped column dicts.
         """
         while not self.should_stop():
             batch = self.next_batch(batch_size)
@@ -257,6 +265,16 @@ class DataFeed(object):
                 (len(next(iter(batch.values()))) if batch else 0)
             if size == 0:
                 continue
+            if pad_to_batch and size < batch_size:
+                if self.input_tensors is None:
+                    batch = list(batch)
+                    while len(batch) < batch_size:
+                        batch.extend(batch[: batch_size - len(batch)])
+                else:
+                    # np.resize repeats the array cyclically along axis 0
+                    # when flattened; reshape keeps trailing dims intact
+                    batch = {k: np.resize(v, (batch_size,) + v.shape[1:])
+                             for k, v in batch.items()}
             yield batch
 
     def stats(self):

@@ -62,18 +62,30 @@ class ResNet(nn.Module):
     width: int = 64
     dtype: Any = jnp.bfloat16
     bn_dtype: Any = jnp.float32  # see BottleneckBlock.bn_dtype
+    #: True = the canonical CIFAR stem (3x3 stride-1 conv, no pool — the
+    #: He et al. small-image form): a 32px input keeps full resolution
+    #: into stage 1 instead of arriving 4x-downsampled through the
+    #: ImageNet 7x7/maxpool stem.
+    cifar_stem: bool = False
 
     @nn.compact
     def __call__(self, x, train=False):
         # x: [B, H, W, 3] float32
         x = x.astype(self.dtype)
-        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
-                    use_bias=False, dtype=self.dtype, name="conv_init")(x)
+        if self.cifar_stem:
+            x = nn.Conv(self.width, (3, 3), use_bias=False,
+                        dtype=self.dtype, name="conv_init")(x)
+        else:
+            x = nn.Conv(self.width, (7, 7), strides=(2, 2),
+                        padding=[(3, 3), (3, 3)],
+                        use_bias=False, dtype=self.dtype, name="conv_init")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-5, dtype=self.bn_dtype,
                          param_dtype=jnp.float32, name="bn_init")(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2),
+                            padding=((1, 1), (1, 1)))
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = 2 if i > 0 and j == 0 else 1

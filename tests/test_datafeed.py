@@ -108,3 +108,32 @@ def test_input_mapping_dict_records_use_field_names():
     batch = feed.next_batch(1)
     assert batch["image"].shape == (1, 3)
     assert batch["label"][0] == 7
+
+
+def test_numpy_batches_pad_to_batch_records():
+    """pad_to_batch repeats a short tail modularly to the full batch —
+    including tails smaller than half a batch (one extend would come up
+    short; this was a live bug in four examples)."""
+    mgr = _mgr()
+    q = mgr.get_queue("input")
+    q.put([1, 2, 3])  # tail of 3 against batch_size 8
+    q.put(EndFeed())
+    feed = DataFeed(mgr, train_mode=True)
+    batches = list(feed.numpy_batches(8, pad_to_batch=True))
+    assert batches == [[1, 2, 3, 1, 2, 3, 1, 2]]
+
+
+def test_numpy_batches_pad_to_batch_mapped_columns():
+    """Mapped-column dict batches pad row-cyclically too (np.resize)."""
+    mgr = _mgr()
+    q = mgr.get_queue("input")
+    q.put([(np.arange(4) + 10 * i, i) for i in range(3)])
+    q.put(EndFeed())
+    feed = DataFeed(mgr, train_mode=True,
+                    input_mapping={"image_col": "image", "label_col": "y"})
+    batches = list(feed.numpy_batches(8, pad_to_batch=True))
+    assert len(batches) == 1
+    b = batches[0]
+    assert b["image"].shape == (8, 4) and b["y"].shape == (8,)
+    assert list(b["y"]) == [0, 1, 2, 0, 1, 2, 0, 1]
+    np.testing.assert_array_equal(b["image"][3], b["image"][0])

@@ -164,3 +164,13 @@ def test_mnist_pipeline(tmp_path):
     # Anything below coin-flip-on-10-classes x5 means the pipeline fed
     # garbage (mapping/order bugs), which is what this guards.
     assert acc > 0.5, line
+
+
+def test_cifar10_spark(tmp_path):
+    """Cluster-fed image classification at CIFAR shape through the SPARK
+    feed (the reference's examples/cifar10 family; examples/resnet covers
+    the same model in InputMode.TENSORFLOW)."""
+    model = str(tmp_path / "cifar")
+    _run("examples/cifar10/cifar10_spark.py", "--cluster_size", "2",
+         "--num_examples", "192", "--batch_size", "32", "--model_dir", model)
+    assert _stats(model)["steps"] > 0

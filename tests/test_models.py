@@ -220,3 +220,31 @@ def test_unet_forward_and_train(jax):
     # mean_iou: perfect one-hot prediction of the labels scores 1.0
     perfect = np.eye(3, dtype=np.float32)[batch["y"]]
     assert float(unet.mean_iou(perfect, batch["y"], 3)) == pytest.approx(1.0)
+
+
+def test_resnet_cifar_stem(jax):
+    """cifar_stem keeps full resolution into stage 1 (3x3 s1, no pool):
+    a 32px input must pool 8x8 features after 3 stages, vs 1x1-ish
+    through the ImageNet stem, and still train."""
+    import optax
+
+    from tensorflowonspark_tpu import training
+    from tensorflowonspark_tpu.models.resnet import ResNet
+    from tensorflowonspark_tpu.parallel import build_mesh
+
+    model = ResNet(stage_sizes=[1, 1, 1], num_classes=10, width=8,
+                   cifar_stem=True)
+    x = np.random.RandomState(0).rand(8, 32, 32, 3).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    # stem conv is 3x3 (CIFAR form), not 7x7
+    assert variables["params"]["conv_init"]["kernel"].shape[:2] == (3, 3)
+    logits = model.apply(variables, x, train=False,
+                         mutable=False)
+    assert logits.shape == (8, 10)
+
+    batch = {"x": x, "y": np.arange(8) % 10}
+    mesh = build_mesh()
+    trainer = training.Trainer(model, optax.sgd(0.1), mesh)
+    state = trainer.init(jax.random.PRNGKey(0), x)
+    state, metrics = trainer.step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))

@@ -31,6 +31,11 @@ def param_path_specs(params, rules, default=None):
         None on the left to match, the flax convention of sharding the
         trailing dims).
       default: spec for unmatched leaves (None = replicate).
+
+    Raw specs are NOT divisibility-guarded — pass each through
+    :func:`constrain_spec` (what :func:`tree_shardings` does) before
+    building shardings for a concrete mesh, or an indivisible dim is a
+    hard error at device_put/jit time.
     """
     import jax
     from jax.sharding import PartitionSpec
@@ -56,17 +61,56 @@ def param_path_specs(params, rules, default=None):
 
 
 def tree_shardings(params, mesh, rules, default=None):
-    """Pytree of NamedShardings shaped like ``params`` (for jit/device_put)."""
+    """Pytree of NamedShardings shaped like ``params`` (for jit/device_put).
+
+    A rule dim whose size does not divide its mesh axis falls back to
+    replication for that dim (t5x-style): rule catalogs are written for
+    the flagship configs, and a tiny head count or a 2-row type-vocab
+    table must degrade to a replicated dim, not a hard device_put error
+    at wider TP (found by scripts/tp_scaling_model.py at tp>=4: BERT's
+    [heads, head_dim] biases with 2 heads)."""
     import jax
-    from jax.sharding import NamedSharding
+    from jax.sharding import NamedSharding, PartitionSpec
 
     by_path = param_path_specs(params, rules, default)
 
     def _lookup(path, leaf):
         name = "/".join(_key_str(k) for k in path)
-        return NamedSharding(mesh, by_path[name])
+        spec = constrain_spec(by_path[name], leaf.shape, mesh, name=name)
+        return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(_lookup, params)
+
+
+def constrain_spec(spec, shape, mesh, name="<param>"):
+    """Drop spec dims that don't divide their mesh axes (replicate them).
+
+    Public so callers building ``in_shardings`` straight from
+    ``param_path_specs`` specs get the same degrade-to-replicate
+    behavior as :func:`tree_shardings`. The fallback WARNS: for a tiny
+    dim (2-head bias) it is the intended degrade, but on a flagship
+    config it usually means a misconfigured mesh width about to
+    replicate a large matrix — memory blowup, not a crash, so it must
+    be visible in default logging."""
+    from jax.sharding import PartitionSpec
+
+    fixed = []
+    for i, axis in enumerate(spec):
+        if axis is None or i >= len(shape):
+            fixed.append(axis)
+            continue
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        n = 1
+        for a in axes:
+            n *= mesh.shape[a]
+        if shape[i] % n:
+            logger.warning(
+                "replicating %s dim %d: size %d does not divide mesh "
+                "axes %r (=%d)", name, i, shape[i], axes, n)
+            fixed.append(None)
+        else:
+            fixed.append(axis)
+    return PartitionSpec(*fixed)
 
 
 def _key_str(key):

@@ -495,3 +495,39 @@ def test_longcontext_zigzag_matches_contiguous(jax):
     assert abs(f_c - f_z) < 1e-3, (f_c, f_z)
     assert abs(l_c - l_z) < 5e-2 * max(abs(l_c), 1e-3), (l_c, l_z)
     assert l_z < f_z  # and it actually learns in the zigzag layout
+
+
+def test_tree_shardings_indivisible_dim_replicates():
+    """A rule dim that doesn't divide its mesh axis degrades to a
+    replicated dim instead of a device_put error — BERT's [2-head]
+    biases at tp=4 (found by scripts/tp_scaling_model.py)."""
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.models import bert
+    from tensorflowonspark_tpu.parallel import build_mesh
+    from tensorflowonspark_tpu.parallel.sharding import (
+        BERT_TP_RULES, tree_shardings)
+
+    mesh = build_mesh({"data": 2, "model": 4})
+    cfg = bert.bert_tiny()  # 2 heads: head-sharded dims can't split by 4
+    model = bert.BertForQuestionAnswering(cfg)
+    x = np.zeros((4, 16), np.int32)
+    params = model.init(jax.random.PRNGKey(0), x,
+                        np.ones((4, 16), bool), deterministic=True)["params"]
+    shardings = tree_shardings(params, mesh, BERT_TP_RULES)
+    placed = jax.device_put(params, shardings)  # must not raise
+
+    def spec_of(pattern):
+        import re
+        for path, leaf in jax.tree_util.tree_flatten_with_path(placed)[0]:
+            name = "/".join(str(getattr(k, "key", k)) for k in path)
+            if re.search(pattern, name):
+                return tuple(leaf.sharding.spec)
+        raise AssertionError(pattern + " not found")
+
+    # the 2-head bias dim CANNOT split by 4: must have degraded to
+    # replicated, while the 64-wide ffn kernel keeps its model axis —
+    # an implementation that replicates everything must fail here
+    assert spec_of(r"attention/query/bias")[:1] == (None,)
+    assert "model" in spec_of(r"ffn_in/kernel")

@@ -164,3 +164,26 @@ def test_scaling_traffic_n_invariance():
     rec = json.loads(out.stdout[out.stdout.index("{"):])
     assert rec["all_points_ok"] is True, rec
     assert rec["ratio_n_invariant"] is True, rec
+
+
+@pytest.mark.slow
+def test_tp_collective_traffic_measured_at_width():
+    """The TP analog of the DP traffic test: compile AND execute the
+    megatron-sharded BERT step at tp=2 and tp=4 and read the collective
+    bytes XLA actually inserted (scripts/tp_scaling_model.py;
+    docs/scaling.md). tp=4 also regression-covers the indivisible-dim
+    fallback in tree_shardings — it was a hard device_put error before
+    this harness existed."""
+    import json
+    import subprocess
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [_sys.executable, os.path.join(repo, "scripts/tp_scaling_model.py"),
+         "--sweep", "2,4"],
+        capture_output=True, text=True, timeout=1500, cwd=repo)
+    assert out.returncode == 0, out.stdout[-1500:] + out.stderr[-1000:]
+    rec = json.loads(out.stdout[out.stdout.index("{"):])
+    assert rec["all_points_ok"] is True, rec
+    for p in rec["sweep"]:
+        assert p["step_executed"] and p["total_collective_bytes"] > 0, p

@@ -123,8 +123,32 @@ def map_fun(args, ctx):
 
     state = trainer.init(jax.random.PRNGKey(0),
                          np.zeros((8, image, image, 3), np.float32))
+
+    # The recovery story (SURVEY.md §5 failure-detection row) at example
+    # level: restore-latest before training, save every --ckpt_every
+    # steps plus once at the end; a re-submitted job resumes instead of
+    # restarting (reference: MonitoredTrainingSession's checkpoint dir).
+    ckpt = None
+    start_step = 0
+    hooks = ()
+    if args.get("ckpt_dir"):
+        from tensorflowonspark_tpu import checkpoint
+
+        ckpt = checkpoint.Checkpointer(ctx.absolute_path(args["ckpt_dir"]),
+                                       chief=ctx.job_name == "chief")
+        restored = ckpt.restore(state)
+        if restored is not None:
+            state = restored
+            start_step = int(state["step"])
+        hooks = (checkpoint.hook(ckpt, args.get("ckpt_every", 50)),)
+
     state, steps, rate = trainer.train_loop(
-        state, infeed.sharded_batches(batches(), mesh), log_every=10)
+        state, infeed.sharded_batches(batches(), mesh), log_every=10,
+        hooks=hooks)
+    if ckpt is not None:
+        ckpt.save(int(state["step"]), state, force=True)
+        ckpt.wait()
+        ckpt.close()
     if ctx.job_name == "chief":
         out = ctx.absolute_path(args["model_dir"])
         os.makedirs(out, exist_ok=True)
@@ -132,6 +156,8 @@ def map_fun(args, ctx):
             json.dump({"steps": steps, "images_per_sec": rate,
                        "images_per_sec_per_device": rate / len(jax.devices()),
                        "reader_records_per_sec": reader_rate,
+                       "start_step": start_step,
+                       "end_step": int(jax.device_get(state["step"])),
                        "input": "tfrecord" if args.get("data_dir")
                        else "synthetic"}, f)
 
@@ -150,6 +176,10 @@ def main(argv=None):
     ap.add_argument("--make_data", type=int, default=0, metavar="N",
                     help="first write N synthetic TFRecord examples to "
                          "--data_dir")
+    ap.add_argument("--ckpt_dir", default=None,
+                    help="checkpoint/resume dir: restore-latest on start, "
+                         "save every --ckpt_every steps and at the end")
+    ap.add_argument("--ckpt_every", type=int, default=50)
     args = ap.parse_args(argv)
     logging.basicConfig(level="INFO")
 

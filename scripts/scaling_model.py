@@ -38,33 +38,46 @@ _DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8, "s32": 4,
                 "u32": 4, "s64": 8, "pred": 1, "s8": 1, "u8": 1}
 
 
-def _allreduce_bytes(hlo_text):
-    """Sum output bytes of every all-reduce in the compiled HLO.
+def collective_bytes(hlo_text, families=("all-reduce",)):
+    """Per-family output bytes of every collective in the compiled HLO.
 
     XLA bundles gradients: an op's output is often a TUPLE of shapes
     ('%ar = (f32[64]{0}, f32[9,9,3,64]{...}) all-reduce(...)'), so every
     element must be counted, not just the first — undercounting would
-    overstate the very efficiency this model exists to bound."""
-    total = 0
-    ops = 0
-    # 'all-reduce(' and async 'all-reduce-start(' (whose matching -done
-    # is NOT separately counted) — anchored on the opcode's open-paren.
-    # The shape region is taken as everything between '=' and the opcode
-    # on the line: TPU post-layout HLO embeds parens inside shapes
-    # ('f32[64]{0:T(8,128)}'), so a paren-balanced tuple match would
-    # silently drop exactly the on-chip ops this script must count.
-    for m in re.finditer(r"=\s*([^\n]+?)\s+all-reduce(?:-start)?\(",
-                         hlo_text):
-        shapes = re.findall(r"([a-z0-9]+)\[([0-9,]*)\]", m.group(1))
-        if not shapes:
-            continue
-        for dtype, dims in shapes:
-            nbytes = _DTYPE_BYTES.get(dtype, 4)
-            for d in filter(None, dims.split(",")):
-                nbytes *= int(d)
-            total += nbytes
-        ops += 1
-    return total, ops
+    overstate the very efficiency this model exists to bound.
+
+    Matches '<family>(' and the async '<family>-start(' (whose matching
+    '-done' is NOT separately counted) — anchored on the opcode's
+    open-paren. The shape region is taken as everything between '=' and
+    the opcode on the line: TPU post-layout HLO embeds parens inside
+    shapes ('f32[64]{0:T(8,128)}'), so a paren-balanced tuple match
+    would silently drop exactly the on-chip ops this must count.
+    Returns {family: {"bytes": int, "ops": int}} for seen families
+    (shared by the DP and TP sweeps)."""
+    out = {}
+    for family in families:
+        total = 0
+        ops = 0
+        pat = r"=\s*([^\n]+?)\s+" + re.escape(family) + r"(?:-start)?\("
+        for m in re.finditer(pat, hlo_text):
+            shapes = re.findall(r"([a-z0-9]+)\[([0-9,]*)\]", m.group(1))
+            if not shapes:
+                continue
+            for dtype, dims in shapes:
+                nbytes = _DTYPE_BYTES.get(dtype, 4)
+                for d in filter(None, dims.split(",")):
+                    nbytes *= int(d)
+                total += nbytes
+            ops += 1
+        if ops:
+            out[family] = {"bytes": total, "ops": ops}
+    return out
+
+
+def _allreduce_bytes(hlo_text):
+    """(total_bytes, ops) of every all-reduce in the compiled HLO."""
+    fam = collective_bytes(hlo_text).get("all-reduce", {})
+    return fam.get("bytes", 0), fam.get("ops", 0)
 
 
 def run_width(argv, n, key="mesh_devices", timeout=600):
@@ -117,10 +130,14 @@ def _sweep(ns):
     for n in ns:
         rec = run_width([os.path.abspath(__file__)], n, key="mesh_devices")
         if "error" not in rec:
-            rec = {k: rec[k] for k in
-                   ("mesh_devices", "hlo_allreduce_bytes",
-                    "hlo_allreduce_ops", "allreduce_vs_params",
-                    "step_executed")}
+            try:
+                rec = {k: rec[k] for k in
+                       ("mesh_devices", "hlo_allreduce_bytes",
+                        "hlo_allreduce_ops", "allreduce_vs_params",
+                        "step_executed")}
+            except KeyError as e:  # a bad point degrades, never kills
+                rec = {"mesh_devices": n,
+                       "error": "report missing key {}".format(e)}
         points.append(rec)
     ratios = [p["allreduce_vs_params"] for p in points if "error" not in p]
     all_ok = all("error" not in p and p["step_executed"] for p in points)

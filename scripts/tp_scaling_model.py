@@ -27,36 +27,12 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from scaling_model import _DTYPE_BYTES, ICI_BYTES_PER_SEC  # noqa: E402
+from scaling_model import ICI_BYTES_PER_SEC, collective_bytes  # noqa: E402
 
-
-def _collective_bytes(hlo_text):
-    """Per-family output bytes of every collective in the compiled HLO.
-
-    Same opcode-anchored shape scan as scaling_model._allreduce_bytes
-    (tuple outputs counted element-wise; '-start' variants counted once,
-    their '-done' halves skipped), widened to the families TP sharding
-    can produce."""
-    import re
-
-    out = {}
-    for family in ("all-reduce", "all-gather", "reduce-scatter",
-                   "collective-permute", "all-to-all"):
-        total = ops = 0
-        pat = r"=\s*([^\n]+?)\s+" + family + r"(?:-start)?\("
-        for m in re.finditer(pat, hlo_text):
-            shapes = re.findall(r"([a-z0-9]+)\[([0-9,]*)\]", m.group(1))
-            if not shapes:
-                continue
-            for dtype, dims in shapes:
-                nbytes = _DTYPE_BYTES.get(dtype, 4)
-                for d in filter(None, dims.split(",")):
-                    nbytes *= int(d)
-                total += nbytes
-            ops += 1
-        if ops:
-            out[family] = {"bytes": int(total), "ops": ops}
-    return out
+#: every family TP sharding can produce (the DP sweep needs only
+#: all-reduce; this list is the only TP-side difference in the scan)
+_TP_FAMILIES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
 
 
 def _measure(tp):
@@ -95,7 +71,8 @@ def _measure(tp):
     step_executed = bool(np.isfinite(float(jax.device_get(
         metrics["loss"]))))
     compiled = trainer._jit_step.lower(state, batch).compile()
-    collectives = _collective_bytes(compiled.as_text())
+    collectives = collective_bytes(compiled.as_text(),
+                                   families=_TP_FAMILIES)
 
     # activation volume the megatron model predicts the comm tracks:
     # one [B, S, H] f32 activation

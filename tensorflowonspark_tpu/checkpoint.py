@@ -91,6 +91,11 @@ class Checkpointer(object):
     def save(self, step, state, force=False):
         """Commit ``state`` at ``step``; returns True if this process saved.
 
+        An already-persisted step is never overwritten: the call
+        returns False (``force`` governs orbax's save-interval policy,
+        not step replacement — orbax itself raises on an existing step
+        even with force). To genuinely replace a step, delete it first.
+
         Replicated state: chief commits, everyone else no-ops. Sharded
         state: every process participates (orbax coordinates the
         multi-process gather); a ``chief=False`` process that holds
@@ -118,6 +123,12 @@ class Checkpointer(object):
                 "restore would return garbage. Sharded states need either "
                 "all processes saving under jax.distributed, or "
                 "chief=True in the single-process case.")
+        if int(step) in self._mgr.all_steps():
+            # Already persisted (e.g. a periodic hook fired on the final
+            # step and the epilogue force-saves the same step): a no-op,
+            # not orbax's StepAlreadyExistsError — the caller's intent
+            # ("step N must be on disk") is satisfied either way.
+            return False
         state = jax.tree.map(lambda x: x, state)  # shallow copy
         saved = self._mgr.save(int(step), args=ocp.args.StandardSave(state),
                                force=force)

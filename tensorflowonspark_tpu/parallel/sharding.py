@@ -70,7 +70,7 @@ def tree_shardings(params, mesh, rules, default=None):
     at wider TP (found by scripts/tp_scaling_model.py at tp>=4: BERT's
     [heads, head_dim] biases with 2 heads)."""
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec
+    from jax.sharding import NamedSharding
 
     by_path = param_path_specs(params, rules, default)
 

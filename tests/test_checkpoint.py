@@ -120,3 +120,19 @@ def test_remote_root_rejected_unless_allowed(tmp_path):
         pytest.fail("allow_remote must bypass the local-path guard")
     except Exception:
         pass  # orbax/tensorstore's own error for an unreachable bucket
+
+
+def test_save_existing_step_is_noop(jax, tmp_path):
+    """Re-saving an already-persisted step returns False instead of
+    orbax's StepAlreadyExistsError — a periodic hook firing on the
+    final step must not break the epilogue's force-save (found by the
+    resnet example's --ckpt_dir resume path)."""
+    from tensorflowonspark_tpu import checkpoint
+
+    state = {"w": np.ones((4,), np.float32), "step": np.int32(2)}
+    ckpt = checkpoint.Checkpointer(str(tmp_path / "ckpt"), chief=True)
+    assert ckpt.save(2, state) is True
+    ckpt.wait()
+    assert ckpt.save(2, state, force=True) is False  # no raise
+    assert ckpt.latest_step() == 2
+    ckpt.close()

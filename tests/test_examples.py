@@ -174,3 +174,20 @@ def test_cifar10_spark(tmp_path):
     _run("examples/cifar10/cifar10_spark.py", "--cluster_size", "2",
          "--num_examples", "192", "--batch_size", "32", "--model_dir", model)
     assert _stats(model)["steps"] > 0
+
+
+def test_resnet_resume(tmp_path):
+    """Submit the resnet job twice with --ckpt_dir: the second run must
+    resume from the first's final step, not restart (the recovery story
+    at example level)."""
+    model = str(tmp_path / "model")
+    args = ["examples/resnet/resnet_spark.py", "--cluster_size", "2",
+            "--steps", "4", "--batch_size", "16", "--model_dir", model,
+            "--ckpt_dir", str(tmp_path / "ckpt"), "--ckpt_every", "2"]
+    _run(*args)
+    first = _stats(model)
+    assert first["start_step"] == 0 and first["end_step"] > 0
+    _run(*args)
+    second = _stats(model)
+    assert second["start_step"] == first["end_step"]
+    assert second["end_step"] > second["start_step"]

@@ -6,13 +6,46 @@
 # windows rerun with the persistent compile cache warm.
 set -u
 cd "$(dirname "$0")/.."
+bank_artifacts() {
+  # .onchip/ is gitignored (caches, tmp files); the measurement
+  # artifacts themselves must survive into the repo — round-5 window 1
+  # lost its raw capture this way (cited in docs, never committed).
+  # Per-window stamped dir so a later window never overwrites an
+  # earlier one; commit is path-restricted so an operator's staged WIP
+  # is never swept in; failures WARN loudly (a silent no-op here is the
+  # exact data loss this function exists to prevent).
+  local stamp dest copied f
+  stamp="w$(date -u +%m%d_%H%M)"
+  dest="docs/onchip_artifacts/$stamp"
+  mkdir -p "$dest"
+  copied=0
+  for f in roofline.json bench.json sweep_first.txt sweep.txt \
+           flash.json perf_analysis.json fed_vs_wire.json; do
+    [ -s ".onchip/$f" ] && cp ".onchip/$f" "$dest/$f" && copied=1
+  done
+  if [ "$copied" = 0 ]; then
+    rmdir "$dest" 2>/dev/null
+    return 0
+  fi
+  if ! git add docs/onchip_artifacts; then
+    echo "WARN: git add failed — window artifacts NOT committed ($dest)"
+    return 0
+  fi
+  git commit -q -m "Bank on-chip window artifacts ($stamp)" \
+    -m "No-Verification-Needed: measurement artifact copy, no code" \
+    -- docs/onchip_artifacts \
+    || echo "WARN: git commit failed — window artifacts staged only"
+}
+
 while true; do
   python scripts/probe_tunnel.py || exit 1   # exhausted its max_hours
   echo "=== $(date -u +%H:%M:%S) tunnel live: firing make onchip ==="
   if make onchip; then
+    bank_artifacts
     echo "=== onchip completed ALL stages; watcher done ==="
     exit 0
   fi
+  bank_artifacts
   echo "=== onchip incomplete (some stage failed); re-arming probe ==="
   sleep 600   # don't hammer a half-dead tunnel
 done

@@ -47,6 +47,8 @@ def feeder_main(mgr_addr, authkey_hex, transport, ring_name, n_images,
 
     authkey = bytes.fromhex(authkey_hex)
     mp.current_process().authkey = authkey
+    from tensorflowonspark_tpu import util
+    util.tune_malloc()  # match the production node bootstrap
     mgr = manager_lib.connect(tuple(mgr_addr), authkey)
     rng = np.random.RandomState(0)
     xs = rng.randint(0, 255, size=(chunk_records, image, image, 3),
@@ -228,6 +230,12 @@ def run_mode(transport, mode, args):
 
 
 def main():
+    from tensorflowonspark_tpu import util
+    # Same allocator tuning the production node bootstrap applies (the
+    # docs/feedpath.md "tuned" rows). Reproduce the untuned baseline
+    # rows with TFOS_MALLOC_TUNE=0.
+    util.tune_malloc()
+
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("transport", choices=["queue", "shm"])
     p.add_argument("mode", nargs="?", default="sync",

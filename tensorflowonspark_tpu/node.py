@@ -254,6 +254,10 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
 
         job_name, task_index = _assign_role(executor_id,
                                             cluster_meta["cluster_template"])
+        # Feed plane allocator tuning (8x consumer-copy rate on fresh
+        # pages; util.tune_malloc docstring): set in the bootstrap
+        # process so fork-started trainers inherit the tuned arena.
+        util.tune_malloc()
         host = info.get("host") or util.get_ip_address()
         authkey = bytes.fromhex(cluster_meta["authkey"])
         _register_filesystems(cluster_meta)
@@ -450,6 +454,7 @@ def _register_filesystems(cluster_meta):
 def _trainer_main(payload):
     """spawn-mode entry: unwrap the cloudpickle payload first."""
     from tensorflowonspark_tpu.engine import serializer
+    util.tune_malloc()  # spawn starts a fresh libc: re-apply the tuning
     _trainer_main_fork(*serializer.loads(payload))
 
 

@@ -72,7 +72,11 @@ def test_spark_mode_inference_roundtrip(sc):
                       input_mode=cluster.InputMode.SPARK)
     data = sc.parallelize(range(20), 4)
     results = tfc.inference(data).collect()
-    assert sorted(results) == [x * 10 for x in range(20)]
+    # EXACT order, not a multiset: the reference guarantees per-partition
+    # count/order (q_in.join() + counted q_out reads, SURVEY.md §7.3
+    # names it a hard part), and collect() reassembles partitions in
+    # order — so the round trip must be order-preserving end to end.
+    assert results == [x * 10 for x in range(20)]
     tfc.shutdown()
 
 
@@ -98,7 +102,8 @@ def test_inference_deep_partition_no_wedge(sc):
         data = sc.parallelize(range(n), 2)
         results = tfc.inference(data, feed_timeout=60).collect()
         assert len(results) == n
-        assert sorted(results) == [x + 1 for x in range(n)]
+        # exact order even with both queues cycling through backpressure
+        assert results == [x + 1 for x in range(n)]
         tfc.shutdown()
     finally:
         if prev is None:

@@ -147,3 +147,22 @@ def test_tfnode_module(tmp_path):
     from tensorflowonspark_tpu import export
     fn, variables, sig = export.load_model(d)
     assert float(fn(variables, {"x": np.asarray([2.0])})["y"][0]) == 3.0
+
+
+def test_tune_malloc_idempotent_and_gated(monkeypatch):
+    """Feed-plane allocator tuning: applies once on glibc, honors the
+    TFOS_MALLOC_TUNE=0 gate (fresh module state via reload)."""
+    import importlib
+
+    from tensorflowonspark_tpu import util as util_mod
+
+    assert util_mod.tune_malloc() in (True, False)
+    first = util_mod._MALLOC_TUNED
+    assert util_mod.tune_malloc() == first  # idempotent
+
+    mod = importlib.reload(util_mod)
+    try:
+        monkeypatch.setenv("TFOS_MALLOC_TUNE", "0")
+        assert mod.tune_malloc() is False
+    finally:
+        importlib.reload(util_mod)

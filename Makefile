@@ -85,7 +85,7 @@ onchip:
 	  && touch .onchip/sweep_first.ok; } || echo $$? > .onchip/sweep_first.rc
 	-test -e .onchip/bench.ok || { set -o pipefail; \
 	  $(ONCHIP_CACHE) TFOS_BENCH_VERBOSE=1 \
-	  timeout -k 30 1800 $(PYTHON) bench.py \
+	  timeout -k 30 2700 $(PYTHON) bench.py \
 	  2>>.onchip/bench.stderr | tee .onchip/bench.json.tmp \
 	  && mv .onchip/bench.json.tmp .onchip/bench.json \
 	  && { ! grep -q '"value": 0.0' .onchip/bench.json; } \

@@ -20,8 +20,12 @@ Prints ONE JSON line. Fields:
   device-resident bar (a fed/device ratio of 1.0 means the feed plane
   keeps the chip fully busy).
 - ``device_only``      — step time with the batch staged in HBM once.
-- ``cluster_fed_shm``  — fed via the native /dev/shm ring (default).
-- ``cluster_fed_queue``— fed via the manager-proxy queue transport.
+- ``cluster_fed_shm``  — fed via the native /dev/shm ring (forced).
+- ``cluster_fed_queue``— fed via the manager-proxy queue transport (forced).
+- ``cluster_fed_auto`` — fed via the production DEFAULT: the bootstrap
+                         micro-probe picks the measured-faster transport.
+- ``transport_probe``  — that probe's evidence: per-transport MB/s rates
+                         plus ``choice`` (the transport auto selected).
 - ``fed_frac_of_device`` — best fed / device_only.
 - ``mfu``              — model FLOP utilization from XLA's compiled cost
                          analysis vs the chip's bf16 peak.
@@ -98,6 +102,19 @@ def _bench_map_fun(args, ctx):
     result = {"images_per_sec": images / dt / n_dev if images else 0.0,
               "images": images, "n_devices": n_dev,
               "feed_stats": feed.stats()}
+    try:
+        # measured-at-bootstrap transport selection evidence — rates from
+        # the auto-probe kv plus the decision itself ("feed_transport" is
+        # the effective choice; rates alone mislead in the near-tie
+        # regime where the probe's 1.1x shm bias decides) — so every
+        # bench artifact carries its own transport story
+        probe = feed.mgr.get("feed_transport_probe")
+        if probe is not None:
+            probe = dict(probe)
+            probe["choice"] = feed.mgr.get("feed_transport")
+        result["transport_probe"] = probe
+    except Exception:  # noqa: BLE001 - kv absent under forced transport
+        result["transport_probe"] = None
     with open(args["result_path"], "w") as f:
         json.dump(result, f)
 
@@ -110,6 +127,11 @@ def _synth_partition(n_records, image, seed):
                      dtype=np.uint8)
     ys = (np.arange(n_records) % 1000).astype(np.int64)
     return [(xs[i], ys[i]) for i in range(n_records)]
+
+
+#: transport-selection evidence from the latest auto-mode fed run (the
+#: node bootstrap's measured probe, via the trainer's broker kv read)
+_LAST_TRANSPORT_PROBE = {}
 
 
 def _cluster_fed_images_per_sec(transport, batch, image, steps, on_tpu):
@@ -149,6 +171,9 @@ def _cluster_fed_images_per_sec(transport, batch, image, steps, on_tpu):
             sc.stop()
         with open(result_path) as f:
             result = json.load(f)
+        if result.get("transport_probe"):
+            _LAST_TRANSPORT_PROBE.clear()
+            _LAST_TRANSPORT_PROBE.update(result["transport_probe"])
         if os.environ.get("TFOS_BENCH_VERBOSE"):
             print("cluster_fed[{}]: {}".format(transport, result),
                   file=sys.stderr)
@@ -388,10 +413,13 @@ def main():
             return None
         return _median(rates)
 
-    fed_shm = fed_queue = None
+    fed_shm = fed_queue = fed_auto = None
     if fed_enabled:
         fed_shm = _fed_median("shm")
         fed_queue = _fed_median("queue")
+        # the production DEFAULT config: auto-probed transport; also the
+        # leg that captures the probe's measured rates for the artifact
+        fed_auto = _fed_median("auto")
 
     # The device-only spin has no engine timeouts around it: a tunnel
     # that dies mid-run (observed round 5 — it served the fed runs then
@@ -421,8 +449,8 @@ def main():
                    if fed_enabled else
                    "resnet50_device_only_images_per_sec_per_chip") if on_tpu \
         else "tiny_resnet_cpu_smoke_images_per_sec"
-    best_fed = max((f for f in (fed_shm, fed_queue) if f is not None),
-                   default=0.0)
+    best_fed = max((f for f in (fed_shm, fed_queue, fed_auto)
+                    if f is not None), default=0.0)
     if fed_enabled and not best_fed:
         # Both transports broken must NOT masquerade as a healthy fed run.
         print(json.dumps({
@@ -453,6 +481,8 @@ def main():
         "device_error": device_error,
         "cluster_fed_shm": round(fed_shm, 2) if fed_shm else None,
         "cluster_fed_queue": round(fed_queue, 2) if fed_queue else None,
+        "cluster_fed_auto": round(fed_auto, 2) if fed_auto else None,
+        "transport_probe": _LAST_TRANSPORT_PROBE or None,
         "fed_frac_of_device": round(best_fed / device_only, 3)
         if device_only and best_fed else None,
         # like-regimes only (VERDICT r4 weak #6): the round-2 fed bar is

@@ -135,7 +135,8 @@ def fed_vs_wire(out, fed_json, image):
                                  "artifact: roofline stage incomplete?")
         return
     best_fed = max((rec.get(k) or 0.0
-                    for k in ("cluster_fed_shm", "cluster_fed_queue")),
+                    for k in ("cluster_fed_shm", "cluster_fed_queue",
+                              "cluster_fed_auto")),
                    default=0.0)
     if not best_fed:
         out["fed_json_error"] = "no fed rate in {}".format(fed_json)

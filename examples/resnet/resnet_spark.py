@@ -142,9 +142,47 @@ def map_fun(args, ctx):
             start_step = int(state["step"])
         hooks = (checkpoint.hook(ckpt, args.get("ckpt_every", 50)),)
 
-    state, steps, rate = trainer.train_loop(
-        state, infeed.sharded_batches(batches(), mesh), log_every=10,
-        hooks=hooks)
+    # Observability at example level (SURVEY.md §5 tracing row): the
+    # profiler server for TensorBoard's profile plugin, a BOUNDED
+    # device-trace window (--trace_steps; whole-run traces are multi-GB
+    # on real runs), and loss/step-rate summaries — the feed-plane
+    # timing the reference's plumbing couldn't see.
+    writer = None
+    trace_ctx = [None]
+
+    def _stop_trace():
+        ctx_, trace_ctx[0] = trace_ctx[0], None
+        if ctx_ is not None:
+            ctx_.__exit__(None, None, None)
+
+    if args.get("profile") and ctx.job_name == "chief":
+        from tensorflowonspark_tpu import tracing
+
+        tb_dir = os.path.join(ctx.absolute_path(args["model_dir"]), "tb")
+        tracing.start_profiler_server()
+        writer = tracing.SummaryWriter(tb_dir)
+        hooks = hooks + (tracing.metrics_hook(
+            writer, every_steps=args.get("log_every", 10),
+            examples_per_step=args["batch_size"]),)
+        trace_ctx[0] = tracing.trace(os.path.join(tb_dir, "trace"))
+        trace_ctx[0].__enter__()
+
+        def _trace_bound(step_no, *_unused, _n=args.get("trace_steps", 20)):
+            if step_no >= _n:
+                _stop_trace()
+
+        hooks = hooks + (_trace_bound,)
+
+    try:
+        state, steps, rate = trainer.train_loop(
+            state, infeed.sharded_batches(batches(), mesh),
+            log_every=args.get("log_every", 10), hooks=hooks)
+    finally:
+        # a failed run keeps its trace + buffered summaries — that
+        # capture is most valuable exactly when the loop raised
+        _stop_trace()
+        if writer is not None:
+            writer.close()
     if ckpt is not None:
         ckpt.save(int(state["step"]), state, force=True)
         ckpt.wait()
@@ -180,6 +218,14 @@ def main(argv=None):
                     help="checkpoint/resume dir: restore-latest on start, "
                          "save every --ckpt_every steps and at the end")
     ap.add_argument("--ckpt_every", type=int, default=50)
+    ap.add_argument("--profile", action="store_true",
+                    help="chief: profiler server + device-trace capture "
+                         "+ TensorBoard loss/rate summaries under "
+                         "<model_dir>/tb")
+    ap.add_argument("--trace_steps", type=int, default=20,
+                    help="bound the --profile device-trace window to the "
+                         "first N steps (whole-run traces are huge)")
+    ap.add_argument("--log_every", type=int, default=10)
     args = ap.parse_args(argv)
     logging.basicConfig(level="INFO")
 

@@ -191,3 +191,19 @@ def test_resnet_resume(tmp_path):
     second = _stats(model)
     assert second["start_step"] == first["end_step"]
     assert second["end_step"] > second["start_step"]
+
+
+def test_resnet_profile(tmp_path):
+    """--profile: device-trace capture + TensorBoard summaries at example
+    level (SURVEY §5 tracing row's user-facing surface)."""
+    import glob
+
+    model = str(tmp_path / "model")
+    _run("examples/resnet/resnet_spark.py", "--cluster_size", "2",
+         "--steps", "4", "--batch_size", "16", "--model_dir", model,
+         "--profile", "--log_every", "2")
+    assert glob.glob(os.path.join(model, "tb", "trace", "plugins",
+                                  "profile", "*", "*.xplane.pb")), \
+        "no profiler trace captured"
+    assert glob.glob(os.path.join(model, "tb", "events.out.tfevents.*")), \
+        "no TensorBoard summaries written"

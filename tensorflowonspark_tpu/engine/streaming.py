@@ -64,7 +64,13 @@ class _TextFileStream(DStream):
     def _poll(self):
         if not os.path.isdir(self.directory):
             return None
-        new = sorted(set(os.listdir(self.directory)) - self._seen)
+        # Hidden files are invisible, exactly as Spark's textFileStream
+        # treats them: writers land data atomically by writing
+        # ".name.tmp" in-place then renaming — a poll must never read a
+        # half-written file.
+        new = sorted(n for n in
+                     set(os.listdir(self.directory)) - self._seen
+                     if not n.startswith("."))
         if not new:
             return None
         self._seen.update(new)

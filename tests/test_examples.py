@@ -207,3 +207,16 @@ def test_resnet_profile(tmp_path):
         "no profiler trace captured"
     assert glob.glob(os.path.join(model, "tb", "events.out.tfevents.*")), \
         "no TensorBoard summaries written"
+
+
+def test_streaming_mnist(tmp_path):
+    """Continuous training from a spooled directory stream (the
+    reference's Spark Streaming mode at example level): micro-batches
+    land as files, trainers consume across intervals, shutdown stops
+    the stream before ending the feed."""
+    model = str(tmp_path / "model")
+    _run("examples/streaming/streaming_mnist.py", "--cluster_size", "2",
+         "--intervals", "2", "--interval_examples", "128",
+         "--interval_secs", "1.5",
+         "--spool_dir", str(tmp_path / "spool"), "--model_dir", model)
+    assert _stats(model)["steps"] > 0

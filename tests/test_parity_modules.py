@@ -69,13 +69,21 @@ def test_streaming_text_file_stream(sc, tmp_path):
     ssc.textFileStream(str(d), num_slices=1).foreachRDD(
         lambda rdd: seen.extend(rdd.collect()))
     ssc.start()
+    # hidden files are invisible (Spark semantics): a writer's dotfile
+    # tmp must never be read, even once renamed content appears later
+    (d / ".b.txt.tmp").write_text("half-writ")
     (d / "a.txt").write_text("one\ntwo\n")
     import time
     deadline = time.monotonic() + 10
     while len(seen) < 2 and time.monotonic() < deadline:
         time.sleep(0.05)
+    import os as _os
+    _os.rename(str(d / ".b.txt.tmp"), str(d / "b.txt"))
+    deadline = time.monotonic() + 10
+    while len(seen) < 3 and time.monotonic() < deadline:
+        time.sleep(0.05)
     ssc.stop()
-    assert seen == ["one", "two"]
+    assert seen == ["one", "two", "half-writ"]
 
 
 def test_streaming_cluster_train(sc):

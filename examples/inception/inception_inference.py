@@ -89,6 +89,9 @@ def main(argv=None):
     ap.add_argument("--output", default=".scratch/inception_predictions")
     args = ap.parse_args(argv)
     logging.basicConfig(level="INFO")
+    if args.export_dir:
+        # trainers run from their executor workdirs; pin the path here
+        args.export_dir = os.path.abspath(args.export_dir)
 
     rng = np.random.RandomState(0)
     images = [rng.randint(0, 256, (args.image_size, args.image_size, 3),

@@ -164,11 +164,13 @@ class _ExecutorHandle(object):
                     result._complete(task["task_id"],
                                      serializer.loads(reply["value"]))
                 else:
+                    self.ctx._saw_failure = True
                     result._fail(task["task_id"],
                                  reply.get("traceback") or reply.get("error"))
                 task = None
         except (EOFError, OSError, BrokenPipeError) as e:
             logger.error("executor %d connection lost: %s", self.executor_id, e)
+            self.ctx._saw_failure = True
             self.conn_broken = True
             if task is not None and task is not _STOP:
                 task["result"]._fail(
@@ -219,6 +221,12 @@ class Context(object):
         self.num_executors = num_executors
         self.app_name = app_name
         self.authkey = os.urandom(20)
+        # Auto-generated work roots are cleaned up on a CLEAN stop();
+        # any failure keeps them — executor.log is the post-mortem. A
+        # user-passed work_root is never deleted (it's theirs), and
+        # TFOS_KEEP_WORKDIR=1 keeps even auto roots (debug sessions).
+        self._auto_work_root = work_root is None
+        self._saw_failure = False
         self.work_root = work_root or os.path.join(
             os.getcwd(), ".tfos-{}-{}".format(app_name, os.getpid()))
         os.makedirs(self.work_root, exist_ok=True)
@@ -327,11 +335,15 @@ class Context(object):
                 return
             for proc in self._procs:
                 if proc.poll() is not None:
+                    # before stop(): its clean-exit cleanup must not
+                    # delete the very logs this error points at
+                    self._saw_failure = True
                     self.stop()
                     raise RuntimeError(
                         "executor process exited with code {} during startup; "
                         "see logs under {}".format(proc.returncode, self.work_root))
             if time.monotonic() > deadline:
+                self._saw_failure = True
                 self.stop()
                 raise TimeoutError(
                     "only {}/{} executors connected within {}s".format(
@@ -443,8 +455,11 @@ class Context(object):
                 proc.wait(timeout=left)
             except subprocess.TimeoutExpired:
                 logger.warning("killing unresponsive executor pid %s", proc.pid)
+                self._saw_failure = True
                 proc.kill()
                 proc.wait(timeout=5)
+            if proc.returncode not in (0, None):
+                self._saw_failure = True
         if self._procs:
             # local executors shared this host: reap any shm feed rings
             # their processes left behind (SIGKILL skips atexit paths).
@@ -458,6 +473,17 @@ class Context(object):
                     shm.sweep_stale()
                 except Exception:  # noqa: BLE001 - cleanup is best effort
                     logger.debug("stale ring sweep failed", exc_info=True)
+        if (self._auto_work_root and not self._saw_failure
+                and os.environ.get("TFOS_KEEP_WORKDIR") != "1"):
+            # clean exit: the auto-generated scratch root (executor logs,
+            # authkey, driver.info) has served its purpose — don't litter
+            # the caller's cwd with one dir per run. Any failure above
+            # keeps it: executor.log is the post-mortem.
+            import shutil
+            shutil.rmtree(self.work_root, ignore_errors=True)
+        elif self._saw_failure:
+            logger.info("keeping work root %s (failures this session)",
+                        self.work_root)
 
     def __enter__(self):
         return self

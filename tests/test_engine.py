@@ -292,3 +292,28 @@ def test_fail_fast_false_runs_every_task(tmp_path):
         assert sorted(os.listdir(str(marker_dir))) == ["ran-1", "ran-2"]
     finally:
         ctx.stop()
+
+
+def test_auto_work_root_cleaned_on_clean_stop(tmp_path, monkeypatch):
+    """Auto-generated work roots vanish on a clean stop (no per-run
+    litter in the caller's cwd) but survive a failed session — the
+    executor logs are the post-mortem."""
+    monkeypatch.chdir(tmp_path)
+    ctx = Context(num_executors=1)
+    root = ctx.work_root
+    assert ctx.parallelize([1, 2], 1).collect() == [1, 2]
+    ctx.stop()
+    assert not os.path.exists(root), "clean stop must remove the auto root"
+
+    ctx2 = Context(num_executors=1)
+    root2 = ctx2.work_root
+    with pytest.raises(TaskError):
+        ctx2.parallelize([1], 1).map(lambda x: 1 / 0).collect()
+    ctx2.stop()
+    assert os.path.exists(root2), "failed session must keep the logs"
+
+    explicit = str(tmp_path / "mine")
+    ctx3 = Context(num_executors=1, work_root=explicit)
+    assert ctx3.parallelize([1], 1).collect() == [1]
+    ctx3.stop()
+    assert os.path.exists(explicit), "user-passed work_root is theirs"

@@ -220,3 +220,27 @@ def test_streaming_mnist(tmp_path):
          "--interval_secs", "1.5",
          "--spool_dir", str(tmp_path / "spool"), "--model_dir", model)
     assert _stats(model)["steps"] > 0
+
+
+def test_inception_train_export_infer_roundtrip(tmp_path):
+    """Distributed Inception train -> eval -> export -> cluster inference
+    from the export (the reference's imagenet/inception training side)."""
+    model = str(tmp_path / "model")
+    export_dir = str(tmp_path / "export")
+    out = _run("examples/inception/inception_train.py", "--cluster_size", "2",
+               "--num_examples", "96", "--batch_size", "16",
+               "--image_size", "75", "--num_classes", "4",
+               "--model_dir", model, "--export_dir", export_dir)
+    stats = _stats(model)
+    assert stats["steps"] > 0
+    # a dozen smoke steps of from-scratch Inception is too noisy for a
+    # learning bar (observed 0.25-0.56 across seeds); the smoke asserts
+    # the eval pass ran and reported a sane value — learning-at-smoke is
+    # proven by the mnist/segmentation/pipeline examples
+    assert 0.0 <= stats["val_accuracy"] <= 1.0, stats
+    preds = str(tmp_path / "preds")
+    _run("examples/inception/inception_inference.py", "--cluster_size", "2",
+         "--num_images", "8", "--batch_size", "4", "--image_size", "75",
+         "--num_classes", "4", "--export_dir", export_dir,
+         "--output", preds)
+    assert os.listdir(preds)

@@ -405,21 +405,32 @@ def main():
     # are stable and expensive — one spin.
     fed_reps = _env_int("TFOS_BENCH_FED_REPS", 1 if on_tpu else 3)
 
-    def _fed_median(transport):
+    def _fed_median(transport, reps=None):
         rates = [r for r in (_cluster_fed_images_per_sec(
             transport, batch, image, fed_steps, on_tpu)
-            for _ in range(fed_reps)) if r is not None]
+            for _ in range(reps or fed_reps)) if r is not None]
         if not rates:
             return None
         return _median(rates)
 
     fed_shm = fed_queue = fed_auto = None
+    auto_full_reps = True
     if fed_enabled:
         fed_shm = _fed_median("shm")
         fed_queue = _fed_median("queue")
         # the production DEFAULT config: auto-probed transport; also the
-        # leg that captures the probe's measured rates for the artifact
-        fed_auto = _fed_median("auto")
+        # leg that captures the probe's measured rates for the artifact.
+        # One spin on CPU (unless TFOS_BENCH_FED_REPS was set
+        # explicitly): the forced legs above carry the median-based
+        # comparison; this leg's job is the default path + probe
+        # evidence, and 3 more smoke spins would push the fallback past
+        # a driver's bench budget for no added signal. A single-spin
+        # auto is excluded from the headline max below — one lucky
+        # un-medianed spin must not become the published value.
+        auto_full_reps = bool(on_tpu or
+                              os.environ.get("TFOS_BENCH_FED_REPS"))
+        fed_auto = _fed_median("auto",
+                               reps=None if auto_full_reps else 1)
 
     # The device-only spin has no engine timeouts around it: a tunnel
     # that dies mid-run (observed round 5 — it served the fed runs then
@@ -449,8 +460,10 @@ def main():
                    if fed_enabled else
                    "resnet50_device_only_images_per_sec_per_chip") if on_tpu \
         else "tiny_resnet_cpu_smoke_images_per_sec"
-    best_fed = max((f for f in (fed_shm, fed_queue, fed_auto)
-                    if f is not None), default=0.0)
+    headline_legs = (fed_shm, fed_queue,
+                     fed_auto if auto_full_reps else None)
+    best_fed = max((f for f in headline_legs if f is not None),
+                   default=0.0)
     if fed_enabled and not best_fed:
         # Both transports broken must NOT masquerade as a healthy fed run.
         print(json.dumps({

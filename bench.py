@@ -27,6 +27,10 @@ Prints ONE JSON line. Fields:
 - ``transport_probe``  — that probe's evidence: per-transport MB/s rates
                          plus ``choice`` (the transport auto selected).
 - ``fed_frac_of_device`` — best fed / device_only.
+- ``feed_stages``      — per-transport, per-stage feed breakdown (mean
+                         ms per sample: ring/queue wait, decode, gather,
+                         device_put) so the fed/device gap is attributed
+                         to a stage instead of unexplained.
 - ``mfu``              — model FLOP utilization from XLA's compiled cost
                          analysis vs the chip's bf16 peak.
 
@@ -84,7 +88,11 @@ def _bench_map_fun(args, ctx):
         np.zeros((batch, image, image, 3), np.float32))
 
     feed = ctx.get_data_feed(input_mapping={"x": "x", "y": "y"})
-    batches = infeed.sharded_batches(feed.numpy_batches(batch), trainer.mesh)
+    # one StageTimers instance spans DataFeed (ring wait / decode /
+    # gather) and the prefetcher (device_put): the whole host-side feed
+    # cost of the run lands in feed.stats()["stages"]
+    batches = infeed.sharded_batches(feed.numpy_batches(batch), trainer.mesh,
+                                     timers=feed.timers)
     it = iter(batches)
     state, metrics = trainer.step(state, next(it))  # uint8-sig compile
     float(jax.device_get(metrics["loss"]))
@@ -99,9 +107,14 @@ def _bench_map_fun(args, ctx):
     float(jax.device_get(metrics["loss"]))
     dt = time.monotonic() - t0
     n_dev = len(jax.devices())
+    stats = feed.stats()
     result = {"images_per_sec": images / dt / n_dev if images else 0.0,
               "images": images, "n_devices": n_dev,
-              "feed_stats": feed.stats()}
+              "feed_stats": stats,
+              # per-stage feed breakdown (seconds totals + mean ms per
+              # sample): where the host-side feed time actually went
+              "feed_stages": stats.get("stages"),
+              "feed_stages_ms": feed.timers.per_ms()}
     try:
         # measured-at-bootstrap transport selection evidence — rates from
         # the auto-probe kv plus the decision itself ("feed_transport" is
@@ -132,6 +145,12 @@ def _synth_partition(n_records, image, seed):
 #: transport-selection evidence from the latest auto-mode fed run (the
 #: node bootstrap's measured probe, via the trainer's broker kv read)
 _LAST_TRANSPORT_PROBE = {}
+
+#: per-transport feed-stage breakdown from the latest fed run of each
+#: transport (ring/queue wait, decode, gather, device_put — mean ms per
+#: sample), so the artifact attributes the fed/device gap to a stage
+#: instead of leaving it unexplained (VERDICT r5 #5)
+_LAST_FEED_STAGES = {}
 
 
 def _cluster_fed_images_per_sec(transport, batch, image, steps, on_tpu):
@@ -174,6 +193,8 @@ def _cluster_fed_images_per_sec(transport, batch, image, steps, on_tpu):
         if result.get("transport_probe"):
             _LAST_TRANSPORT_PROBE.clear()
             _LAST_TRANSPORT_PROBE.update(result["transport_probe"])
+        if result.get("feed_stages_ms"):
+            _LAST_FEED_STAGES[transport] = result["feed_stages_ms"]
         if os.environ.get("TFOS_BENCH_VERBOSE"):
             print("cluster_fed[{}]: {}".format(transport, result),
                   file=sys.stderr)
@@ -496,6 +517,10 @@ def main():
         "cluster_fed_queue": round(fed_queue, 2) if fed_queue else None,
         "cluster_fed_auto": round(fed_auto, 2) if fed_auto else None,
         "transport_probe": _LAST_TRANSPORT_PROBE or None,
+        # mean ms per sample, per stage, per transport (ring/queue wait /
+        # decode / gather / device_put) — attributes whatever gap
+        # fed_frac_of_device shows to a concrete stage
+        "feed_stages": _LAST_FEED_STAGES or None,
         "fed_frac_of_device": round(best_fed / device_only, 3)
         if device_only and best_fed else None,
         # like-regimes only (VERDICT r4 weak #6): the round-2 fed bar is

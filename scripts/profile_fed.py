@@ -24,7 +24,12 @@ Usage (CPU, hermetic — same platform pinning as tests/conftest.py):
 
 Stage legend: read = next_batch (transport read + decode + combine),
 put = jax.device_put, dispatch = trainer.step call returning,
-sync = device_get of the loss.
+sync = device_get of the loss. Every mode additionally prints the
+DataFeed-internal per-stage breakdown (``feed stages``, mean ms per
+sample: ring_wait/queue_wait, decode, gather — plus device_put in
+prefetch mode, where the staging thread's puts share the feed's
+StageTimers) — the same attribution bench.py publishes as
+``feed_stages``.
 """
 
 import argparse
@@ -128,8 +133,9 @@ def run_transport_only(transport, args):
         ring.unlink()
         ring.close()
     print("[%s/transport-only] %.0f img/s consumer side (%.2fs, "
-          "feedwait=%.3fs)" % (transport, images / dt, dt,
-                               feed.stats()["wait_s"]), flush=True)
+          "feedwait=%.3fs)  feed stages/sample(ms): %s"
+          % (transport, images / dt, dt, feed.stats()["wait_s"],
+             feed.timers.per_ms()), flush=True)
     return images / dt
 
 
@@ -198,7 +204,8 @@ def run_mode(transport, mode, args):
             dt = time.monotonic() - t_start
         else:  # prefetch — bench.py's actual shape
             batches = infeed.sharded_batches(feed.numpy_batches(args.batch),
-                                             trainer.mesh)
+                                             trainer.mesh,
+                                             timers=feed.timers)
             it = iter(batches)
             state, metrics = trainer.step(state, next(it))
             float(jax.device_get(metrics["loss"]))
@@ -221,11 +228,11 @@ def run_mode(transport, mode, args):
 
     rate = images / dt if images else 0.0
     print("[%s/%s] %.0f img/s  (%.2fs total)  stages/step(ms): %s  "
-          "feedwait=%.3fs"
+          "feedwait=%.3fs  feed stages/sample(ms): %s"
           % (transport, mode, rate, dt,
              {k: round(v / max(args.steps, 1) * 1000, 1)
               for k, v in T.items()},
-             feed.stats()["wait_s"]), flush=True)
+             feed.stats()["wait_s"], feed.timers.per_ms()), flush=True)
     return rate
 
 

@@ -87,6 +87,28 @@ class Checkpointer(object):
             self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=chief))
+        # Skip-decision bookkeeping (ADVICE r5): the already-persisted
+        # guard in save() must be PROVABLY CONSISTENT across processes —
+        # under jax.distributed, orbax's save is a collective, so if one
+        # process skips while a sibling enters, the sibling hangs at the
+        # barrier forever. A live all_steps() scan per call is not
+        # consistent: a racing async commit can make processes disagree
+        # mid-run. So the decision derives only from (a) this snapshot,
+        # taken once before this run issues any saves (every process
+        # sees the same settled disk state at construction), and (b) the
+        # steps THIS instance saved — both identical across processes
+        # that make the same save() calls, which the collective contract
+        # already requires. Boundary of the guarantee: the snapshot
+        # assumes disk is SETTLED at construction, i.e. no other
+        # incarnation's async commit is landing while processes
+        # construct. The framework's restart story satisfies this (a
+        # resubmitted job's previous savers are dead before the
+        # reservation barrier forms and trainers build checkpointers);
+        # an external writer racing construction is outside the
+        # contract and surfaces as StepAlreadyExistsError, not a hang.
+        self._steps_on_disk = frozenset(
+            int(s) for s in self._mgr.all_steps())
+        self._saved_steps = set()
 
     def save(self, step, state, force=False):
         """Commit ``state`` at ``step``; returns True if this process saved.
@@ -94,7 +116,12 @@ class Checkpointer(object):
         An already-persisted step is never overwritten: the call
         returns False (``force`` governs orbax's save-interval policy,
         not step replacement — orbax itself raises on an existing step
-        even with force). To genuinely replace a step, delete it first.
+        even with force). "Already persisted" means on disk when this
+        Checkpointer was constructed, or saved through this instance —
+        a deliberately process-consistent definition (see __init__); a
+        step landed mid-run by an unrelated writer surfaces as orbax's
+        StepAlreadyExistsError instead of a silent skip. To genuinely
+        replace a step, delete it first.
 
         Replicated state: chief commits, everyone else no-ops. Sharded
         state: every process participates (orbax coordinates the
@@ -123,15 +150,23 @@ class Checkpointer(object):
                 "restore would return garbage. Sharded states need either "
                 "all processes saving under jax.distributed, or "
                 "chief=True in the single-process case.")
-        if int(step) in self._mgr.all_steps():
+        step = int(step)
+        if step in self._saved_steps or step in self._steps_on_disk:
             # Already persisted (e.g. a periodic hook fired on the final
             # step and the epilogue force-saves the same step): a no-op,
             # not orbax's StepAlreadyExistsError — the caller's intent
-            # ("step N must be on disk") is satisfied either way.
+            # ("step N must be on disk") is satisfied either way. The
+            # decision uses only locally tracked saves + the init-time
+            # disk snapshot (never a live all_steps() scan), so every
+            # process in a collective save skips or enters IDENTICALLY —
+            # a racing async commit can no longer strand some processes
+            # at orbax's barrier while others return False.
             return False
         state = jax.tree.map(lambda x: x, state)  # shallow copy
-        saved = self._mgr.save(int(step), args=ocp.args.StandardSave(state),
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
                                force=force)
+        if saved:
+            self._saved_steps.add(step)
         return bool(saved)
 
     def latest_step(self):

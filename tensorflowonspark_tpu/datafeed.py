@@ -20,33 +20,111 @@ TPU-native differences:
 - ``numpy_batches()`` is an infinite-batch generator suitable for wrapping
   in a prefetching infeed (see infeed.py) — the analog of the reference's
   ``tf.data.Dataset.from_generator(DataFeed...)`` idiom.
+
+Zero-copy ring consume path (the small-batch feed-gap fix): when the
+node's shm ring is active, chunks are decoded as views INTO the ring
+mapping (``ShmRing.read_view``) instead of being memcpy'd out
+(``read_obj``), and the mapped batch is assembled with a single gather
+per column into a reusable staging buffer; the ring slot is released
+only after that copy. This kills both fixed copies the old path paid
+per chunk (the read-side materialize AND the ``frames.concat`` in
+``_combine``). Contract: with staging reuse on (the default), a mapped
+columnar batch is valid until the NEXT ``next_batch`` call — consumers
+that hold batches longer must copy (``np.array``). Every framework
+consumer (``infeed.sharded_batches``'s per-shard device_put,
+``pad_to_batch``'s ``np.resize``) already copies within that window.
+``TFOS_FEED_STAGING=0`` restores per-batch ownership (fresh buffer per
+batch, still single-gather); ``TFOS_FEED_ZERO_COPY=0`` restores the
+copying ``read_obj`` consume path entirely.
 """
 
 import logging
+import os
 import time
 
 import numpy as np
 
-from tensorflowonspark_tpu.frames import ColumnarChunk, concat
+from tensorflowonspark_tpu import frames as frames_lib
+from tensorflowonspark_tpu import tracing
+from tensorflowonspark_tpu.frames import ColumnarChunk
 from tensorflowonspark_tpu.marker import EndFeed, EndPartition, Marker
 
 logger = logging.getLogger(__name__)
 
 
+class _RingSlot(object):
+    """Shared ownership of one zero-copy ring message.
+
+    Decoded column arrays alias the ring mapping until the release runs;
+    the release fires exactly once, after every aliasing row has been
+    copied out (gathered into a staging batch or materialized into
+    rows). Chunk slices and coalesced multi-frame siblings share one
+    slot, so the row countdown spans all of them.
+    """
+
+    __slots__ = ("_release", "_remaining")
+
+    def __init__(self, release, rows):
+        self._release = release
+        self._remaining = rows
+
+    def consume(self, rows):
+        """``rows`` more aliasing rows were copied out; release at zero."""
+        self._remaining -= rows
+        if self._remaining <= 0:
+            self.drop()
+
+    def drop(self):
+        """Unconditional release (terminate/abort paths). Idempotent."""
+        if self._release is not None:
+            release, self._release = self._release, None
+            release()
+
+
+class _RingSegment(object):
+    """A ColumnarChunk whose columns are views into the shm ring, plus
+    the slot bookkeeping that keeps the producer away until consumed."""
+
+    __slots__ = ("chunk", "slot")
+
+    def __init__(self, chunk, slot):
+        self.chunk = chunk
+        self.slot = slot
+
+
 def _seg_len(seg):
+    if isinstance(seg, _RingSegment):
+        return len(seg.chunk)
     return len(seg)
 
 
 def _seg_slice(seg, start, stop):
+    if isinstance(seg, _RingSegment):
+        return _RingSegment(seg.chunk.slice(start, stop), seg.slot)
     if isinstance(seg, ColumnarChunk):
         return seg.slice(start, stop)
     return seg[start:stop]
 
 
 def _seg_rows(seg):
+    if isinstance(seg, _RingSegment):
+        # row extraction outlives the slot: copy out, then release
+        seg.chunk.materialize()
+        seg.slot.consume(len(seg.chunk))
+        return seg.chunk.records()
     if isinstance(seg, ColumnarChunk):
         return seg.records()
     return list(seg)
+
+
+def _unpin_segments(segs):
+    """Copy consumed ring segments out of the mapping and free their
+    slots, in place (each becomes a plain owned ColumnarChunk)."""
+    for i, seg in enumerate(segs):
+        if isinstance(seg, _RingSegment):
+            seg.chunk.materialize()
+            seg.slot.consume(len(seg.chunk))
+            segs[i] = seg.chunk
 
 
 class DataFeed(object):
@@ -83,10 +161,19 @@ class DataFeed(object):
             self._ring = shm.ShmRing.open(ring_name)
         self._queue_in = None if self._ring else mgr.get_queue(qname_in)
         self._queue_out = None if train_mode else mgr.get_queue(qname_out)
-        self._pending = []  # segments: ColumnarChunk | list of records
+        self._pending = []  # segments: ColumnarChunk | _RingSegment | list
+        self._backlog = []  # items decoded ahead from a coalesced frame
+        # Zero-copy consume path knobs (module docstring): both default on.
+        self._zero_copy = os.environ.get("TFOS_FEED_ZERO_COPY", "1") == "1"
+        self._staging_reuse = os.environ.get("TFOS_FEED_STAGING", "1") == "1"
+        self._staging = {}  # per-output-column reusable gather buffers
         # feed-plane visibility the reference lacked (SURVEY.md §5
-        # tracing): how long the consumer sat blocked on the queue.
-        self._stats = {"records": 0, "chunks": 0, "wait_s": 0.0}
+        # tracing): how long the consumer sat blocked on the queue, plus
+        # the per-stage breakdown (ring wait / decode / gather; the
+        # prefetcher adds device_put into the same instance).
+        self.timers = tracing.StageTimers()
+        self._stats = {"records": 0, "chunks": 0, "wait_s": 0.0,
+                       "staging_alloc": 0, "staging_reuse": 0}
         # Progress heartbeat: a throttled batches-served counter in the
         # broker kv. node.shutdown() re-arms its termination grace while
         # this advances, so a trainer legitimately stepping through a deep
@@ -130,6 +217,22 @@ class DataFeed(object):
                 continue
             if self.done_feeding:
                 break
+            if not self._backlog:
+                # About to read the transport while this batch spans
+                # messages: release the already-consumed segments' ring
+                # slots first (copy out + free). Load-bearing twice
+                # over. (1) Correctness: ring.read_view's sequential-
+                # consumption contract — the read position is the tail,
+                # which only release advances, so reading again with a
+                # slot still held would re-deliver the SAME message
+                # (duplicated records, then a desynced stream when both
+                # slots release). (2) Liveness: a held slot pins bytes
+                # the producer may need to send the very data we would
+                # block waiting for. Costs one extra copy ONLY for
+                # message-spanning batches; the batch-within-one-message
+                # steady state never gets here with ring segments in
+                # hand and stays zero-copy.
+                _unpin_segments(segs)
             t0 = time.monotonic()
             item = self._next_item()
             self._stats["wait_s"] += time.monotonic() - t0
@@ -142,7 +245,7 @@ class DataFeed(object):
                 if isinstance(item, EndFeed):
                     break
                 continue  # EndPartition with empty batch: keep reading
-            if isinstance(item, ColumnarChunk):
+            if isinstance(item, (ColumnarChunk, _RingSegment)):
                 seg = item
             else:
                 seg = item if isinstance(item, list) else [item]
@@ -179,20 +282,80 @@ class DataFeed(object):
                 rows.extend(_seg_rows(seg))
             return rows
         cols_only = segs and all(
-            isinstance(s, ColumnarChunk) for s in segs)
+            isinstance(s, (ColumnarChunk, _RingSegment)) for s in segs)
         if cols_only:
-            ch = concat(segs)
-            if ch.names is not None:
-                fields = list(self.input_mapping.keys())
-                cols = [ch.cols[ch.names.index(f)] for f in fields]
-            else:
-                cols = ch.cols
-            return {name: col
-                    for name, col in zip(self.input_tensors, cols)}
+            with self.timers.timed("gather"):
+                return self._gather_columns(segs)
         rows = []
         for seg in segs:
             rows.extend(_seg_rows(seg))
         return self._stack_columns(rows)
+
+    def _gather_columns(self, segs):
+        """Mapped columnar batch with AT MOST one copy per column.
+
+        One owned chunk (queue-transport steady state): its column views
+        pass through untouched — zero copy, as before. Anything else —
+        ring-backed views (which must not outlive their slot) or
+        multi-segment batches (which previously paid a ``frames.concat``
+        allocation+copy on top of the read-side materialize) — gathers
+        each column straight into a staging buffer, then releases the
+        ring slots. The staging buffer is reused across batches whenever
+        rows/trailing-shape/dtype repeat (the steady state), so the
+        gather lands on already-faulted pages with zero per-batch
+        allocation; see the module docstring for the validity contract
+        this implies.
+        """
+        chunks = [s.chunk if isinstance(s, _RingSegment) else s
+                  for s in segs]
+        first = chunks[0]
+        if first.names is not None:
+            fields = list(self.input_mapping.keys())
+
+            def col(chunk, j):
+                return chunk.cols[chunk.names.index(fields[j])]
+        else:
+            def col(chunk, j):
+                return chunk.cols[j]
+
+        if len(segs) == 1 and isinstance(segs[0], ColumnarChunk):
+            return {name: col(first, j)
+                    for j, name in enumerate(self.input_tensors)}
+        total = sum(len(c) for c in chunks)
+        out = {}
+        for j, name in enumerate(self.input_tensors):
+            srcs = [col(c, j) for c in chunks]
+            if len({(s.dtype, s.shape[1:]) for s in srcs}) > 1:
+                # heterogeneous segments (mixed feeds): numpy's upcasting
+                # concat is the only correct assembly — and it copies, so
+                # the slot release below stays safe
+                out[name] = np.concatenate(srcs)
+                continue
+            dst = self._staging_buffer(name, total, srcs[0])
+            pos = 0
+            for s in srcs:
+                n = s.shape[0]
+                dst[pos:pos + n] = s  # the single gather memcpy
+                pos += n
+            out[name] = dst[:total]
+        for s in segs:
+            if isinstance(s, _RingSegment):
+                s.slot.consume(len(s.chunk))
+        return out
+
+    def _staging_buffer(self, name, rows, like):
+        """Reusable gather destination for output column ``name``."""
+        buf = self._staging.get(name) if self._staging_reuse else None
+        if (buf is not None and buf.dtype == like.dtype
+                and buf.shape[1:] == like.shape[1:]
+                and buf.shape[0] >= rows):
+            self._stats["staging_reuse"] += 1
+            return buf
+        buf = np.empty((rows,) + like.shape[1:], like.dtype)
+        if self._staging_reuse:
+            self._staging[name] = buf
+        self._stats["staging_alloc"] += 1
+        return buf
 
     def _next_item(self):
         """Blocking read of the next feed item (chunk or Marker).
@@ -206,15 +369,30 @@ class DataFeed(object):
         this consumer on an empty feed until the shutdown timeout.
         """
         import queue as _queue
+        if self._backlog:
+            # items decoded ahead of time from a coalesced multi-frame
+            return self._backlog.pop(0)
         idle_terminating = 0
+        # One wait sample per DELIVERED item, spanning however many empty
+        # 5s polls preceded it — so timers.per_ms() reads as per-item
+        # wait, not a per-poll mean diluted (or inflated) by idle polls.
+        t_wait = time.monotonic()
         while True:
             if self._ring is not None:
-                obj = self._ring.read_obj(timeout=5.0)
-                if obj is not None:
-                    return obj
+                view, release = self._ring.read_view(timeout=5.0)
+                if view is not None:
+                    self.timers.add("ring_wait", time.monotonic() - t_wait)
+                    items = self._decode_message(view, release)
+                    if items:  # empty multi-frame: nothing to deliver
+                        self._backlog.extend(items[1:])
+                        return items[0]
+                    t_wait = time.monotonic()
             else:
                 try:
-                    return self._queue_in.get(block=True, timeout=5.0)
+                    item = self._queue_in.get(block=True, timeout=5.0)
+                    self.timers.add("queue_wait",
+                                    time.monotonic() - t_wait)
+                    return item
                 except _queue.Empty:
                     pass
             state = self.mgr.get("state")
@@ -227,6 +405,45 @@ class DataFeed(object):
                     raise RuntimeError(
                         "feed aborted: node is terminating and no "
                         "end-of-feed marker arrived")
+
+    def _decode_message(self, view, release):
+        """One ring message → list of feed items (≥1 for coalesced
+        multi-frames).
+
+        Columnar payloads stay ZERO-COPY views into the ring mapping,
+        wrapped in :class:`_RingSegment` with the slot bookkeeping that
+        defers ``release`` until every aliased row has been copied out —
+        which is also why blocking in ``_next_item`` can never deadlock
+        against a producer blocked on ring space: this is only reached
+        with ``_pending``/``_backlog`` empty AND the current batch's
+        consumed segments unpinned (``_unpin_segments`` in next_batch),
+        i.e. with no slots held by this consumer.
+        """
+        t0 = time.monotonic()
+        try:
+            obj = frames_lib.decode(view)
+        except BaseException:
+            release()  # never strand the producer on a corrupt frame
+            raise
+        objs = list(obj) if isinstance(obj, frames_lib.FrameList) else [obj]
+        rows = sum(len(o) for o in objs if isinstance(o, ColumnarChunk))
+        if rows and self._zero_copy:
+            slot = _RingSlot(release, rows)
+            items = [_RingSegment(o, slot)
+                     if isinstance(o, ColumnarChunk) and len(o)
+                     else (o.materialize() if isinstance(o, ColumnarChunk)
+                           else o)
+                     for o in objs]
+        else:
+            # marker-only messages, legacy object frames, or zero-copy
+            # disabled: copy out and free the slot immediately
+            for o in objs:
+                if isinstance(o, ColumnarChunk):
+                    o.materialize()
+            release()
+            items = objs
+        self.timers.add("decode", time.monotonic() - t0)
+        return items
 
     def _item_done(self):
         if self._queue_in is not None:
@@ -248,8 +465,11 @@ class DataFeed(object):
     def numpy_batches(self, batch_size, pad_to_batch=False):
         """Generator of non-empty batches until end-of-feed.
 
-        The TPU-idiomatic consumption loop: wrap in ``infeed.prefetch`` to
-        overlap host->HBM transfer with the device step.
+        The TPU-idiomatic consumption loop: wrap in
+        ``infeed.sharded_batches`` (or ``infeed.prefetch`` with a
+        device_put that COPIES — see the staging-buffer caveat in
+        ``infeed.prefetch``'s docstring) to overlap host->HBM transfer
+        with the device step.
 
         ``pad_to_batch=True`` repeats a short batch's own records
         (modularly — partition tails can be smaller than half a batch)
@@ -278,12 +498,15 @@ class DataFeed(object):
             yield batch
 
     def stats(self):
-        """{records, chunks, wait_s}: consumer-side feed-plane counters."""
-        return dict(self._stats)
+        """Consumer-side feed-plane counters: {records, chunks, wait_s,
+        staging_alloc, staging_reuse, stages: {stage: seconds}}."""
+        out = dict(self._stats)
+        out["stages"] = self.timers.snapshot()
+        return out
 
     def should_stop(self):
         """True once the feed has ended (reference: ``DataFeed.should_stop``)."""
-        return self.done_feeding and not self._pending
+        return self.done_feeding and not self._pending and not self._backlog
 
     def batch_results(self, results):
         """Push a batch of inference results to the output queue.
@@ -305,6 +528,14 @@ class DataFeed(object):
         logger.info("DataFeed terminating: draining input feed")
         self.mgr.set("state", "terminating")
         self.done_feeding = True
+        # Free any zero-copy slots first: draining reads the ring at the
+        # tail, which the held slots pin — and a terminated feed will
+        # never gather them out.
+        for seg in self._pending + self._backlog:
+            if isinstance(seg, _RingSegment):
+                seg.slot.drop()
+        self._pending = []
+        self._backlog = []
         import queue as _queue
         count = 0
         if self._ring is not None:

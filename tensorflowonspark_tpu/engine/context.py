@@ -479,11 +479,46 @@ class Context(object):
             # authkey, driver.info) has served its purpose — don't litter
             # the caller's cwd with one dir per run. Any failure above
             # keeps it: executor.log is the post-mortem.
-            import shutil
-            shutil.rmtree(self.work_root, ignore_errors=True)
+            self._remove_engine_artifacts()
         elif self._saw_failure:
             logger.info("keeping work root %s (failures this session)",
                         self.work_root)
+
+    def _remove_engine_artifacts(self):
+        """Remove only what the engine itself created under work_root.
+
+        Executors ``os.chdir`` into ``work_root/executor-N``, so user
+        task files written with relative paths (without
+        ``ctx.absolute_path``) land there — an ``shutil.rmtree`` of the
+        whole root on a clean run silently destroyed them. The engine's
+        own artifacts are precisely enumerable (authkey, driver.info,
+        each executor's executor.log + persisted executor_id), so remove
+        exactly those; directories are removed only once empty, and a
+        root still holding user files survives intact.
+        """
+        from tensorflowonspark_tpu.util import EXECUTOR_ID_FILE
+        for name in ("authkey", "driver.info"):
+            try:
+                os.unlink(os.path.join(self.work_root, name))
+            except OSError:
+                pass
+        for i in range(self.num_executors):
+            exec_dir = os.path.join(self.work_root, "executor-%d" % i)
+            for name in ("executor.log", EXECUTOR_ID_FILE):
+                try:
+                    os.unlink(os.path.join(exec_dir, name))
+                except OSError:
+                    pass
+            try:
+                os.rmdir(exec_dir)
+            except OSError:
+                pass  # user files present (or already gone): keep
+        try:
+            os.rmdir(self.work_root)
+        except OSError:
+            if os.path.isdir(self.work_root):
+                logger.info("keeping work root %s (user task files present)",
+                            self.work_root)
 
     def __enter__(self):
         return self

@@ -143,6 +143,21 @@ class SparkRDDAdapter(object):
         # remaining tasks, so no task may ever raise — each partition
         # catches its own error and returns it as data; the collected
         # errors re-raise on the driver after all partitions ran.
+        #
+        # Deliberate no-retry tradeoff: returning the error as data also
+        # OPTS OUT of Spark's native task retry, so a transiently
+        # failing cleanup partition runs exactly once — less delivery
+        # assurance than Spark's default for transient faults. In-task
+        # retries cannot fix this safely: the partition iterator cannot
+        # be rewound (a replay would feed a truncated partition), a
+        # "consumed nothing yet" guard races fns that hand the iterator
+        # to a background thread (node._inference's feeder — a zombie
+        # feeder from attempt 1 can steal records from attempt 2 or
+        # trip 'generator already executing'), and the framework's own
+        # fail_fast=False task (node.shutdown) drains its iterator as
+        # its first statement so it could never qualify anyway. Callers
+        # needing stronger cleanup delivery should make the cleanup
+        # idempotent and resubmit the job.
         def run_catching(it, _f=f):
             try:
                 _f(it)

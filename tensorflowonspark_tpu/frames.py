@@ -15,9 +15,16 @@ and the frame codec moves them as raw bytes:
 - :func:`encode` — object → list of buffers ``[u32 hdrlen][hdr pickle]
   [col bytes]...``; column payloads are raw array memory, never pickled.
   Arbitrary objects (markers, legacy record lists) embed in the header.
+- :func:`encode_multi` — several objects → ONE frame (one transport
+  message). The feeder coalesces tiny chunks and trailing markers this
+  way so per-message fixed costs (header pickle, ring wakeup, slot
+  bookkeeping) amortize across them — the small-batch regime pays those
+  costs per chunk where the bulk regime amortizes them per 38MB frame.
 - :func:`decode` — memoryview → object; column arrays come back as
   ZERO-COPY views into the source buffer (callers that outlive the
-  buffer must ``.materialize()``).
+  buffer must ``.materialize()``). Multi-object frames decode to a
+  :class:`FrameList` (so a frame carrying a pickled *record list* stays
+  distinguishable from a frame carrying several objects).
 
 Used by the shm ring transport (shm.py) where the buffers land in the
 mmap with a single gather-memcpy; the manager-queue transport pickles
@@ -31,6 +38,18 @@ import struct
 import numpy as np
 
 _LEN = struct.Struct("<I")
+
+
+class FrameList(list):
+    """``decode()`` result for a multi-object frame (``encode_multi``).
+
+    A plain ``list`` would be ambiguous: legacy record-list chunks also
+    travel as one pickled list inside an object frame, and consumers
+    (DataFeed) treat those as a single segment of records. The subclass
+    marks "these are SEPARATE feed items sharing one transport message".
+    """
+
+    __slots__ = ()
 
 
 class ColumnarChunk(object):
@@ -123,33 +142,22 @@ def concat(chunks):
     return ColumnarChunk(cols, names)
 
 
-def encode(obj):
-    """object → list of byte-like buffers forming one frame."""
+def _part_meta(obj, payloads):
+    """Header entry for one object; column payload buffers append to
+    ``payloads``."""
     if isinstance(obj, ColumnarChunk):
         cols = [np.ascontiguousarray(c) for c in obj.cols]
-        hdr = pickle.dumps({
-            "k": "cols",
-            "names": obj.names,
-            "scalar": obj.scalar,
-            "meta": [(c.dtype.str, c.shape) for c in cols],
-        }, protocol=5)
-        return [_LEN.pack(len(hdr)), hdr] + [memoryview(c).cast("B")
-                                             for c in cols]
-    hdr = pickle.dumps({"k": "obj", "obj": obj}, protocol=5)
-    return [_LEN.pack(len(hdr)), hdr]
+        payloads.extend(memoryview(c).cast("B") for c in cols)
+        return {"k": "cols", "names": obj.names, "scalar": obj.scalar,
+                "meta": [(c.dtype.str, c.shape) for c in cols]}
+    return {"k": "obj", "obj": obj}
 
 
-def decode(view):
-    """One frame (memoryview/bytes) → object.
-
-    ColumnarChunk columns are zero-copy views into ``view``.
-    """
-    view = memoryview(view)
-    (hdrlen,) = _LEN.unpack_from(view, 0)
-    hdr = pickle.loads(view[4:4 + hdrlen])
+def _decode_part(hdr, view, off):
+    """One header entry → (object, next payload offset). Column arrays are
+    zero-copy views into ``view``."""
     if hdr["k"] == "obj":
-        return hdr["obj"]
-    off = 4 + hdrlen
+        return hdr["obj"], off
     cols = []
     for dtype_str, shape in hdr["meta"]:
         dt = np.dtype(dtype_str)
@@ -157,4 +165,44 @@ def decode(view):
         arr = np.frombuffer(view, dtype=dt, count=n, offset=off)
         cols.append(arr.reshape(shape))
         off += n * dt.itemsize
-    return ColumnarChunk(cols, hdr["names"], hdr.get("scalar", False))
+    return ColumnarChunk(cols, hdr["names"], hdr.get("scalar", False)), off
+
+
+def encode(obj):
+    """object → list of byte-like buffers forming one frame."""
+    payloads = []
+    hdr = pickle.dumps(_part_meta(obj, payloads), protocol=5)
+    return [_LEN.pack(len(hdr)), hdr] + payloads
+
+
+def encode_multi(objs):
+    """Several objects → ONE frame (one transport message).
+
+    Column payloads of every ColumnarChunk ride as raw bytes after a
+    single pickled header describing all parts, so N tiny objects cost
+    one message's fixed overhead instead of N. ``decode`` returns them
+    as a :class:`FrameList` in order.
+    """
+    payloads = []
+    parts = [_part_meta(obj, payloads) for obj in objs]
+    hdr = pickle.dumps({"k": "multi", "parts": parts}, protocol=5)
+    return [_LEN.pack(len(hdr)), hdr] + payloads
+
+
+def decode(view):
+    """One frame (memoryview/bytes) → object (or FrameList for multi).
+
+    ColumnarChunk columns are zero-copy views into ``view``.
+    """
+    view = memoryview(view)
+    (hdrlen,) = _LEN.unpack_from(view, 0)
+    hdr = pickle.loads(view[4:4 + hdrlen])
+    off = 4 + hdrlen
+    if hdr["k"] == "multi":
+        out = FrameList()
+        for part in hdr["parts"]:
+            obj, off = _decode_part(part, view, off)
+            out.append(obj)
+        return out
+    obj, _ = _decode_part(hdr, view, off)
+    return obj

@@ -20,17 +20,34 @@ Two layers:
 
 import queue as _queue
 import threading
+import time
 
 _END = object()
 
 
-def prefetch(batch_iter, size=2, device_put=None):
+def prefetch(batch_iter, size=2, device_put=None, timers=None):
     """Iterate ``batch_iter`` with ``size`` batches staged ahead.
 
     ``device_put``: callable applied to each batch on the staging thread
     (default ``jax.device_put`` — leaves layout to JAX). The generator
     yields staged batches in order. Exceptions on the staging thread
     re-raise at the consuming ``next()``.
+
+    ``timers``: optional :class:`tracing.StageTimers`; each batch's
+    host→device transfer dispatch lands in its ``device_put`` stage.
+    Pass the consuming DataFeed's ``.timers`` so the whole feed-plane
+    breakdown (ring wait / decode / gather / device_put) shares one
+    snapshot — ``feed.stats()["stages"]`` then attributes every host-
+    side millisecond of the fed path.
+
+    Staging-buffer caveat: DataFeed's mapped columnar batches are
+    REUSED buffers (valid until its next ``next_batch``). The default
+    ``jax.device_put`` can ZERO-COPY alias an aligned numpy array on
+    the CPU backend, so feeding DataFeed batches through this plain
+    prefetch on CPU can alias staged arrays to memory the feed will
+    overwrite. Use :func:`sharded_batches` (its per-shard puts copy —
+    the canonical consumption everywhere in this framework), pass a
+    copying ``device_put``, or set ``TFOS_FEED_STAGING=0`` on the feed.
 
     Closing the generator early (break, ``inference terminate()``, an
     error in the consumer) cancels and joins the staging thread — a bare
@@ -56,7 +73,11 @@ def prefetch(batch_iter, size=2, device_put=None):
     def _stage():
         try:
             for batch in batch_iter:
-                if stop.is_set() or not _put(jax.tree.map(put, batch)):
+                t0 = time.monotonic()
+                staged = jax.tree.map(put, batch)
+                if timers is not None:
+                    timers.add("device_put", time.monotonic() - t0)
+                if stop.is_set() or not _put(staged):
                     return
             _put(_END)
         except BaseException as e:  # noqa: BLE001 - re-raised at next()
@@ -83,19 +104,30 @@ def prefetch(batch_iter, size=2, device_put=None):
         t.join(timeout=5.0)
 
 
-def sharded_batches(batch_iter, mesh, axis="data", size=2):
+def sharded_batches(batch_iter, mesh, axis="data", size=2, timers=None):
     """Prefetch + shard: yield batches laid out over ``mesh``'s data axis.
 
     Each array's leading dim is split across ``axis`` (must divide it);
     everything arrives as committed global arrays, so a pjit-ed step with
-    matching in_shardings runs without any implicit resharding.
+    matching in_shardings runs without any implicit resharding. A SPLIT
+    axis's per-shard ``device_put`` copies out of the host batch (each
+    shard is a slice), so DataFeed's reusable staging buffers are safe
+    to hand straight in here; a 1-device axis's "shard" is the whole
+    array, which ``jax.device_put`` can ZERO-COPY alias on the CPU
+    backend (measured) — there the copy is forced explicitly, or
+    prefetched-but-unconsumed batches would be silently overwritten by
+    the feed's next gather. ``timers`` forwards to :func:`prefetch`.
     """
     import jax
+    import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec
 
     sharding = NamedSharding(mesh, PartitionSpec(axis))
+    n_shards = int(mesh.shape[axis])
 
     def put(x):
+        if n_shards == 1 and isinstance(x, np.ndarray):
+            x = np.array(x, copy=True)
         return jax.device_put(x, sharding)
 
-    return prefetch(batch_iter, size=size, device_put=put)
+    return prefetch(batch_iter, size=size, device_put=put, timers=timers)

@@ -25,6 +25,10 @@ TPU-native differences from the reference:
 - **Chunked feed.** Feed tasks batch records into chunks before the queue
   ``put`` — the reference's per-record manager-proxy round trip is its
   documented bottleneck (SURVEY.md §3.2 hot loop) and is not reproduced.
+  Chunks are size-targeted (FEED_FRAME_BYTES) so tiny records coalesce
+  into full frames, and on the ring a partition's tail chunk rides one
+  message with its EndPartition marker — the per-message fixed costs the
+  small-batch regime otherwise pays per chunk.
 """
 
 import logging
@@ -41,9 +45,26 @@ from tensorflowonspark_tpu.datafeed import DataFeed
 
 logger = logging.getLogger(__name__)
 
-#: Chunk size for the feed plane: records per queue item. Tuned for
-#: pickling cost, not device batch size — DataFeed re-slices.
+#: Chunk size for the feed plane when record byte sizes are unknowable
+#: (object/ragged records): records per queue item, tuned for pickling
+#: cost, not device batch size — DataFeed re-slices. All-ndarray records
+#: get size-targeted chunks instead (FEED_FRAME_BYTES below).
 FEED_CHUNK = 256
+
+#: Byte target per transport frame for measurable (all-ndarray) records:
+#: tiny records coalesce into frames of about this size so per-message
+#: fixed costs (frame-header pickling, ring wakeups, slot bookkeeping)
+#: amortize across many records — the bulk regime gets that amortization
+#: for free from its ~38MB frames; the small-batch regime pays the fixed
+#: costs on every chunk unless the feeder packs more records per frame.
+#: Env-tunable: TFOS_FEED_FRAME_BYTES.
+FEED_FRAME_BYTES = 4 * 1024 * 1024
+
+#: Hard cap on records per chunk regardless of the byte target: bounds
+#: the feeder's stacking latency for minuscule records (an unbounded
+#: target would stall the trainer's first batch behind a whole-partition
+#: stack).
+FEED_CHUNK_MAX = 4096
 
 #: Per-executor node state, set by the bootstrap task and read by the
 #: feed/shutdown tasks that later run in the same executor process
@@ -629,6 +650,34 @@ def _feed_ring(qname):
     return None
 
 
+def _columnar_leaves(record):
+    """``record``'s field values iff the feed would columnarize it;
+    None otherwise. THE one gate shared by _pack_chunk (whether to
+    stack) and _chunk_limit (whether byte-targeted sizing applies) —
+    a drifted copy would size chunks for a packing that never happens.
+
+    Only records whose fields are numpy numeric values (arrays or 0-d
+    scalars — a ``(image, np.int64_label)`` tuple is the canonical feed
+    record and must not flunk this gate) qualify: python scalars /
+    strings / objects must round-trip with their exact types, and only
+    bulk array payloads benefit from raw-byte framing anyway.
+    """
+    import numpy as np
+
+    if isinstance(record, dict):
+        leaves = list(record.values())
+    elif isinstance(record, (tuple, list)):
+        leaves = list(record)
+    else:
+        leaves = [record]
+    if leaves and all(
+            isinstance(v, (np.ndarray, np.generic))
+            and v.dtype.kind in "biufc"
+            for v in leaves):
+        return leaves
+    return None
+
+
 def _pack_chunk(records):
     """Stack a chunk of records into a ColumnarChunk when possible.
 
@@ -638,28 +687,54 @@ def _pack_chunk(records):
     (ragged shapes, object/string payloads) fall back to the plain list
     chunk with identical semantics.
     """
-    import numpy as np
-
     from tensorflowonspark_tpu import frames as frames_lib
 
-    # Only records whose fields are real ndarrays get columnarized: python
-    # scalars / strings / objects must round-trip with their exact types,
-    # and only bulk array payloads benefit from raw-byte framing anyway.
-    first = records[0]
-    if isinstance(first, dict):
-        leaves = list(first.values())
-    elif isinstance(first, (tuple, list)):
-        leaves = list(first)
-    else:
-        leaves = [first]
-    if not leaves or not all(
-            isinstance(v, np.ndarray) and v.dtype.kind in "biufc"
-            for v in leaves):
+    if _columnar_leaves(records[0]) is None:
         return list(records)
     try:
         return frames_lib.ColumnarChunk.from_records(records)
     except Exception:  # noqa: BLE001 - ragged shapes etc → legacy path
         return list(records)
+
+
+def _pack_chunks(records):
+    """``records`` → list of feed items to enqueue.
+
+    Normally one item. The exception: a size-targeted accumulation
+    (``_chunk_limit``, up to FEED_CHUNK_MAX records, sized from the
+    FIRST record) whose later records turned out ragged/mixed falls
+    back to a pickled row list — unsplittable by the ring's oversize
+    path and a single giant pickle on the queue — so oversized fallback
+    lists re-split to the legacy FEED_CHUNK bound here.
+    """
+    packed = _pack_chunk(records)
+    if isinstance(packed, list) and len(packed) > FEED_CHUNK:
+        return [packed[i:i + FEED_CHUNK]
+                for i in range(0, len(packed), FEED_CHUNK)]
+    return [packed]
+
+
+def _chunk_limit(first_record):
+    """Records per chunk for this partition: size-targeted for records
+    the feed will columnarize (same gate as _pack_chunk — byte-sizing a
+    pickled-row chunk would 16x a path the frame target was never meant
+    to touch), FEED_CHUNK otherwise.
+
+    Never sized BELOW FEED_CHUNK — bulk-regime records (147KB images)
+    already hit multi-MB frames at 256 records and shrinking them would
+    regress the tuned path; the target only coalesces MORE records when
+    they are small.
+    """
+    leaves = _columnar_leaves(first_record)
+    if leaves is None:
+        return FEED_CHUNK
+    rec_bytes = sum(v.nbytes for v in leaves) or 1
+    try:
+        target = int(os.environ.get("TFOS_FEED_FRAME_BYTES", "") or
+                     FEED_FRAME_BYTES)
+    except ValueError:
+        target = FEED_FRAME_BYTES
+    return max(FEED_CHUNK, min(FEED_CHUNK_MAX, target // rec_bytes))
 
 
 def _feed_partition(iterator, mgr, qname, feed_timeout, cancel=None):
@@ -668,7 +743,17 @@ def _feed_partition(iterator, mgr, qname, feed_timeout, cancel=None):
     Transport is the shm ring when active (node bootstrap created it),
     else the manager queue. ``cancel`` (a ``threading.Event``) aborts the
     feed between chunks — set by a concurrent consumer that failed, so a
-    background feeder never outlives its task."""
+    background feeder never outlives its task.
+
+    Two per-message-cost amortizations for the small-batch regime:
+    chunks are size-targeted (``_chunk_limit`` — tiny records pack into
+    ~FEED_FRAME_BYTES frames instead of 256-record slivers), and on the
+    ring the partition's final chunk coalesces with its EndPartition
+    marker into ONE gather write (``frames.FrameList``) — for a
+    small partition that halves the message count outright. One chunk is
+    buffered (``prev``) to make the tail identifiable; backpressure
+    semantics are unchanged, the feeder just runs one chunk ahead.
+    """
     ring = _feed_ring(qname)
     q = None if ring is not None else mgr.get_queue(qname)
 
@@ -682,18 +767,40 @@ def _feed_partition(iterator, mgr, qname, feed_timeout, cancel=None):
 
     deadline = time.monotonic() + feed_timeout
     chunk = []
+    limit = None
+    prev = None
     count = 0
+
+    def emit(obj):
+        """Buffer one item; flush the previously buffered one."""
+        nonlocal prev, deadline
+        if prev is not None:
+            put(prev, deadline)
+            deadline = time.monotonic() + feed_timeout
+        prev = obj
+
     for item in iterator:
+        if limit is None:
+            limit = _chunk_limit(item)
         chunk.append(item)
-        if len(chunk) >= FEED_CHUNK:
-            put(_pack_chunk(chunk), deadline)
+        if len(chunk) >= limit:
+            for packed in _pack_chunks(chunk):
+                emit(packed)
             count += len(chunk)
             chunk = []
-            deadline = time.monotonic() + feed_timeout
     if chunk:
-        put(_pack_chunk(chunk), deadline)
+        for packed in _pack_chunks(chunk):
+            emit(packed)
         count += len(chunk)
-    put(marker.EndPartition(), deadline)
+    end = marker.EndPartition()
+    if prev is None:
+        put(end, deadline)
+    elif ring is not None:
+        from tensorflowonspark_tpu import frames as frames_lib
+        put(frames_lib.FrameList([prev, end]), deadline)
+    else:
+        put(prev, deadline)
+        put(end, deadline)
     return count
 
 
@@ -831,13 +938,16 @@ _RING_WRITE_LOCK = threading.Lock()
 def _ring_put(ring, obj, mgr, deadline, cancel=None):
     """shm-ring analog of _bounded_put: bounded writes + state checks.
 
-    Frame-encodes once; retries move no bytes until space frees. A frame
-    too large for the ring (> capacity/2) is split record-wise and
-    re-sent — semantics are unchanged since DataFeed re-slices chunks
-    anyway."""
+    Frame-encodes once; retries move no bytes until space frees. A
+    ``frames.FrameList`` coalesces several objects into one message
+    (gather write — the tail-coalescing path). A frame too large for the
+    ring (> capacity/2) de-coalesces first, then splits chunks
+    record-wise and re-sends — semantics are unchanged since DataFeed
+    re-slices chunks anyway."""
     from tensorflowonspark_tpu import frames as frames_lib
 
-    bufs = frames_lib.encode(obj)
+    multi = isinstance(obj, frames_lib.FrameList)
+    bufs = frames_lib.encode_multi(obj) if multi else frames_lib.encode(obj)
     while True:
         try:
             with _RING_WRITE_LOCK:
@@ -851,6 +961,10 @@ def _ring_put(ring, obj, mgr, deadline, cancel=None):
             if time.monotonic() > deadline:
                 raise RuntimeError("feed timeout exceeded")
         except ValueError:
+            if multi:
+                for part in obj:
+                    _ring_put(ring, part, mgr, deadline, cancel=cancel)
+                return
             if isinstance(obj, frames_lib.ColumnarChunk) and len(obj) > 1:
                 half = len(obj) // 2
                 _ring_put(ring, obj.slice(0, half), mgr, deadline,

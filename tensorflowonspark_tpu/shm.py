@@ -282,6 +282,13 @@ class ShmRing(object):
         The view addresses the ring mapping directly; call ``release()``
         exactly once when done to free the slot (until then the producer
         can't reclaim the space).
+
+        SEQUENTIAL-CONSUMPTION CONTRACT: at most one outstanding view.
+        The read position is the consumer tail, which only ``release``
+        advances — a second ``read_view`` before releasing the first
+        returns the SAME message again (and releasing both then
+        over-advances the tail, desyncing the stream). DataFeed upholds
+        this by unpinning every held slot before each blocking read.
         """
         lib = _load()
         t = -1 if timeout is None else int(timeout * 1000)
@@ -330,7 +337,13 @@ class ShmRing(object):
         """Read one frame → object; None on timeout.
 
         ColumnarChunk columns are copied out of the ring (one memcpy) so
-        the slot frees immediately and the result owns its memory.
+        the slot frees immediately and the result owns its memory. A
+        coalesced multi-object frame (frames.encode_multi) comes back as
+        a FrameList with every chunk materialized the same way.
+
+        This is the copying legacy path (probes, drains, tools); the
+        trainer's DataFeed consumes via read_view + a staging gather
+        instead, releasing the slot only after the single copy out.
         """
         from tensorflowonspark_tpu import frames
         view, release = self.read_view(timeout)
@@ -338,8 +351,10 @@ class ShmRing(object):
             return None
         try:
             obj = frames.decode(view)
-            if isinstance(obj, frames.ColumnarChunk):
-                obj.materialize()
+            objs = obj if isinstance(obj, frames.FrameList) else (obj,)
+            for o in objs:
+                if isinstance(o, frames.ColumnarChunk):
+                    o.materialize()
             return obj
         finally:
             release()

@@ -13,12 +13,75 @@ code; its own plumbing is unobservable. Here the framework exposes:
   cleanly when TF is absent.
 - :func:`metrics_hook` — a ``Trainer.train_loop`` hook writing loss +
   step rate, the part the reference couldn't see (queue-fed step timing).
+- :class:`StageTimers` — named wall-clock accumulators for the feed
+  plane's per-stage breakdown (ring wait / decode / gather /
+  device_put): DataFeed and infeed.prefetch share one instance so the
+  whole host-side feed cost of a run lands in a single snapshot, and
+  bench.py / scripts/profile_fed.py surface it next to
+  ``fed_frac_of_device`` — the remaining feed loss is attributed to a
+  stage instead of unexplained.
 """
 
 import logging
 import time
 
 logger = logging.getLogger(__name__)
+
+
+class StageTimers(object):
+    """Named wall-clock accumulators: one entry per pipeline stage.
+
+    Cheap enough for per-chunk use (a dict add per sample, no locks).
+    The feed plane's convention is one instance per DataFeed, shared
+    with the infeed prefetcher (``infeed.prefetch(..., timers=...)``);
+    the prefetch staging thread is the only cross-thread writer and
+    ``snapshot()`` is read at end of run, so the unlocked add is a
+    benign last-sample race, never a torn total.
+    """
+
+    __slots__ = ("_t", "_n")
+
+    def __init__(self):
+        self._t = {}
+        self._n = {}
+
+    def add(self, stage, seconds):
+        """Accumulate one sample for ``stage``."""
+        self._t[stage] = self._t.get(stage, 0.0) + seconds
+        self._n[stage] = self._n.get(stage, 0) + 1
+
+    def timed(self, stage):
+        """``with timers.timed("decode"):`` — context-manager sampling."""
+        return _StageSpan(self, stage)
+
+    def snapshot(self):
+        """{stage: total_seconds} — stable copy for artifacts/logs."""
+        return dict(self._t)
+
+    def counts(self):
+        """{stage: samples} — for per-sample (per-chunk/batch) math."""
+        return dict(self._n)
+
+    def per_ms(self):
+        """{stage: mean milliseconds per sample} — the human-readable
+        breakdown bench.py and profile_fed.py print."""
+        return {k: round(v * 1000.0 / max(self._n.get(k, 1), 1), 3)
+                for k, v in self._t.items()}
+
+
+class _StageSpan(object):
+    __slots__ = ("_timers", "_stage", "_t0")
+
+    def __init__(self, timers, stage):
+        self._timers = timers
+        self._stage = stage
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._timers.add(self._stage, time.monotonic() - self._t0)
 
 
 def start_profiler_server(port=9012):

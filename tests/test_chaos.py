@@ -74,23 +74,33 @@ def test_trainer_sigkill_mid_queue_join(tmp_path):
     join's state check must return (the reference's bare queue.join()
     hangs here forever), and shutdown must name the exit code."""
     def read_one_then_sigkill_after(args, ctx):
-        # consume one batch, give the feeder time to finish writing and
-        # enter its join, then die
+        # consume one batch, then die — but only once the feeder has
+        # finished writing the partition and is (about to be) parked in
+        # its join. Poll-with-deadline, not a fixed linger: on a loaded
+        # 1-core box a fixed sleep races the feeder both ways. The
+        # EndPartition marker landing in the input queue (qsize >= 1
+        # after this trainer consumed the partition's one chunk) IS the
+        # "feeder finished writing" event.
         feed = ctx.get_data_feed(train_mode=True)
         feed.next_batch(8)
-        time.sleep(args["linger_s"])
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and feed._queue_in.qsize() < 1:
+            time.sleep(0.1)
         os.kill(os.getpid(), signal.SIGKILL)
 
     sc = _sc(tmp_path, "queue")
     try:
-        tfc = cluster.run(sc, read_one_then_sigkill_after,
-                          {"linger_s": 3.0}, num_executors=1,
+        tfc = cluster.run(sc, read_one_then_sigkill_after, {},
+                          num_executors=1,
                           input_mode=cluster.InputMode.SPARK)
         # small feed: fully written long before the trainer dies, so the
         # feeder is inside _join_feed when the kill lands
         t0 = time.monotonic()
         tfc.train(sc.parallelize(list(range(200)), 2), feed_timeout=60)
-        assert time.monotonic() - t0 < 75, "join wedged past its bounds"
+        # generous bound (feed_timeout + load margin): the assertion is
+        # "returned at all, via the state check" — not a latency SLO a
+        # loaded CI box can miss
+        assert time.monotonic() - t0 < 120, "join wedged past its bounds"
         with pytest.raises(RuntimeError, match=r"-9|killed"):
             tfc.shutdown(grace_secs=1)
     finally:
@@ -130,27 +140,37 @@ def test_feeder_executor_sigkill_leaves_no_ring(tmp_path):
         import threading
 
         def assassin():
-            # wait for the trainer to prove the feed is flowing, then
-            # shoot the executor while its feed task is mid-write
-            deadline = time.monotonic() + 30
+            # wait for the trainer to prove the feed is flowing (the pid
+            # file lands after its first consumed batch), then shoot the
+            # executor while its feed task is mid-feed. Poll-with-
+            # deadline; the deadline is generous because missing it just
+            # means the kill never fires and train() below succeeds —
+            # which fails the pytest.raises loudly, not flakily.
+            deadline = time.monotonic() + 60
             while not os.path.exists(pid_file):
                 if time.monotonic() > deadline:
                     return
                 time.sleep(0.1)
-            time.sleep(0.5)
+            time.sleep(0.5)  # minimum settle, not a deadline: the feeder
+            # is still streaming 256 slow-consumed rows at this point
             os.kill(executor_pid, signal.SIGKILL)
 
         killer = threading.Thread(target=assassin, daemon=True)
         killer.start()
         with pytest.raises(TaskError, match="died|connection lost"):
             tfc.train(sc.parallelize(rows, 2), feed_timeout=60)
-        killer.join(timeout=35)
+        killer.join(timeout=60)
+        assert not killer.is_alive(), "assassin thread wedged"
         # the kill skipped every cleanup: the segment is leaked right now
         assert _rings(), "expected the SIGKILLed executor's ring to linger"
 
-        # the orphaned trainer must notice its broker is gone and exit
+        # the orphaned trainer must notice its broker is gone and exit.
+        # Deadline sized for a loaded 1-core box: the orphan first crawls
+        # the ring's leftovers at its deliberate 0.05s/record pace (up to
+        # ~13s unloaded), then needs a 5s read timeout + the dead-broker
+        # RPC to error out — 120s is a no-hang bound, not a latency SLO.
         trainer_pid = int(open(pid_file).read())
-        deadline = time.monotonic() + 45
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             try:
                 os.kill(trainer_pid, 0)
@@ -158,7 +178,7 @@ def test_feeder_executor_sigkill_leaves_no_ring(tmp_path):
                 break
             time.sleep(0.5)
         else:
-            pytest.fail("orphaned trainer still alive after 45s")
+            pytest.fail("orphaned trainer still alive after 120s")
     finally:
         sc.stop()
     # stop() swept the dead executor's ring (pid-liveness check)

@@ -317,3 +317,30 @@ def test_auto_work_root_cleaned_on_clean_stop(tmp_path, monkeypatch):
     assert ctx3.parallelize([1], 1).collect() == [1]
     ctx3.stop()
     assert os.path.exists(explicit), "user-passed work_root is theirs"
+
+
+def test_clean_stop_spares_user_task_files(tmp_path, monkeypatch):
+    """A clean stop() removes only ENGINE artifacts: executors chdir into
+    work_root/executor-N, so user task files written with relative paths
+    live there and must survive (the old whole-tree rmtree silently
+    destroyed them on success — ADVICE r5 medium)."""
+    monkeypatch.chdir(tmp_path)
+    ctx = Context(num_executors=1)
+    root = ctx.work_root
+
+    def write_relative(it):
+        with open("result.txt", "w") as f:
+            f.write(str(sum(it)))
+        return iter([0])
+
+    assert ctx.parallelize([1, 2, 3], 1).mapPartitions(
+        write_relative).collect() == [0]
+    ctx.stop()
+    user_file = os.path.join(root, "executor-0", "result.txt")
+    assert os.path.exists(user_file), "user task file must survive stop()"
+    assert open(user_file).read() == "6"
+    # the engine's own artifacts are gone
+    assert not os.path.exists(os.path.join(root, "authkey"))
+    assert not os.path.exists(os.path.join(root, "driver.info"))
+    assert not os.path.exists(
+        os.path.join(root, "executor-0", "executor.log"))

@@ -1,0 +1,252 @@
+"""Zero-copy consume-path contracts (the small-batch feed-gap tentpole).
+
+The DataFeed ring path decodes chunks as views INTO the shm mapping and
+assembles mapped batches with a single gather per column into a reusable
+staging buffer, releasing the ring slot only after that copy. These
+tests pin the safety contract (consumed batches never alias ring memory
+after slot release), the performance contract (zero read-side column
+memcpys and zero per-batch allocations once the staging buffer is
+reusable), the slot bookkeeping (held until the last aliasing row is
+copied, released exactly once), and the feeder's tail coalescing
+(final chunk + EndPartition in ONE ring message).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import frames, manager, node, shm
+from tensorflowonspark_tpu.datafeed import DataFeed
+from tensorflowonspark_tpu.marker import EndFeed, EndPartition
+
+pytestmark = pytest.mark.skipif(not shm.available(),
+                                reason="native shm ring unavailable")
+
+
+def _ring_feed(name, capacity=1 << 16, mapping=None):
+    """(producer_ring, broker, consumer_feed) wired like a node would."""
+    shm._load().shmring_unlink(name.encode())
+    ring = shm.ShmRing.create(name, capacity=capacity)
+    mgr = manager.start(os.urandom(16), ["input"])
+    mgr.set("shm_name", name)
+    feed = DataFeed(mgr, train_mode=True,
+                    input_mapping=mapping or {"x": "x"})
+    return ring, mgr, feed
+
+
+def _close(ring, feed):
+    feed._ring.close()
+    ring.unlink()
+    ring.close()
+
+
+def test_consumed_batches_never_alias_ring_memory():
+    """The materialize contract, zero-copy edition: a batch handed to the
+    user must survive the producer wrapping the ring arbitrarily many
+    times — if the gather were skipped and the batch aliased the
+    mapping, the hammering below would corrupt it silently."""
+    ring, mgr, feed = _ring_feed("/tfos-test-zc-alias")
+    try:
+        x = np.full((4, 1500), 7, np.uint8)
+        ring.write_obj(frames.ColumnarChunk([x], names=("x",)), timeout=2.0)
+        batch = feed.next_batch(4)
+        # the slot was released the moment the gather copied the rows out
+        assert feed._ring.pending() == 0
+        # hammer far past wraparound while holding `batch`
+        for i in range(30):
+            ring.write_obj(
+                frames.ColumnarChunk([np.full((4, 1500), i % 251, np.uint8)],
+                                     names=("x",)), timeout=2.0)
+            assert feed._ring.read(timeout=2.0) is not None
+        np.testing.assert_array_equal(batch["x"], x)
+    finally:
+        _close(ring, feed)
+
+
+def test_staging_reuse_no_alloc_no_read_side_memcpy(monkeypatch):
+    """Steady state (repeating batch shape): the consume path performs
+    ZERO read-side column memcpys (no ColumnarChunk.materialize at all)
+    and zero per-batch allocations — the one copy is the in-place gather
+    into the staging buffer, which later batches reuse."""
+    calls = []
+    orig = frames.ColumnarChunk.materialize
+
+    def counting_materialize(self):
+        calls.append(1)
+        return orig(self)
+
+    monkeypatch.setattr(frames.ColumnarChunk, "materialize",
+                        counting_materialize)
+    ring, mgr, feed = _ring_feed("/tfos-test-zc-staging")
+    try:
+        for i in (1, 2, 3):
+            ring.write_obj(
+                frames.ColumnarChunk([np.full((4, 64), i, np.uint8)],
+                                     names=("x",)), timeout=2.0)
+        b1 = feed.next_batch(4)
+        np.testing.assert_array_equal(b1["x"], np.full((4, 64), 1, np.uint8))
+        b2 = feed.next_batch(4)
+        np.testing.assert_array_equal(b2["x"], np.full((4, 64), 2, np.uint8))
+        b3 = feed.next_batch(4)
+        np.testing.assert_array_equal(b3["x"], np.full((4, 64), 3, np.uint8))
+        # every batch landed in the SAME staging buffer: one allocation,
+        # then reuse (the documented valid-until-next-call contract)
+        assert np.shares_memory(b1["x"], b2["x"])
+        assert np.shares_memory(b2["x"], b3["x"])
+        stats = feed.stats()
+        assert stats["staging_alloc"] == 1
+        assert stats["staging_reuse"] == 2
+        assert not calls, "read-side materialize memcpy must be gone"
+    finally:
+        _close(ring, feed)
+
+
+def test_slot_held_until_fully_copied_released_once():
+    """A partially consumed chunk pins its ring slot (the producer must
+    not reclaim memory the pending remainder still aliases); consuming
+    the remainder releases it exactly once and frees the space."""
+    ring, mgr, feed = _ring_feed("/tfos-test-zc-slot", capacity=1 << 16)
+    try:
+        x = np.arange(8 * 3600, dtype=np.uint8).reshape(8, 3600)
+        ring.write_obj(frames.ColumnarChunk([x], names=("x",)), timeout=2.0)
+        ring.write_obj(frames.ColumnarChunk([x], names=("x",)), timeout=2.0)
+        half = feed.next_batch(4)  # msg1 half-consumed: slot HELD
+        np.testing.assert_array_equal(half["x"], x[:4])
+        with pytest.raises(TimeoutError):
+            # ~29KB free of the ~29KB+pad needed while msg1's slot pins
+            # its bytes: the write must block
+            ring.write_obj(frames.ColumnarChunk([x[:4]], names=("x",)),
+                           timeout=0.3)
+        rest = feed.next_batch(4)  # remainder copied out -> slot released
+        np.testing.assert_array_equal(rest["x"], x[4:])
+        ring.write_obj(frames.ColumnarChunk([x[:4]], names=("x",)),
+                       timeout=5.0)  # now fits
+    finally:
+        _close(ring, feed)
+
+
+def test_spanning_batch_unpins_slots_before_blocking():
+    """A batch spanning several ring messages must unpin consumed
+    segments' slots before each further read: read_view's sequential
+    contract re-delivers the SAME message while a slot is held (a
+    skipped unpin surfaces here as all-zeros duplicated rows), and on
+    the liveness side held slots pin bytes the producer needs for the
+    rest of the batch (sized so two messages fill the ring)."""
+    import threading
+
+    ring, mgr, feed = _ring_feed("/tfos-test-zc-span", capacity=1 << 16)
+    try:
+        chunks = [frames.ColumnarChunk(
+            [np.full((8, 3800), i, np.uint8)], names=("x",))
+            for i in range(3)]
+        errs = []
+
+        def produce():
+            try:
+                for c in chunks:
+                    ring.write_obj(c, timeout=30.0)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append(e)
+
+        producer = threading.Thread(target=produce, daemon=True)
+        producer.start()
+        batch = feed.next_batch(24)  # spans all three messages
+        producer.join(timeout=30)
+        assert not producer.is_alive() and not errs, (errs or "wedged")
+        assert batch["x"].shape == (24, 3800)
+        for i in range(3):
+            np.testing.assert_array_equal(
+                batch["x"][8 * i:8 * (i + 1)],
+                np.full((8, 3800), i, np.uint8))
+    finally:
+        _close(ring, feed)
+
+
+def test_feed_partition_coalesces_tail_into_one_ring_message():
+    """node._feed_partition on the ring sends a small partition as ONE
+    message: [chunk, EndPartition] via frames.encode_multi — the
+    per-message fixed costs the small-batch regime used to pay twice."""
+    shm._load().shmring_unlink(b"/tfos-test-zc-coalesce")
+    ring = shm.ShmRing.create("/tfos-test-zc-coalesce", capacity=1 << 20)
+    mgr = manager.start(os.urandom(16), ["input"])
+    node._NODE_STATE["shm_ring"] = ring
+    try:
+        records = [(np.full(100, i, np.uint8), np.int64(i))
+                   for i in range(10)]
+        count = node._feed_partition(iter(records), mgr, "input",
+                                     feed_timeout=30)
+        assert count == 10
+        msg = ring.read(timeout=2.0)
+        obj = frames.decode(msg)
+        assert isinstance(obj, frames.FrameList)
+        assert len(obj) == 2
+        assert isinstance(obj[0], frames.ColumnarChunk) and len(obj[0]) == 10
+        assert isinstance(obj[1], EndPartition)
+        assert ring.pending() == 0, "partition must be exactly one message"
+    finally:
+        node._NODE_STATE.pop("shm_ring", None)
+        ring.unlink()
+        ring.close()
+
+
+def test_datafeed_consumes_coalesced_partitions_end_to_end():
+    """Coalesced [chunk, EndPartition] messages round-trip through
+    DataFeed with identical semantics: batches never straddle the
+    partition boundary and end-of-feed lands."""
+    ring, mgr, feed = _ring_feed("/tfos-test-zc-e2e", capacity=1 << 20,
+                                 mapping={"x": "x", "y": "y"})
+    node._NODE_STATE["shm_ring"] = ring
+    try:
+        def part(lo, hi):
+            return [(np.full(8, i, np.uint8), np.int64(i))
+                    for i in range(lo, hi)]
+
+        assert node._feed_partition(iter(part(0, 6)), mgr, "input", 30) == 6
+        assert node._feed_partition(iter(part(6, 10)), mgr, "input", 30) == 4
+        ring.write_obj(EndFeed(), timeout=2.0)
+        sizes = []
+        ys = []
+        while not feed.should_stop():
+            batch = feed.next_batch(4)
+            n = len(batch["y"]) if batch else 0
+            if n:
+                sizes.append(n)
+                ys.extend(int(v) for v in batch["y"])
+        assert sizes == [4, 2, 4], "batches must not straddle EndPartition"
+        assert ys == list(range(10))
+        assert feed.stats()["records"] == 10
+    finally:
+        node._NODE_STATE.pop("shm_ring", None)
+        _close(ring, feed)
+
+
+def test_pack_chunks_bounds_ragged_fallback():
+    """A size-targeted accumulation (limit sized from the FIRST record,
+    up to FEED_CHUNK_MAX) whose later records are ragged falls back to
+    pickled row lists — which must re-split to the legacy FEED_CHUNK
+    bound (one unsplittable multi-thousand-record list would hard-fail
+    the ring's oversize path and spike the queue pickles)."""
+    recs = [(np.zeros(2, np.uint8), np.int64(0))] + \
+           [(np.zeros(3, np.uint8), np.int64(i)) for i in range(600)]
+    out = node._pack_chunks(recs)
+    assert all(isinstance(c, list) for c in out)
+    assert max(len(c) for c in out) <= node.FEED_CHUNK
+    assert sum(len(c) for c in out) == 601
+    flat = [r for c in out for r in c]
+    assert all(int(flat[1 + i][1]) == i for i in range(600))
+
+
+def test_queue_single_chunk_passthrough_stays_zero_copy():
+    """The queue transport's steady state (one owned chunk per batch)
+    keeps its zero-copy pass-through: output columns are views of the
+    chunk's arrays, no gather, no staging."""
+    mgr = manager.start(os.urandom(16), ["input"])
+    q = mgr.get_queue("input")
+    x = np.arange(20, dtype=np.float32).reshape(5, 4)
+    q.put(frames.ColumnarChunk([x], names=("x",)))
+    q.put(EndFeed())
+    feed = DataFeed(mgr, train_mode=True, input_mapping={"x": "x"})
+    batch = feed.next_batch(5)
+    assert np.shares_memory(batch["x"], x)
+    assert feed.stats()["staging_alloc"] == 0

@@ -73,6 +73,34 @@ def test_encode_decode_object():
     assert isinstance(frames.decode(blob), EndPartition)
 
 
+def test_encode_multi_roundtrip_and_zero_copy_views():
+    """One frame carrying several objects (the feeder's tail-coalescing
+    wire format): order preserved, chunks decode as zero-copy views,
+    markers round-trip, and the result is a FrameList — never confusable
+    with a legacy record-list chunk (a plain pickled list)."""
+    x = np.random.RandomState(2).rand(3, 4).astype(np.float32)
+    y = np.arange(5, dtype=np.int64)
+    blob = b"".join(bytes(b) for b in frames.encode_multi([
+        frames.ColumnarChunk([x], names=("x",)),
+        EndPartition(),
+        frames.ColumnarChunk([y], scalar=True),
+    ]))
+    out = frames.decode(blob)
+    assert isinstance(out, frames.FrameList) and len(out) == 3
+    np.testing.assert_array_equal(out[0].cols[0], x)
+    assert out[0].names == ("x",)
+    assert isinstance(out[1], EndPartition)
+    np.testing.assert_array_equal(out[2].cols[0], y)
+    assert out[2].scalar
+    # column payloads are views into the source buffer, not copies
+    assert out[0].cols[0].base is not None
+    assert out[2].cols[0].base is not None
+    # a legacy record-list chunk stays a PLAIN list after decode
+    legacy = frames.decode(
+        b"".join(bytes(b) for b in frames.encode([1, 2, 3])))
+    assert type(legacy) is list
+
+
 def test_datafeed_columnar_chunks_reslice():
     mgr = manager.start(b"framekey", ["input"])
     q = mgr.get_queue("input")

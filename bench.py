@@ -33,6 +33,16 @@ Prints ONE JSON line. Fields:
                          to a stage instead of unexplained.
 - ``mfu``              — model FLOP utilization from XLA's compiled cost
                          analysis vs the chip's bf16 peak.
+- ``serving_decode``   — the serving plane (PR 2): continuous-batching
+                         decode engine vs the run-to-completion window
+                         batcher on 32 mixed-length requests (prompt
+                         8-128, max_new 8-128). ``speedup`` compares
+                         tokens/sec from COLD jit caches (a fresh server
+                         facing fresh traffic — the regime where the
+                         batcher's one-program-per-signature compile
+                         cost is real and unbounded); ``*_warm`` fields
+                         are the steady-state rerun. p50/p99 are
+                         per-request submit->complete latencies.
 
 Fed batches carry uint8 images (the realistic decoded-image payload; a
 production input pipeline ships uint8 and normalizes on-device) with the
@@ -305,6 +315,157 @@ def _device_only(on_tpu, batch, image, steps, warmup):
     return rate, mfu
 
 
+def _serving_workload(n_requests, total_len, vocab, seed=0):
+    """Mixed-length generation traffic: (prompt, max_new) pairs with
+    prompt 8-128 and max_new 8-128 (multiples of 8, so the baseline's
+    per-signature compile count stays bounded enough to measure), every
+    request fitting ``prompt + max_new <= total_len``. Prompts cap at
+    ``total_len // 2`` so small-cache configs (scripts/profile_serving
+    shares this generator) still leave decode room; at the bench's own
+    total_len=256 that cap is 128 — no change to the published
+    workload. Needs ``total_len >= 16``."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    reqs = []
+    for _ in range(n_requests):
+        p_len = int(rng.choice(range(8, min(129, total_len // 2 + 1), 8)))
+        max_new = int(rng.choice(range(8, 129, 8)))
+        max_new = min(max_new, total_len - p_len)
+        prompt = rng.randint(0, vocab, size=p_len).tolist()
+        reqs.append((prompt, max_new))
+    return reqs
+
+
+def _serving_model(on_tpu):
+    """Decoder LM for the serving bench (shape-matched to the box)."""
+    from tensorflowonspark_tpu.models.decoder import DecoderLM
+    kw = dict(vocab=256, hidden=256 if on_tpu else 64,
+              num_heads=8 if on_tpu else 4,
+              num_layers=4 if on_tpu else 2, max_len=256)
+    return (DecoderLM(decode=False, **kw), DecoderLM(decode=True, **kw))
+
+
+def _batcher_leg(dec, params, reqs):
+    """The OLD serving shape: the window ``_Batcher`` groups only
+    identical-signature requests and runs each group to completion
+    through ``generate_jit`` — so a mixed-length workload degenerates
+    into many small run-to-max groups, each compiling its own
+    whole-generation program. Modeled in-process with the batcher's own
+    policies (perfect same-signature coalescing, rows padded to a
+    power-of-two bucket) — generous to the baseline: a real window
+    would add wait time and miss some coalesces. Returns
+    (tokens/sec, latencies, n_calls)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from tensorflowonspark_tpu import generation
+
+    groups = {}
+    for i, (prompt, max_new) in enumerate(reqs):
+        groups.setdefault((len(prompt), max_new), []).append(i)
+    latencies = [0.0] * len(reqs)
+    tokens = 0
+    t0 = time.monotonic()
+    for (p_len, max_new), members in groups.items():
+        rows = np.asarray([reqs[i][0] for i in members], np.int32)
+        bucket = 1
+        while bucket < len(rows):
+            bucket *= 2
+        if bucket > len(rows):  # _Batcher._run_group's row padding
+            rows = np.concatenate(
+                [rows, np.repeat(rows[-1:], bucket - len(rows), axis=0)])
+        out = generation.generate_jit(dec, params, jnp.asarray(rows),
+                                      max_new)
+        out.block_until_ready()
+        done = time.monotonic() - t0
+        tokens += max_new * len(members)
+        for i in members:
+            latencies[i] = done
+    wall = time.monotonic() - t0
+    return tokens / wall, latencies, len(groups)
+
+
+def _engine_leg(dec, params, reqs, slots):
+    """The NEW serving shape: continuous batching through
+    serving.DecodeEngine. Returns (tokens/sec, latencies, stats) — THE
+    engine-measurement harness; scripts/profile_serving.py imports it so
+    bench numbers and profile attributions describe the same run
+    shape."""
+    from tensorflowonspark_tpu import serving
+
+    eng = serving.DecodeEngine(dec, params, slots=slots)
+    try:
+        t0 = time.monotonic()
+        handles = [eng.submit(p, mn) for p, mn in reqs]
+        for h in handles:
+            h.result(1800)
+        wall = time.monotonic() - t0
+        counts = eng.counters.snapshot()["counts"]
+        stats = {"compile": eng.compile_stats(),
+                 "tokens": counts.get("tokens", 0),
+                 "wall_s": round(wall, 3),
+                 "tokens_per_step": round(
+                     eng.counters.rate("decode_tokens", "decode_steps"), 2),
+                 "decode_steps": counts.get("decode_steps", 0),
+                 "prefills": counts.get("prefills", 0),
+                 "stage_ms": eng.timers.per_ms(),
+                 "stage_s_total": {k: round(v, 3) for k, v in
+                                   eng.timers.snapshot().items()}}
+        latencies = [h.latency for h in handles]
+        return counts.get("tokens", 0) / wall, latencies, stats
+    finally:
+        eng.stop()
+
+
+def _serving_decode_bench(on_tpu):
+    """Mixed-length serving comparison: continuous-batching engine vs
+    the run-to-completion window batcher, both from COLD jit caches (a
+    fresh server facing fresh traffic — the regime where the baseline's
+    per-signature compiles are its real cost) and again WARM (pure
+    steady-state decode). Returns the ``serving_decode`` JSON block.
+    """
+    import jax
+    import numpy as np
+
+    train, dec = _serving_model(on_tpu)
+    params = train.init(jax.random.PRNGKey(0),
+                        np.zeros((1, dec.max_len), np.int32))["params"]
+    reqs = _serving_workload(32, dec.max_len, dec.vocab)
+
+    def _leg(fn):
+        jax.clear_caches()
+        cold = fn()
+        warm = fn()
+        return cold, warm
+
+    def _pcts(latencies):
+        return {"p50_ms": round(float(np.percentile(latencies, 50)) * 1e3),
+                "p99_ms": round(float(np.percentile(latencies, 99)) * 1e3)}
+
+    (b_cold_tps, b_cold_lat, n_calls), (b_warm_tps, b_warm_lat, _) = _leg(
+        lambda: _batcher_leg(dec, params, reqs))
+    (e_cold_tps, e_cold_lat, e_stats), (e_warm_tps, e_warm_lat, _) = _leg(
+        lambda: _engine_leg(dec, params, reqs, slots=8))
+
+    block = {
+        "workload": {"requests": len(reqs), "prompt_lens": "8-128",
+                     "max_new": "8-128",
+                     "total_tokens": sum(mn for _, mn in reqs),
+                     "signatures": n_calls},
+        "engine": dict(tokens_per_sec=round(e_cold_tps, 1),
+                       **_pcts(e_cold_lat), **e_stats),
+        "batcher": dict(tokens_per_sec=round(b_cold_tps, 1),
+                        **_pcts(b_cold_lat), model_calls=n_calls),
+        "engine_warm": dict(tokens_per_sec=round(e_warm_tps, 1),
+                            **_pcts(e_warm_lat)),
+        "batcher_warm": dict(tokens_per_sec=round(b_warm_tps, 1),
+                             **_pcts(b_warm_lat)),
+        "speedup": round(e_cold_tps / b_cold_tps, 2) if b_cold_tps else None,
+        "speedup_warm": round(e_warm_tps / b_warm_tps, 2)
+        if b_warm_tps else None,
+    }
+    return block
+
+
 def _probe_platform():
     """Device platform WITHOUT initializing jax in this process.
 
@@ -477,6 +638,19 @@ def main():
     if device_error:
         print("device_only failed: {}".format(device_error), file=sys.stderr)
 
+    # Serving plane: the continuous-batching decode engine vs the old
+    # run-to-completion window batcher on mixed-length traffic
+    # (tokens/sec + p50/p99 request latency, cold and warm). Runs in
+    # the driver AFTER the fed/device stages so the single-owner rule
+    # holds. TFOS_BENCH_SERVING=0 skips it.
+    serving_decode = None
+    if os.environ.get("TFOS_BENCH_SERVING", "1") == "1":
+        try:
+            serving_decode = _serving_decode_bench(on_tpu)
+        except Exception as e:  # noqa: BLE001 - report, not die
+            print("serving_decode failed: {}".format(e), file=sys.stderr)
+            serving_decode = {"error": str(e)}
+
     metric_name = ("resnet50_cluster_fed_images_per_sec_per_chip"
                    if fed_enabled else
                    "resnet50_device_only_images_per_sec_per_chip") if on_tpu \
@@ -528,6 +702,9 @@ def main():
         "fed_vs_round2": round(best_fed / ROUND2_FED_IMAGES_PER_SEC, 2)
         if best_fed and on_tpu else None,
         "mfu": round(mfu, 4) if mfu is not None else None,
+        # continuous-batching decode engine vs run-to-completion window
+        # batcher on mixed-length traffic (PR 2; BENCH_r06+ tracks this)
+        "serving_decode": serving_decode,
     }))
 
 

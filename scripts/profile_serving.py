@@ -1,0 +1,112 @@
+"""Stage breakdown of the continuous-batching decode engine (PR 2).
+
+Where does a served token's time go? Runs a mixed-length generation
+workload through serving.DecodeEngine and prints the per-stage
+attribution the engine's own tracing hooks collect:
+
+- ``prefill``       — per-admission fused prompt pass (one jit call per
+                      request, compiled per shape bucket)
+- ``decode_step``   — the fixed-shape S-slot step, including the
+                      per-step host sync that reads the emitted tokens
+- ``host_schedule`` — pure scheduler bookkeeping between steps
+                      (admission scans, EOS checks, stream delivery)
+
+plus the engine's counters (tokens/step = effective slot occupancy,
+prefills, steps), compile stats (programs vs buckets), and a
+cold/warm split so compile cost is attributed separately from
+steady-state decode.
+
+Usage (CPU, hermetic):
+
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python scripts/profile_serving.py [--requests 32] [--slots 8] \
+        [--total-len 256] [--hidden 64] [--layers 2] [--seed 0] [--json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _run(dec, params, reqs, slots, label, out):
+    import numpy as np
+
+    # bench.py's harness — ONE engine-measurement implementation, so
+    # the profiler's stage attribution describes the benched run shape
+    from bench import _engine_leg
+
+    tps, lat, stats = _engine_leg(dec, params, reqs, slots)
+    out[label] = dict(
+        tokens_per_sec=round(tps, 1),
+        p50_ms=round(float(np.percentile(lat, 50)) * 1e3),
+        p99_ms=round(float(np.percentile(lat, 99)) * 1e3),
+        **stats)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--total-len", type=int, default=256)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", action="store_true",
+                    help="print one JSON blob instead of the table")
+    args = ap.parse_args(argv)
+    if args.total_len < 16:
+        ap.error("--total-len must be >= 16: the mixed workload draws "
+                 "prompts from range(8, total_len//2 + 1, 8), which is "
+                 "empty below that")
+
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.models.decoder import DecoderLM
+
+    train = DecoderLM(vocab=args.vocab, hidden=args.hidden, num_heads=4,
+                      num_layers=args.layers, max_len=args.total_len,
+                      decode=False)
+    dec = DecoderLM(vocab=args.vocab, hidden=args.hidden, num_heads=4,
+                    num_layers=args.layers, max_len=args.total_len,
+                    decode=True)
+    params = train.init(jax.random.PRNGKey(0),
+                        np.zeros((1, args.total_len), np.int32))["params"]
+    # the SAME generator bench.py's serving_decode block measures, so
+    # the profiler's stage attribution describes the benched workload
+    from bench import _serving_workload
+    reqs = _serving_workload(args.requests, args.total_len, args.vocab,
+                             seed=args.seed)
+
+    out = {"config": {"requests": args.requests, "slots": args.slots,
+                      "total_len": args.total_len, "hidden": args.hidden,
+                      "layers": args.layers,
+                      "total_new_tokens": sum(mn for _, mn in reqs)}}
+    jax.clear_caches()
+    _run(dec, params, reqs, args.slots, "cold", out)   # includes compiles
+    _run(dec, params, reqs, args.slots, "warm", out)   # steady state
+
+    if args.json:
+        print(json.dumps(out))
+        return
+    print("config: {}".format(out["config"]))
+    for leg in ("cold", "warm"):
+        r = out[leg]
+        print("\n[{}] {} tokens in {}s -> {} tok/s  "
+              "(p50 {}ms, p99 {}ms)".format(
+                  leg, r["tokens"], r["wall_s"], r["tokens_per_sec"],
+                  r["p50_ms"], r["p99_ms"]))
+        print("  occupancy: {} tokens/step over {} steps, {} prefills"
+              .format(r["tokens_per_step"], r["decode_steps"],
+                      r["prefills"]))
+        print("  stages (mean ms/call): {}".format(r["stage_ms"]))
+        print("  stages (total s):      {}".format(r["stage_s_total"]))
+        print("  compile: {}".format(r["compile"]))
+
+
+if __name__ == "__main__":
+    main()

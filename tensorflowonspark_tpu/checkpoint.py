@@ -69,6 +69,7 @@ class Checkpointer(object):
 
     def __init__(self, directory, chief=True, max_to_keep=3,
                  allow_remote=False):
+        import jax
         import orbax.checkpoint as ocp
 
         from tensorflowonspark_tpu import fs
@@ -83,10 +84,19 @@ class Checkpointer(object):
         self.chief = chief
         if chief and not self._remote:
             os.makedirs(self.directory, exist_ok=True)
+        # ``create`` must be PROCESS-UNIFORM under jax.distributed:
+        # orbax's create path runs a named sync_global_devices barrier,
+        # so chief-only create (create=chief) sends the chief into a
+        # collective the workers never enter — the next collective then
+        # dies inside gloo with a payload-size mismatch (found by the
+        # multi-process sharded recovery test). Multi-process: everyone
+        # passes create=True and orbax's primary-host logic does the one
+        # mkdir. Single-process keeps the chief-only behavior.
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=chief))
+                max_to_keep=max_to_keep,
+                create=chief or jax.process_count() > 1))
         # Skip-decision bookkeeping (ADVICE r5): the already-persisted
         # guard in save() must be PROVABLY CONSISTENT across processes —
         # under jax.distributed, orbax's save is a collective, so if one

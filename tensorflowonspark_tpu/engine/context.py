@@ -385,7 +385,21 @@ class Context(object):
 
         ``fail_fast=False`` opts a job out of abort-on-first-failure:
         every task still runs and ``get()`` waits for all of them
-        (cleanup/shutdown jobs)."""
+        (cleanup/shutdown jobs).
+
+        Fail-fast abort scope (deliberately BEST-EFFORT): the first
+        failure wakes ``get()`` immediately and marks the job failed, and
+        the dispatch loop skips every not-yet-shipped task of that job —
+        but tasks ALREADY shipped to an executor run to completion (or
+        burn their own timeout) and their results are discarded. There is
+        no in-flight cancel message: the executor protocol is
+        send-task/await-reply over one connection, so a cancel could not
+        be heard until the task finished anyway — preemption would need
+        killing the executor process, which costs more than letting a
+        doomed task drain (and the trainer-owned TPU makes process
+        recycling expensive). Callers must therefore treat ``get()``
+        raising as "job lost", not "cluster quiesced"; ``Context.stop``'s
+        terminate-with-escalation is the hard bound on stragglers."""
         partitions = rdd._partitions
         result = AsyncResult(len(partitions), fail_fast=fail_fast)
         with self._lock:

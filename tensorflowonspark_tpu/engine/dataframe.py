@@ -88,6 +88,31 @@ class DataFrame(object):
         schema.append((name, dtype))
         return DataFrame(self.rdd.map(add), schema)
 
+    def filter(self, predicate):
+        """Rows where ``predicate(row)`` is truthy; schema unchanged.
+
+        ``predicate`` is a plain python fn over the row dict (the
+        ``withColumn`` convention — no expression DSL exists here).
+        """
+        return DataFrame(self.rdd.filter(predicate), self.schema)
+
+    #: Spark alias: ``where`` is ``filter``
+    where = filter
+
+    def drop(self, *cols):
+        """Drop the named columns (unknown names ignored, like Spark).
+
+        Dropping everything is refused — a zero-column DataFrame has no
+        row representation here (rows are plain dicts).
+        """
+        cols = set(cols)
+        keep = [n for n, _ in self.schema if n not in cols]
+        if not keep:
+            raise ValueError("drop() would remove every column")
+        if len(keep) == len(self.schema):
+            return self
+        return self.select(*keep)
+
     def collect(self):
         return self.rdd.collect()
 

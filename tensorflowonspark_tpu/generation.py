@@ -12,9 +12,14 @@ cache instead of re-running the O(S²) prefix.
     out = generate(model, params, prompt, max_new_tokens=64)
 
 ``temperature=0`` is greedy; otherwise softmax sampling with the given
-PRNG key. Feeding happens one token per step (the flax decode-cache
-contract), which also makes prefill a scan — simple and fully
-compiled; a fused multi-token prefill is a later optimization.
+PRNG key. ``generate`` feeds one token per step (the flax decode-cache
+contract), which makes its prefill a scan — simple and fully compiled.
+
+For SERVING, this module also provides the slot-structured primitives
+(``prefill_into_slot`` — fused multi-token, shape-bucketed — and
+``decode_step`` over per-slot cursors) that serving.DecodeEngine
+schedules continuously; see docs/serving.md. Both paths produce
+bitwise-identical greedy outputs per sequence.
 """
 
 import functools
@@ -36,6 +41,20 @@ def init_cache(model, batch, total_len):
         lambda: model.init(jax.random.PRNGKey(0), dummy))
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                         shapes["cache"])
+
+
+def check_sampling_config(temperature, top_k, top_p, rng):
+    """Raise ValueError on sampling configs that would serve silently
+    wrong tokens (top_k=0 / top_p=0 mask EVERY logit to -inf and emit
+    token 0 forever; temperature>0 without a key replays one stream).
+    Shared by ``generate`` and ``serving.DecodeEngine`` so both paths
+    fail loudly on the same inputs."""
+    if temperature and rng is None:
+        raise ValueError("temperature sampling needs a PRNG key")
+    if top_k is not None and int(top_k) < 1:
+        raise ValueError("top_k must be >= 1, got {}".format(top_k))
+    if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+        raise ValueError("top_p must be in (0, 1], got {}".format(top_p))
 
 
 def filter_logits(logits, top_k=None, top_p=None, temperature=0.0):
@@ -94,12 +113,7 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         raise ValueError(
             "model.max_len={} < prompt {} + max_new_tokens {}".format(
                 model.max_len, s, max_new_tokens))
-    if temperature and rng is None:
-        raise ValueError("temperature sampling needs a PRNG key")
-    if top_k is not None and int(top_k) < 1:
-        raise ValueError("top_k must be >= 1, got {}".format(top_k))
-    if top_p is not None and not 0.0 < float(top_p) <= 1.0:
-        raise ValueError("top_p must be in (0, 1], got {}".format(top_p))
+    check_sampling_config(temperature, top_k, top_p, rng)
     if rng is None:
         rng = jax.random.PRNGKey(0)
     if int(max_new_tokens) == 0:
@@ -125,16 +139,11 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         prefill_step, (cache, jnp.zeros((b, model.vocab), jnp.float32)),
         prompt.T)
 
-    def pick(logits, key):
-        logits = filter_logits(logits, top_k=top_k, top_p=top_p,
-                               temperature=temperature)
-        if temperature:
-            return jax.random.categorical(key, logits / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
-
     def pick_frozen(logits, key, done):
-        """pick(), but finished sequences emit pad and stay finished."""
-        token = pick(logits, key).astype(jnp.int32)
+        """``_pick_tokens`` (the ONE sampling implementation, shared
+        with the slot path so they cannot diverge), but finished
+        sequences emit pad and stay finished."""
+        token = _pick_tokens(logits, key, temperature, top_k, top_p)
         if eos_token is None:
             return token, done
         token = jnp.where(done, jnp.int32(pad_token), token)
@@ -159,6 +168,180 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     last, _ = pick_frozen(logits, keys[-1], done0)
     new_tokens = jnp.concatenate([body_tokens, last[None]], axis=0)
     return jnp.concatenate([prompt, new_tokens.T], axis=1)
+
+
+# -- slot-structured primitives (continuous-batching decode) -----------
+#
+# The whole-generation ``generate``/``generate_jit`` above compiles one
+# program per (batch, prompt_len, max_new) signature and runs each batch
+# to completion — fine for offline jobs, the wrong shape for serving
+# mixed-length traffic. The primitives below decompose generation so a
+# scheduler (serving.DecodeEngine) can run ITERATION-LEVEL batching over
+# a slot-structured KV cache:
+#
+# - ``init_cache(model, slots, total_len)`` — one cache, S independent
+#   slots (rows), each with its own write cursor (models/decoder.py keeps
+#   ``cache_index``/``pos_idx`` per-ROW for exactly this).
+# - ``prefill_into_slot`` — run one request's prompt (padded to a shape
+#   bucket) through a batch-1 mini cache, then scatter its K/V rows into
+#   the engine cache at the slot index. Compiles once per BUCKET length,
+#   not once per prompt length.
+# - ``decode_step`` — one fixed-shape step over all S slots at their own
+#   cursors. Compiles ONCE per (slots, total_len) engine config.
+#
+# Both jitted wrappers donate the engine cache, so the scheduler's
+# steady-state loop updates the cache in place instead of copying it.
+
+
+def _pick_tokens(logits, key, temperature, top_k, top_p):
+    """[B, V] logits -> [B] sampled/argmax tokens — the single sampling
+    implementation behind BOTH the solo path (``generate``'s
+    pick_frozen) and the slot path, so they stay bitwise-identical at
+    every temperature."""
+    logits = filter_logits(logits, top_k=top_k, top_p=top_p,
+                           temperature=temperature)
+    if temperature:
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1).astype(jnp.int32)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+#: flax cache leaves that are per-row WRITE CURSORS, not K/V storage
+_CURSOR_LEAVES = ("cache_index", "pos_idx")
+
+
+def _leaf_name(path):
+    entry = path[-1]
+    return getattr(entry, "key", None) or getattr(entry, "name", str(entry))
+
+
+def _set_cursor_leaves(cache, idx):
+    """Cache pytree with every per-row cursor leaf replaced by ``idx``.
+
+    The scheduler (host) is the authority on each slot's position — a
+    freed slot must NOT keep advancing its cursor while it idles, and a
+    re-admitted slot restarts at its new prompt length. Overwriting the
+    cursors before each step makes the device cache's own increments
+    advisory, so inactive slots just re-write one stale position in
+    place instead of walking off the end of the cache.
+    """
+    def repl(path, leaf):
+        if _leaf_name(path) in _CURSOR_LEAVES:
+            return idx.astype(leaf.dtype)
+        return leaf
+    return jax.tree_util.tree_map_with_path(repl, cache)
+
+
+def prefill_into_slot(model, params, cache, slot, tokens, true_len,
+                      temperature=0.0, top_k=None, top_p=None, rng=None):
+    """Prefill one request's prompt into slot ``slot`` of ``cache``.
+
+    ``tokens`` is the prompt padded to its shape bucket ``[bucket_len]``
+    (int32); ``true_len`` is the real prompt length. The prompt runs
+    through a fresh batch-1 mini cache as ONE fused multi-token forward
+    (models/decoder.py's prefill branch: K/V rows [0, bucket_len)
+    written in one pass, each query row masked to its causal prefix —
+    bitwise-identical per row to the token-by-token path), and the
+    logits at position ``true_len - 1`` are captured. Pad positions
+    beyond it do execute (static shapes) but their K/V is never
+    visible: the slot's cursor is set to ``true_len`` and decode
+    overwrites position ``true_len + k`` at step k strictly before the
+    visibility mask reaches it. The mini cache's FULL rows are
+    scattered into the slot, wiping any previous occupant's K/V.
+
+    Returns ``(cache', first_token[int32 scalar])`` — the first generated
+    token is picked here, from the true last-prompt-position logits, so a
+    ``max_new_tokens=1`` request never needs a decode step at all.
+    """
+    total_len = next(
+        leaf.shape[1] for path, leaf in
+        jax.tree_util.tree_leaves_with_path(cache)
+        if _leaf_name(path) == "cached_key")
+    mini = init_cache(model, 1, total_len)
+    true_len = jnp.asarray(true_len, jnp.int32)
+
+    logits, upd = model.apply(
+        {"params": params, "cache": mini}, tokens[None, :],
+        mutable=["cache"])
+    mini = upd["cache"]
+    cap = jax.lax.dynamic_index_in_dim(
+        logits, true_len - 1, axis=1, keepdims=False)
+    first = _pick_tokens(cap, rng, temperature, top_k, top_p)[0]
+
+    slot = jnp.asarray(slot, jnp.int32)
+
+    def merge(path, big, small):
+        name = _leaf_name(path)
+        if name in _CURSOR_LEAVES:
+            return big.at[slot].set(true_len.astype(big.dtype))
+        return big.at[slot].set(small[0])
+
+    cache = jax.tree_util.tree_map_with_path(merge, cache, mini)
+    return cache, first
+
+
+def decode_step(model, params, cache, tokens, idx, temperature=0.0,
+                top_k=None, top_p=None, rng=None):
+    """One fixed-shape decode step over every slot.
+
+    ``tokens [S]`` is each slot's previously emitted token; ``idx [S]``
+    each slot's write cursor (the scheduler's host-side copy — see
+    :func:`_set_cursor_leaves`). Every slot computes (static shapes);
+    the scheduler simply ignores emissions from slots it knows are free.
+    Returns ``(cache', next_tokens [S])``.
+    """
+    cache = _set_cursor_leaves(cache, jnp.asarray(idx, jnp.int32))
+    logits, upd = model.apply(
+        {"params": params, "cache": cache}, tokens[:, None],
+        mutable=["cache"])
+    picked = _pick_tokens(logits[:, -1, :], rng, temperature, top_k, top_p)
+    return upd["cache"], picked
+
+
+@functools.lru_cache(maxsize=32)
+def slot_step_fns(model, temperature=0.0, top_k=None, top_p=None):
+    """(jitted prefill_into_slot, jitted decode_step) for one model +
+    sampling config, cache-donating, reused across engines.
+
+    Compile-count contract (asserted in tests): the decode fn compiles
+    ONCE per (slots, total_len) cache shape; the prefill fn once per
+    bucket length. ``fn._cache_size()`` exposes the live program count —
+    serving.DecodeEngine surfaces both via ``compile_stats()``.
+    """
+    prefill = jax.jit(
+        lambda params, cache, slot, tokens, true_len, key:
+        prefill_into_slot(model, params, cache, slot, tokens, true_len,
+                          temperature=temperature, top_k=top_k,
+                          top_p=top_p, rng=key),
+        donate_argnums=(1,))
+    decode = jax.jit(
+        lambda params, cache, tokens, idx, key:
+        decode_step(model, params, cache, tokens, idx,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    rng=key),
+        donate_argnums=(1,))
+    return prefill, decode
+
+
+def default_buckets(total_len, lo=8):
+    """Power-of-two prompt buckets up to ``total_len``: the compile-count
+    bound for prefill is ``len(default_buckets(...))`` programs."""
+    buckets, b = [], max(2, int(lo))
+    while b < total_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(int(total_len))
+    return tuple(buckets)
+
+
+def bucket_for(length, buckets):
+    """Smallest bucket >= length (raises if the prompt outgrows them)."""
+    for b in buckets:
+        if length <= b:
+            return b
+    raise ValueError(
+        "prompt length {} exceeds the largest bucket {}".format(
+            length, buckets[-1]))
 
 
 @functools.lru_cache(maxsize=64)

@@ -14,6 +14,16 @@ The reference framework has no generation story at all (its inference
 is batch scoring — SURVEY.md §3.3); this is a don't-stop-at-parity
 addition shaped for TPU: static shapes everywhere (cache pre-allocated
 at ``max_len``), decode steps under ``lax.scan``.
+
+The decode cache is SLOT-STRUCTURED for serving (PR 2): the
+``cache_index``/``pos_idx`` cursors are per-row ``[B]`` vectors, so
+each batch row can sit at its own sequence depth — the property
+serving.DecodeEngine's continuous batching rests on. An s>1 call on an
+initialized cache is a fused prefill continuing from each row's cursor
+(one program for the whole prompt instead of an s-step scan),
+formulated per query row exactly like s single-token steps — equal to
+float noise in general and bitwise-equal on the engine's pinned
+serving configs.
 """
 
 import functools
@@ -66,19 +76,22 @@ class CausalSelfAttention(nn.Module):
                 "cache", "cached_key", jnp.zeros, k.shape, k.dtype)
             cached_value = self.variable(
                 "cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+            # Per-ROW write cursor [B], not a scalar: each batch row is an
+            # independent sequence (a serving "slot"), so row b writes its
+            # token at its own position and attends its own prefix. Whole-
+            # batch generation is the degenerate case where every row
+            # carries the same index — bitwise-identical to the old scalar
+            # formulation (the mask/scatter broadcasts agree elementwise).
             cache_index = self.variable(
                 "cache", "cache_index",
-                lambda: jnp.zeros((), jnp.int32))
-            if is_initialized:
+                lambda: jnp.zeros((b,), jnp.int32))
+            if is_initialized and s == 1:
                 # one token per step against the cache prefix
-                if s != 1:
-                    raise ValueError(
-                        "decode mode feeds one token per call, got "
-                        "length {}".format(s))
                 idx = cache_index.value
                 max_len = cached_key.value.shape[1]
-                ck = cached_key.value.at[:, idx].set(k[:, 0])
-                cv = cached_value.value.at[:, idx].set(v[:, 0])
+                rows = jnp.arange(b)
+                ck = cached_key.value.at[rows, idx].set(k[:, 0])
+                cv = cached_value.value.at[rows, idx].set(v[:, 0])
                 cached_key.value = ck
                 cached_value.value = cv
                 cache_index.value = idx + 1
@@ -86,8 +99,43 @@ class CausalSelfAttention(nn.Module):
                 logits = jnp.einsum("bqnd,bknd->bnqk", q, ck,
                                     preferred_element_type=jnp.float32)
                 logits = logits * scale
-                visible = jnp.arange(max_len) <= idx
-                logits = jnp.where(visible[None, None, None, :], logits,
+                visible = jnp.arange(max_len)[None, :] <= idx[:, None]
+                logits = jnp.where(visible[:, None, None, :], logits,
+                                   jnp.finfo(jnp.float32).min)
+                probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
+                ctx = jnp.einsum("bnqk,bknd->bqnd", probs, cv)
+            elif is_initialized:
+                # FUSED PREFILL: an s-token call on an initialized cache
+                # writes K/V rows [idx, idx+s) at each row's own cursor
+                # and attends causally — one program instead of an
+                # s-step scan. Formulated exactly like s single-token
+                # steps (each query row contracts over the FULL cache
+                # length under an arange <= pos mask): mathematically
+                # identical per row, and bitwise-equal on the serving
+                # engine's pinned configs (tests/test_decode_engine.py);
+                # across arbitrary chunk shapes XLA's accumulation
+                # order may differ in the last float bit. A fresh cache
+                # (idx 0) is plain prompt prefill
+                # (generation.prefill_into_slot's mini cache); an
+                # advanced cache gets correct CHUNKED continuation
+                # rather than the silent restart-at-zero a position-0
+                # assumption would produce.
+                idx = cache_index.value
+                max_len = cached_key.value.shape[1]
+                rows = jnp.arange(b)[:, None]
+                pos = idx[:, None] + jnp.arange(s)[None, :]  # [B, s]
+                ck = cached_key.value.at[rows, pos].set(k)
+                cv = cached_value.value.at[rows, pos].set(v)
+                cached_key.value = ck
+                cached_value.value = cv
+                cache_index.value = idx + s
+                scale = head_dim ** -0.5
+                logits = jnp.einsum("bqnd,bknd->bnqk", q, ck,
+                                    preferred_element_type=jnp.float32)
+                logits = logits * scale
+                visible = (jnp.arange(max_len)[None, None, :]
+                           <= pos[:, :, None])  # [B, s, max_len]
+                logits = jnp.where(visible[:, None, :, :], logits,
                                    jnp.finfo(jnp.float32).min)
                 probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
                 ctx = jnp.einsum("bnqk,bknd->bqnd", probs, cv)
@@ -126,7 +174,8 @@ class DecoderLM(nn.Module):
 
     ``decode=True`` instances carry the KV cache: init it by running a
     full-length dummy input with ``init`` (flax materializes the cache at
-    that length), then feed one token at a time.
+    that length), then feed one token at a time — or a whole prompt at
+    once (fused prefill from position 0) on a fresh cache.
     """
 
     vocab: int
@@ -146,17 +195,24 @@ class DecoderLM(nn.Module):
         if self.decode:
             # the LM tracks its own position alongside the attention KV
             # caches (the flax lm1b pattern): 0 during cache init (the
-            # full-length dummy pass), then advancing by s per call
-            from jax import lax
-
+            # full-length dummy pass), then advancing by s per call.
+            # Like the attention cache_index, the position cursor is
+            # per-ROW [B] so each slot decodes at its own depth.
             initializing = not self.has_variable("cache", "pos_idx")
             pos_idx = self.variable("cache", "pos_idx",
-                                    lambda: jnp.zeros((), jnp.int32))
-            pos = jnp.where(initializing, 0, pos_idx.value)
-            x = x + lax.dynamic_slice(
-                pos_embed, (pos.astype(jnp.int32), 0),
-                (s, self.hidden))[None]
-            if not initializing:
+                                    lambda: jnp.zeros((b,), jnp.int32))
+            if initializing:
+                # full-length dummy pass: positions 0..s-1, all rows
+                x = x + pos_embed[:s][None]
+            elif s == 1:
+                x = x + jnp.take(pos_embed, pos_idx.value,
+                                 axis=0)[:, None, :]
+                pos_idx.value = pos_idx.value + s
+            else:
+                # fused prefill: positions continue from each row's own
+                # cursor (see CausalSelfAttention's prefill branch)
+                pos = pos_idx.value[:, None] + jnp.arange(s)[None, :]
+                x = x + jnp.take(pos_embed, pos, axis=0)
                 pos_idx.value = pos_idx.value + s
         else:
             x = x + pos_embed[:s][None]

@@ -24,16 +24,463 @@ Start in-process (:class:`ModelServer`) or from a shell::
 This is deliberately protocol-compatible with TF-Serving's REST surface
 for the predict/metadata paths a Spark-Scala client uses, so reference
 users' JVM-side HTTP code ports by changing the URL.
+
+Two batching planes live here, serving different traffic shapes:
+
+- :class:`_Batcher` — a collection-window coalescer for the GENERIC
+  predict path (any exported apply_fn): same-signature concurrent
+  requests merge into one model call. Run-to-completion: a merged group
+  occupies the model until every row finishes. Kept as the baseline the
+  serving bench measures against.
+- :class:`DecodeEngine` — CONTINUOUS batching for the decoder-LM path:
+  a scheduler thread owns a slot-structured KV cache and a single
+  fixed-shape decode step; requests enter freed slots at step
+  boundaries, exit individually on EOS/length, and prefill through
+  shape buckets so compiles stay O(buckets), not O(request signatures).
+  Mounted on a server it serves ``POST /v1/models/<name>:generate``.
 """
 
+import collections
 import json
 import logging
+import queue as queue_mod
 import threading
 import time
 
 import numpy as np
 
 logger = logging.getLogger(__name__)
+
+_STREAM_DONE = object()
+
+
+class GenerationHandle(object):
+    """One in-flight generation request against a :class:`DecodeEngine`.
+
+    The scheduler thread emits tokens into the handle as each decode
+    step completes; clients either iterate :meth:`stream` (tokens arrive
+    one by one, the continuous-batching point) or block on
+    :meth:`result` for the full sequence. ``latency`` is submit-to-
+    completion wall time, the number the serving bench percentiles.
+    """
+
+    def __init__(self, prompt, max_new_tokens):
+        # constructed by DecodeEngine AFTER validate() normalized both
+        self.prompt = list(prompt)
+        self.max_new_tokens = max_new_tokens
+        self.submitted = time.monotonic()
+        self.completed = None
+        self._tokens = []
+        self._q = queue_mod.Queue()
+        self._done = threading.Event()
+        self._error = None
+
+    # -- scheduler side --------------------------------------------------
+
+    def _emit(self, token):
+        self._tokens.append(int(token))
+        self._q.put(int(token))
+
+    def _finish(self, error=None):
+        self._error = error
+        self.completed = time.monotonic()
+        self._done.set()
+        self._q.put(_STREAM_DONE)
+
+    # -- client side -----------------------------------------------------
+
+    def stream(self, timeout=600.0):
+        """Yield generated tokens as the engine emits them. ``timeout``
+        bounds the wait for EACH token (TimeoutError, matching
+        :meth:`result`'s surface)."""
+        while True:
+            try:
+                item = self._q.get(timeout=timeout)
+            except queue_mod.Empty:
+                raise TimeoutError(
+                    "no token within {}s".format(timeout))
+            if item is _STREAM_DONE:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item
+
+    def result(self, timeout=600.0):
+        """Block until complete; returns prompt + generated tokens."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                "generation did not complete within {}s".format(timeout))
+        if self._error is not None:
+            raise self._error
+        return list(self.prompt) + list(self._tokens)
+
+    @property
+    def generated(self):
+        """Tokens emitted so far (complete once :meth:`result` returns)."""
+        return list(self._tokens)
+
+    @property
+    def latency(self):
+        return (self.completed - self.submitted) \
+            if self.completed is not None else None
+
+
+class QueueFull(RuntimeError):
+    """The engine's admission queue is at ``max_queue`` — backpressure;
+    retry later. The HTTP surface answers 429 instead of queueing work
+    for a client that will have timed out by the time it decodes."""
+
+
+class DecodeEngine(object):
+    """Continuous-batching decode engine over a slot-structured KV cache.
+
+    The serving answer to ``generate_jit``'s run-to-completion shape
+    (and the window ``_Batcher``'s group-by-identical-signature shape):
+    a persistent scheduler thread owns ONE ``[slots, total_len]`` KV
+    cache and runs a fixed-shape decode step over it forever. Each of
+    the S slots independently holds one in-flight sequence at its own
+    position; requests are admitted into freed slots at decode-step
+    boundaries (no run-to-max groups), exit individually on EOS or
+    length, and prompts prefill through shape BUCKETS (padded to the
+    next bucket length), so the whole engine compiles
+
+        1 decode program per (slots, total_len) config
+      + 1 prefill program per bucket
+
+    instead of one whole-generation program per (batch, prompt_len,
+    max_new) request signature. At ``temperature=0`` each request's
+    output is bitwise-identical to a solo ``generation.generate`` call
+    (pinned in tests/test_decode_engine.py).
+
+    Args:
+      model: decode-mode DecoderLM-family flax module (``decode=True``).
+      params: its parameters.
+      slots: concurrent sequences (S). Throughput lever.
+      total_len: cache length per slot; every request needs
+        ``len(prompt) + max_new_tokens <= total_len``. Defaults to
+        ``model.max_len``.
+      buckets: ascending prefill bucket lengths (default: powers of two
+        up to ``total_len``). Compile-count lever.
+      temperature/top_k/top_p: sampling config (engine-wide; one
+        program serves every request). 0 = greedy.
+      eos_token: emitting it completes a request (eos included in the
+        output, nothing after it — the slot frees immediately).
+      rng: PRNG key for sampling (ignored at temperature=0).
+      counters/timers: optional tracing.Counters / tracing.StageTimers
+        to share; fresh ones are created otherwise and exposed as
+        attributes. Counters: queue_depth + slot_occupancy gauges,
+        tokens / decode_tokens / decode_steps / prefills /
+        requests_completed counts (decode_tokens excludes the
+        prefill-emitted first token, so decode occupancy stays bounded
+        by ``slots``).
+      max_queue: admission-queue bound — ``submit`` raises
+        :class:`QueueFull` once this many requests are waiting for a
+        slot (None = unbounded). Backpressure, not fairness: without
+        it, sustained overload grows the queue without limit while
+        every client times out and abandons work the engine still
+        decodes to completion.
+    """
+
+    def __init__(self, model, params, slots=8, total_len=None,
+                 buckets=None, temperature=0.0, top_k=None, top_p=None,
+                 eos_token=None, rng=None, counters=None, timers=None,
+                 max_queue=1024):
+        import jax
+
+        from tensorflowonspark_tpu import generation, tracing
+
+        self._generation = generation
+        total_len = int(total_len or model.max_len)
+        if total_len > model.max_len:
+            raise ValueError(
+                "total_len {} exceeds model.max_len {}".format(
+                    total_len, model.max_len))
+        if int(slots) < 1:
+            raise ValueError("slots must be >= 1, got {}".format(slots))
+        self.model = model
+        self.params = params
+        self.slots = int(slots)
+        self.total_len = total_len
+        self.buckets = tuple(sorted(int(b) for b in buckets)) if buckets \
+            else generation.default_buckets(total_len)
+        if self.buckets[-1] > total_len:
+            raise ValueError(
+                "largest bucket {} exceeds total_len {}".format(
+                    self.buckets[-1], total_len))
+        self.eos_token = None if eos_token is None else int(eos_token)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        # same fail-loudly contract as generation.generate: top_k=0 /
+        # top_p=0 would mask every logit and serve token 0 engine-wide
+        generation.check_sampling_config(temperature, top_k, top_p, rng)
+        self.counters = counters if counters is not None \
+            else tracing.Counters()
+        self.timers = timers if timers is not None else tracing.StageTimers()
+        self._temperature = float(temperature)
+        self._prefill_fn, self._decode_fn = generation.slot_step_fns(
+            model, self._temperature,
+            None if top_k is None else int(top_k),
+            None if top_p is None else float(top_p))
+        self._key = rng if rng is not None else jax.random.PRNGKey(0)
+        self._queue = collections.deque()
+        self._cv = threading.Condition()
+        self._stopping = False
+        self._broken = None
+        self._slot_req = [None] * self.slots
+        self._idx = np.zeros(self.slots, np.int32)
+        self._last = np.zeros(self.slots, np.int32)
+        self._cache = generation.init_cache(model, self.slots, total_len)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="tfos-decode-engine")
+        self._thread.start()
+
+    # -- client API ------------------------------------------------------
+
+    def validate(self, prompt, max_new_tokens):
+        """Raise ValueError/TypeError if the request cannot be served;
+        returns the normalized ``(prompt, max_new)``. Exposed so batch
+        callers (ModelServer.generate) can vet a WHOLE body before
+        submitting any of it — a mid-batch reject must not leave earlier
+        prompts decoding for a client that already got its 400."""
+        prompt = [int(t) for t in prompt]
+        max_new = int(max_new_tokens)
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        vocab = getattr(self.model, "vocab", None)
+        if vocab is not None:
+            bad = next((t for t in prompt if not 0 <= t < vocab), None)
+            if bad is not None:
+                # nn.Embed would silently CLAMP out-of-range ids inside
+                # jit — the client must get a 400, not a generation for
+                # a prompt it never sent
+                raise ValueError(
+                    "prompt token {} outside vocab [0, {})".format(
+                        bad, vocab))
+        if max_new < 0:
+            raise ValueError(
+                "max_new_tokens must be >= 0, got {}".format(max_new))
+        # raises if the prompt outgrows every bucket:
+        self._generation.bucket_for(len(prompt), self.buckets)
+        if len(prompt) + max_new > self.total_len:
+            raise ValueError(
+                "prompt {} + max_new_tokens {} exceeds total_len {}".format(
+                    len(prompt), max_new, self.total_len))
+        return prompt, max_new
+
+    def submit(self, prompt, max_new_tokens):
+        """Queue one request; returns its :class:`GenerationHandle`.
+
+        Validation happens HERE, on the caller's thread, so a malformed
+        request raises to its client instead of poisoning the shared
+        scheduler loop (same discipline as ``_Batcher.submit``).
+        """
+        return self._submit_validated(*self.validate(prompt,
+                                                     max_new_tokens))
+
+    def _submit_validated(self, prompt, max_new):
+        """submit() minus validation — for callers (ModelServer.generate)
+        that already ran :meth:`validate` over a whole body."""
+        return self._submit_many([(prompt, max_new)])[0]
+
+    def _submit_many(self, vetted):
+        """Atomically queue a whole vetted body: either every request is
+        admitted or none is (QueueFull / stopped / broken raise before
+        any handle exists), so a mid-batch refusal never leaves earlier
+        prompts of the same body decoding for a client that already got
+        its error. max_new==0 requests complete inline (the prompt IS
+        the answer) but still pass the liveness checks — a dead engine
+        must refuse degenerate requests as loudly as real ones."""
+        with self._cv:
+            if self._stopping:
+                raise RuntimeError("engine stopped")
+            if self._broken is not None:
+                raise RuntimeError(
+                    "engine failed: {}".format(self._broken))
+            queueing = sum(1 for _, mn in vetted if mn > 0)
+            if self.max_queue is not None and \
+                    len(self._queue) + queueing > self.max_queue:
+                raise QueueFull(
+                    "admission queue full ({} waiting, max_queue {})"
+                    .format(len(self._queue), self.max_queue))
+            handles = []
+            for prompt, max_new in vetted:
+                handle = GenerationHandle(prompt, max_new)
+                if max_new == 0:
+                    handle._finish()
+                else:
+                    self._queue.append(handle)
+                handles.append(handle)
+            if queueing:
+                self.counters.gauge("queue_depth", len(self._queue))
+                self._cv.notify()
+        return handles
+
+    def generate(self, prompt, max_new_tokens, timeout=600.0):
+        """Blocking convenience: submit + result."""
+        return self.submit(prompt, max_new_tokens).result(timeout)
+
+    def compile_stats(self):
+        """Live program counts for the engine's jitted fns (shared per
+        (model, sampling-config) via ``generation.slot_step_fns``, so
+        the counts span every engine on that pair — the compile-bound
+        contract the tests assert). ``_cache_size`` is private jax jit
+        API; counts come back None if a jax upgrade drops it, so stats
+        degrade instead of breaking the serving path."""
+        def n_programs(fn):
+            size = getattr(fn, "_cache_size", None)
+            return size() if callable(size) else None
+        return {"decode_programs": n_programs(self._decode_fn),
+                "prefill_programs": n_programs(self._prefill_fn),
+                "buckets": len(self.buckets)}
+
+    def stop(self):
+        """Stop the scheduler; queued and in-flight requests fail fast
+        with RuntimeError (drain with ``handle.result()`` BEFORE stop if
+        you need completions). Idempotent.
+
+        The LOOP owns failing the outstanding handles (its exit path),
+        never this thread: if the scheduler is wedged inside a long
+        device call past the join timeout, mutating its slot state here
+        would race it — instead we log and leave the handles to be
+        failed whenever the loop next reaches its stopping check."""
+        with self._cv:
+            if self._stopping:
+                return
+            self._stopping = True
+            self._cv.notify_all()
+        self._thread.join(timeout=30)
+        if self._thread.is_alive():
+            logger.warning(
+                "decode engine scheduler still inside a device call "
+                "after 30s; outstanding requests will fail when it "
+                "returns")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- scheduler loop --------------------------------------------------
+
+    def _next_key(self):
+        import jax
+
+        if not self._temperature:
+            return self._key  # greedy pick never consumes it
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _active_slots(self):
+        return [s for s in range(self.slots)
+                if self._slot_req[s] is not None]
+
+    def _loop(self):
+        import jax.numpy as jnp
+
+        try:
+            while True:
+                with self._cv:
+                    while (not self._stopping and not self._queue
+                           and not self._active_slots()):
+                        self._cv.wait()
+                    if self._stopping:
+                        self._fail_outstanding(
+                            RuntimeError("engine stopped"))
+                        return
+                    admits = []
+                    for s in range(self.slots):
+                        if self._slot_req[s] is None and self._queue:
+                            handle = self._queue.popleft()
+                            # occupy the slot AT pop time: every popped
+                            # handle must be findable by the failure
+                            # paths (_fail_outstanding) even if an
+                            # EARLIER admit's prefill dies before this
+                            # one runs
+                            self._slot_req[s] = handle
+                            admits.append((s, handle))
+                    self.counters.gauge("queue_depth", len(self._queue))
+                # prefill OUTSIDE the lock: submit() must never block on
+                # device work
+                for s, handle in admits:
+                    self._admit(s, handle)
+                active = self._active_slots()
+                self.counters.gauge("slot_occupancy", len(active))
+                if not active:
+                    continue
+                with self.timers.timed("decode_step"):
+                    self._cache, toks = self._decode_fn(
+                        self.params, self._cache, jnp.asarray(self._last),
+                        jnp.asarray(self._idx), self._next_key())
+                    toks = np.asarray(toks)  # the per-step host sync
+                self.counters.inc("decode_steps")
+                with self.timers.timed("host_schedule"):
+                    for s in active:
+                        # the step just WROTE the fed token at _idx[s]:
+                        # advance the cursor, then deliver the emission
+                        self._idx[s] += 1
+                        self._deliver(s, int(toks[s]))
+                    self.counters.inc("tokens", len(active))
+                    # decode_tokens excludes prefill-emitted firsts, so
+                    # rate("decode_tokens", "decode_steps") is true
+                    # decode occupancy (bounded by slots)
+                    self.counters.inc("decode_tokens", len(active))
+        except BaseException as e:  # noqa: BLE001 - fail every client
+            logger.exception("decode engine loop died")
+            with self._cv:
+                self._broken = e
+                self._fail_outstanding(
+                    RuntimeError("decode engine failed: {}".format(e)))
+
+    def _fail_outstanding(self, err):
+        """Fail every queued and in-flight handle (scheduler thread
+        only, caller holds ``_cv``): the loop's exit paths — stop and
+        death — both land here so no client is ever stranded."""
+        failed = [self._slot_req[s] for s in self._active_slots()]
+        for s in range(self.slots):
+            self._slot_req[s] = None
+        failed.extend(self._queue)
+        self._queue.clear()
+        for handle in failed:
+            handle._finish(err)
+
+    def _admit(self, slot, handle):
+        """Prefill ``handle``'s prompt into ``slot`` and emit its first
+        token (a max_new_tokens=1 request completes right here)."""
+        import jax.numpy as jnp
+
+        n = len(handle.prompt)
+        bucket = self._generation.bucket_for(n, self.buckets)
+        toks = np.zeros(bucket, np.int32)
+        toks[:n] = handle.prompt
+        # (the slot was occupied at pop time, so if this prefill dies
+        # the loop's failure path finds the handle in _slot_req instead
+        # of stranding its client on a timeout)
+        with self.timers.timed("prefill"):
+            self._cache, first = self._prefill_fn(
+                self.params, self._cache, jnp.int32(slot),
+                jnp.asarray(toks), jnp.int32(n), self._next_key())
+            first = int(first)
+        self.counters.inc("prefills")
+        self._idx[slot] = n
+        self._last[slot] = first
+        self._deliver(slot, first)
+        self.counters.inc("tokens")
+
+    def _deliver(self, slot, token):
+        """Append one emitted token to the slot's request; complete and
+        free the slot on EOS or length. Cursor discipline: ``_idx[slot]``
+        always holds the position where ``_last[slot]`` will be written
+        by the NEXT decode step (the caller advances it for tokens that
+        are already in the cache)."""
+        handle = self._slot_req[slot]
+        handle._emit(token)
+        self._last[slot] = token
+        done = (self.eos_token is not None and token == self.eos_token) \
+            or len(handle._tokens) >= handle.max_new_tokens
+        if done:
+            handle._finish()
+            self._slot_req[slot] = None
+            self.counters.inc("requests_completed")
 
 
 class _BadRequest(ValueError):
@@ -314,17 +761,28 @@ class ModelServer(object):
     """
 
     def __init__(self, model_dir, name="model", host="127.0.0.1", port=8501,
-                 batch_window_ms=0):
+                 batch_window_ms=0, engine=None):
         from tensorflowonspark_tpu import export as export_lib
 
-        apply_fn, variables, signature = export_lib.load_model(model_dir)
+        if model_dir is not None:
+            apply_fn, variables, signature = export_lib.load_model(model_dir)
+        elif engine is None:
+            raise ValueError("ModelServer needs a model_dir, an engine, "
+                             "or both")
+        else:  # engine-only server: :generate is the whole surface
+            apply_fn, variables, signature = None, None, {}
         self.name = name
         self.signature = signature or {}
         self._apply = apply_fn
         self._variables = variables
         self._lock = threading.Lock()  # one owner: requests serialize
         self._batcher = (_Batcher(apply_fn, variables, batch_window_ms)
-                         if batch_window_ms else None)
+                         if batch_window_ms and apply_fn is not None
+                         else None)
+        #: optional DecodeEngine behind POST :generate — the continuous-
+        #: batching LM path; concurrent HTTP requests just submit() and
+        #: the engine's scheduler interleaves them at step granularity
+        self.engine = engine
         self._httpd = None
         self._thread = None
         self._host, self._port = host, port
@@ -333,6 +791,10 @@ class ModelServer(object):
 
     def predict(self, payload):
         """{'instances'|'inputs': ...} -> TF-Serving response dict."""
+        if self._apply is None:
+            raise _BadRequest(
+                "no exported model mounted; this server only serves "
+                ":generate (decode engine)")
         row_format = "instances" in payload
         batch = _to_batch(payload, self.signature)
         if self._batcher is not None:
@@ -341,6 +803,47 @@ class ModelServer(object):
             with self._lock:
                 outputs = self._apply(self._variables, batch)
         return _to_json(outputs, row_format)
+
+    def generate(self, payload):
+        """{'prompt': [[...], ...], 'max_new_tokens': N} -> {'tokens': ...}.
+
+        Each prompt becomes one engine request; the handles resolve
+        concurrently (slot-interleaved), so a multi-prompt body — or many
+        single-prompt clients — shares the same decode steps. A single
+        flat prompt list is accepted and answered un-nested.
+        """
+        # snapshot: stop() nulls the attribute, and a handler already
+        # past this check must reach the engine's own clean "stopped"
+        # refusal rather than an AttributeError 500
+        engine = self.engine
+        if engine is None:
+            raise _BadRequest("no decode engine mounted on this server")
+        if not isinstance(payload, dict) or "prompt" not in payload:
+            raise _BadRequest("request needs a 'prompt' field")
+        prompts = payload["prompt"]
+        if not isinstance(prompts, list) or not prompts:
+            raise _BadRequest("'prompt' must be a non-empty list")
+        flat = not isinstance(prompts[0], (list, tuple))
+        if flat:
+            prompts = [prompts]
+        max_new = payload.get("max_new_tokens", 16)
+        try:
+            max_new = int(max_new)
+        except (TypeError, ValueError):
+            raise _BadRequest("max_new_tokens must be an integer")
+        try:
+            # vet the WHOLE body before submitting any of it: a 400 must
+            # not leave earlier prompts of the same body decoding for a
+            # client that already got its error
+            vetted = [engine.validate(p, max_new) for p in prompts]
+        except (ValueError, TypeError) as e:
+            raise _BadRequest(str(e))
+        # atomic whole-body admission: QueueFull surfaces as 429 with
+        # nothing queued, instead of part of the body decoding for a
+        # client that got an error
+        handles = engine._submit_many(vetted)
+        tokens = [h.result() for h in handles]
+        return {"tokens": tokens[0] if flat else tokens}
 
     def metadata(self):
         return {"model_spec": {"name": self.name,
@@ -379,18 +882,26 @@ class ModelServer(object):
                 return self._send(404, {"error": "not found: %s" % self.path})
 
             def do_POST(self):
-                if self.path != "/v1/models/%s:predict" % server.name:
+                routes = {"/v1/models/%s:predict" % server.name:
+                          server.predict,
+                          "/v1/models/%s:generate" % server.name:
+                          server.generate}
+                handler = routes.get(self.path)
+                if handler is None:
                     return self._send(404,
                                       {"error": "not found: %s" % self.path})
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     payload = json.loads(self.rfile.read(n) or b"{}")
-                    return self._send(200, server.predict(payload))
+                    return self._send(200, handler(payload))
                 except (_BadRequest, json.JSONDecodeError) as e:
                     # malformed JSON is the client's fault: 400, not 500
                     return self._send(400, {"error": str(e)})
+                except QueueFull as e:
+                    # backpressure, not failure: retry later
+                    return self._send(429, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 - surface as 500
-                    logger.exception("predict failed")
+                    logger.exception("%s failed", self.path)
                     return self._send(500, {"error": str(e)})
 
             def log_message(self, fmt, *args):  # quiet by default
@@ -414,6 +925,9 @@ class ModelServer(object):
         if self._batcher is not None:
             self._batcher.stop()
             self._batcher = None
+        if self.engine is not None:
+            self.engine.stop()
+            self.engine = None
 
     def __enter__(self):
         self.start()

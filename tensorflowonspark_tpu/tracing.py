@@ -20,6 +20,10 @@ code; its own plumbing is unobservable. Here the framework exposes:
   bench.py / scripts/profile_fed.py surface it next to
   ``fed_frac_of_device`` — the remaining feed loss is attributed to a
   stage instead of unexplained.
+- :class:`Counters` — named monotonic counters + gauges for scheduler
+  loops: serving.DecodeEngine exports queue depth, slot occupancy, and
+  tokens-per-step through one of these, and bench.py / scripts/
+  profile_serving.py read the snapshots.
 """
 
 import logging
@@ -67,6 +71,43 @@ class StageTimers(object):
         breakdown bench.py and profile_fed.py print."""
         return {k: round(v * 1000.0 / max(self._n.get(k, 1), 1), 3)
                 for k, v in self._t.items()}
+
+
+class Counters(object):
+    """Named monotonic counters + gauges for a serving/scheduler loop.
+
+    The feed plane's :class:`StageTimers` answers "where did the time
+    go"; this answers "what did the loop do" — requests queued, slots
+    occupied, tokens emitted per step. Single-writer convention (the
+    owning scheduler thread); readers take :meth:`snapshot` copies, so
+    the unlocked dict ops are benign under the GIL exactly like
+    StageTimers' adds.
+    """
+
+    __slots__ = ("_counts", "_gauges")
+
+    def __init__(self):
+        self._counts = {}
+        self._gauges = {}
+
+    def inc(self, name, n=1):
+        """Add ``n`` to monotonic counter ``name``."""
+        self._counts[name] = self._counts.get(name, 0) + n
+
+    def gauge(self, name, value):
+        """Set instantaneous gauge ``name`` (e.g. queue depth)."""
+        self._gauges[name] = value
+
+    def snapshot(self):
+        """{"counts": {...}, "gauges": {...}} — stable copies."""
+        return {"counts": dict(self._counts), "gauges": dict(self._gauges)}
+
+    def rate(self, numerator, denominator):
+        """counts[numerator] / counts[denominator] (0 when empty) — e.g.
+        ``rate("decode_tokens", "decode_steps")`` = mean decode
+        occupancy per step."""
+        d = self._counts.get(denominator, 0)
+        return self._counts.get(numerator, 0) / d if d else 0.0
 
 
 class _StageSpan(object):

@@ -1,0 +1,256 @@
+"""Continuous-batching decode engine invariants (serving.DecodeEngine).
+
+The engine's whole contract is that slot-structured continuous batching
+is INVISIBLE to each request: at temperature=0 a request's output must
+be bitwise-identical to a solo ``generation.generate`` call, regardless
+of what the other slots are doing, how often its slot was previously
+occupied, or which shape bucket its prompt padded into. Plus the perf
+contract that motivates the design: compile count stays O(buckets),
+not O(request signatures).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import generation, serving
+from tensorflowonspark_tpu.models.decoder import DecoderLM
+
+V, H, NH, L, MAXLEN = 17, 32, 4, 2, 48
+
+
+@pytest.fixture(scope="module")
+def lm():
+    train = DecoderLM(vocab=V, hidden=H, num_heads=NH, num_layers=L,
+                      max_len=MAXLEN, decode=False)
+    dec = DecoderLM(vocab=V, hidden=H, num_heads=NH, num_layers=L,
+                    max_len=MAXLEN, decode=True)
+    params = train.init(jax.random.PRNGKey(7),
+                        jnp.zeros((2, MAXLEN), jnp.int32))["params"]
+    return dec, params
+
+
+def _solo(dec, params, prompt, max_new, **kw):
+    out = generation.generate_jit(
+        dec, params, jnp.asarray([prompt], jnp.int32), max_new, **kw)
+    return np.asarray(out)[0].tolist()
+
+
+def _mixed_requests(rng, n, lo_p=3, hi_p=12, lo_n=1, hi_n=10):
+    reqs = []
+    for _ in range(n):
+        p = rng.randint(0, V, size=rng.randint(lo_p, hi_p)).tolist()
+        mn = int(rng.randint(lo_n, hi_n))
+        reqs.append((p, min(mn, MAXLEN - len(p))))
+    return reqs
+
+
+def test_temp0_bitwise_identical_to_solo_generate(lm):
+    """The acceptance pin: mixed-length requests through a shared
+    2-slot engine emit EXACTLY the tokens each would get alone."""
+    dec, params = lm
+    reqs = _mixed_requests(np.random.RandomState(0), 6)
+    want = [_solo(dec, params, p, mn) for p, mn in reqs]
+    with serving.DecodeEngine(dec, params, slots=2) as eng:
+        handles = [eng.submit(p, mn) for p, mn in reqs]
+        got = [h.result(300) for h in handles]
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert g == w, (i, g, w)
+
+
+def test_no_cross_slot_logit_leakage(lm):
+    """A request's tokens must not change with slot COMPANY: run one
+    request alone (its neighbor slot idle/masked), then crowded among
+    five concurrent others — identical output both times, so neither
+    idle slots nor foreign active sequences perturb its logits."""
+    dec, params = lm
+    rng = np.random.RandomState(1)
+    probe = (rng.randint(0, V, size=7).tolist(), 9)
+    others = _mixed_requests(rng, 5)
+    with serving.DecodeEngine(dec, params, slots=2) as eng:
+        alone = eng.submit(*probe).result(300)
+    with serving.DecodeEngine(dec, params, slots=2) as eng:
+        hs = [eng.submit(p, mn) for p, mn in others[:2]]
+        hp = eng.submit(*probe)
+        hs += [eng.submit(p, mn) for p, mn in others[2:]]
+        crowded = hp.result(300)
+        for h in hs:
+            h.result(300)
+    assert alone == crowded
+
+
+def test_slot_reuse_after_eos_has_no_cache_bleed(lm):
+    """A 1-slot engine forces every request through the SAME slot, each
+    admission overwriting the previous occupant's cache rows; with an
+    eos that fires mid-sequence the slot frees early and the next
+    request must still match its solo rollout bitwise."""
+    dec, params = lm
+    rng = np.random.RandomState(2)
+    # choose as eos a token the greedy rollout actually emits, so the
+    # early-exit path (slot freed before max_new) really executes
+    first = rng.randint(0, V, size=5).tolist()
+    base = _solo(dec, params, first, 10)
+    eos = base[len(first) + 1]
+    reqs = [(first, 10)] + _mixed_requests(rng, 4)
+    want = []
+    for p, mn in reqs:
+        solo = _solo(dec, params, p, mn, eos_token=eos)
+        gen = solo[len(p):]
+        if eos in gen:  # engine semantics: truncate at (and keep) eos
+            gen = gen[:gen.index(eos) + 1]
+        want.append(p + gen)
+    with serving.DecodeEngine(dec, params, slots=1, eos_token=eos) as eng:
+        got = [eng.submit(p, mn).result(300) for p, mn in reqs]
+    assert got == want
+    # the eos path genuinely fired early on the seeded first request
+    assert got[0][-1] == eos and len(got[0]) < len(first) + 10
+
+
+def test_compile_count_bounded_by_buckets(lm):
+    """The perf contract: a workload of many DISTINCT (prompt_len,
+    max_new) signatures compiles one decode program per engine config
+    plus at most one prefill program per touched bucket — while the
+    old whole-generation path would compile once per signature."""
+    # a dedicated model config so generation.slot_step_fns' lru cache
+    # entry (and its program counts) belongs to this test alone
+    train = DecoderLM(vocab=V, hidden=H, num_heads=NH, num_layers=1,
+                      max_len=64, decode=False)
+    dec = DecoderLM(vocab=V, hidden=H, num_heads=NH, num_layers=1,
+                    max_len=64, decode=True)
+    params = train.init(jax.random.PRNGKey(3),
+                        jnp.zeros((1, 64), jnp.int32))["params"]
+    rng = np.random.RandomState(3)
+    reqs = [(rng.randint(0, V, size=n).tolist(), int(rng.randint(1, 9)))
+            for n in (2, 3, 5, 7, 9, 12, 17, 21, 29, 33)]
+    signatures = {(len(p), mn) for p, mn in reqs}
+    assert len(signatures) == len(reqs)  # genuinely mixed workload
+    with serving.DecodeEngine(dec, params, slots=4) as eng:
+        buckets = eng.buckets
+        touched = {generation.bucket_for(len(p), buckets)
+                   for p, mn in reqs}
+        for h in [eng.submit(p, mn) for p, mn in reqs]:
+            h.result(300)
+        stats = eng.compile_stats()
+    assert stats["decode_programs"] == 1, stats
+    assert stats["prefill_programs"] == len(touched), (stats, touched)
+    assert stats["prefill_programs"] <= len(buckets)
+
+
+def test_max_new_one_and_zero_paths(lm):
+    """max_new=1 completes at prefill (no decode step); max_new=0 never
+    touches the device and returns the prompt."""
+    dec, params = lm
+    prompt = [1, 2, 3, 4]
+    want = _solo(dec, params, prompt, 1)
+    with serving.DecodeEngine(dec, params, slots=2) as eng:
+        h1 = eng.submit(prompt, 1)
+        h0 = eng.submit(prompt, 0)
+        assert h1.result(300) == want
+        assert h0.result(300) == prompt
+        snap = eng.counters.snapshot()["counts"]
+    assert snap.get("decode_steps", 0) == 0, snap
+    assert snap["prefills"] == 1, snap
+
+
+def test_submit_validation(lm):
+    dec, params = lm
+    with serving.DecodeEngine(dec, params, slots=1,
+                              total_len=32) as eng:
+        with pytest.raises(ValueError, match="non-empty"):
+            eng.submit([], 4)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.submit([1, 2], -1)
+        with pytest.raises(ValueError, match="bucket"):
+            eng.submit([1] * 33, 1)
+        with pytest.raises(ValueError, match="vocab"):
+            eng.submit([1, 99999], 1)
+        with pytest.raises(ValueError, match="vocab"):
+            eng.submit([-5], 1)
+        with pytest.raises(ValueError, match="total_len"):
+            eng.submit([1] * 30, 8)
+    with pytest.raises(RuntimeError, match="stopped"):
+        eng.submit([1], 1)
+    # the degenerate max_new=0 path must hit the same liveness checks:
+    # a dead engine answering a probe with success reads as healthy
+    with pytest.raises(RuntimeError, match="stopped"):
+        eng.submit([1], 0)
+
+
+def test_engine_rejects_bad_sampling_config(lm):
+    """The engine shares generate()'s sampling checks: a config that
+    would serve silently wrong tokens must refuse at construction."""
+    dec, params = lm
+    with pytest.raises(ValueError, match="top_k"):
+        serving.DecodeEngine(dec, params, slots=1, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        serving.DecodeEngine(dec, params, slots=1, top_p=0.0)
+    with pytest.raises(ValueError, match="PRNG"):
+        serving.DecodeEngine(dec, params, slots=1, temperature=0.8)
+
+
+def test_queue_full_backpressure(lm):
+    """submit() past max_queue raises QueueFull with nothing queued —
+    and a multi-request body is all-or-nothing."""
+    dec, params = lm
+    with serving.DecodeEngine(dec, params, slots=1, max_queue=2) as eng:
+        blocker = eng.submit([1, 2], 40)  # holds the single slot
+        deadline = time.monotonic() + 60
+        while eng.counters.snapshot()["counts"].get("prefills", 0) < 1:
+            assert time.monotonic() < deadline, "blocker never admitted"
+            time.sleep(0.01)
+        eng.submit([1], 4)
+        eng.submit([2], 4)  # queue now at max_queue=2
+        with pytest.raises(serving.QueueFull, match="max_queue"):
+            eng.submit([3], 4)
+        # atomic body admission: 2 queued + 2 more > max_queue, so the
+        # WHOLE body refuses and queue_depth is unchanged
+        depth_before = eng.counters.snapshot()["gauges"]["queue_depth"]
+        with pytest.raises(serving.QueueFull):
+            eng._submit_many([([4], 4), ([5], 4)])
+        depth = eng.counters.snapshot()["gauges"]["queue_depth"]
+        assert depth == depth_before
+        blocker.result(300)  # drain so stop() isn't racing live decode
+
+
+def test_streaming_and_counters(lm):
+    """stream() yields tokens incrementally; the tracing.Counters
+    export (queue depth / slot occupancy / tokens-per-step) reflects
+    the run."""
+    dec, params = lm
+    prompt = [3, 1, 4, 1]
+    want = _solo(dec, params, prompt, 8)
+    with serving.DecodeEngine(dec, params, slots=2) as eng:
+        h = eng.submit(prompt, 8)
+        streamed = list(h.stream(timeout=300))
+        snap = eng.counters.snapshot()
+        tps = eng.counters.rate("decode_tokens", "decode_steps")
+    assert prompt + streamed == want
+    assert h.latency is not None and h.latency >= 0
+    assert snap["counts"]["tokens"] == 8
+    # the prefill-emitted first token is counted in "tokens" but NOT in
+    # "decode_tokens", so occupancy stays bounded by the slot count
+    assert snap["counts"]["decode_tokens"] == 7
+    assert snap["counts"]["requests_completed"] == 1
+    assert snap["gauges"]["queue_depth"] == 0
+    assert 0 < tps <= eng.slots
+
+
+def test_engine_failure_fails_clients_not_hangs(lm):
+    """A scheduler-loop death must surface to every waiting client as
+    an error, and later submits must refuse loudly."""
+    dec, params = lm
+    eng = serving.DecodeEngine(dec, params, slots=2)
+    try:
+        # poison the loop: a params pytree of the wrong structure makes
+        # the prefill call raise inside the scheduler thread
+        eng.params = {"nope": jnp.zeros(())}
+        h = eng.submit([1, 2, 3], 4)
+        with pytest.raises(RuntimeError, match="failed"):
+            h.result(120)
+        with pytest.raises(RuntimeError):
+            eng.submit([1, 2, 3], 4)
+    finally:
+        eng.stop()

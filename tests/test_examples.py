@@ -121,9 +121,12 @@ def test_lm_generate(tmp_path):
     continues it exactly (the observable proof the cache works)."""
     out = str(tmp_path / "gen.json")
     _run("examples/generate/lm_generate.py", "--steps", "150",
-         "--out", out)
+         "--serve", "4", "--out", out)
     result = json.load(open(out))
     assert result["loss"] < 0.1, result
+    # the continuous-batching serving leg ran and agreed with solo decode
+    assert result["serve"]["requests"] == 4, result
+    assert result["serve"]["solo_mismatches"] == 0, result
     period = 4
     start = (result["prompt"][-1] + 1) % period
     want = [(start + i) % period for i in range(len(result["generated"]))]

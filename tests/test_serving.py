@@ -334,3 +334,63 @@ def test_concurrent_predicts(server):
     for t in threads:
         t.join()
     assert sorted(results) == [(i, 2.0 * i + 1.0) for i in range(8)]
+
+
+def test_generate_route_continuous_batching(tmp_path):
+    """The :generate endpoint mounts a DecodeEngine (PR 2): concurrent
+    single-prompt HTTP clients share the slot-structured decode loop
+    and each gets exactly its solo-generate continuation — no window,
+    no run-to-completion groups."""
+    import threading
+
+    import jax
+
+    from tensorflowonspark_tpu import generation, serving as serving_mod
+    from tensorflowonspark_tpu.models.decoder import DecoderLM
+
+    dec = DecoderLM(vocab=8, hidden=16, num_heads=2, num_layers=1,
+                    max_len=24, decode=True)
+    train = DecoderLM(vocab=8, hidden=16, num_heads=2, num_layers=1,
+                      max_len=24, decode=False)
+    params = train.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 24), jnp.int32))["params"]
+    engine = serving_mod.DecodeEngine(dec, params, slots=2)
+    with serving_mod.ModelServer(None, name="lm", port=0,
+                                 engine=engine) as srv:
+        url = "http://%s:%d/v1/models/lm:generate" % (srv._host, srv._port)
+        prompts = [[1, 2, (3 + i) % 8] for i in range(6)]
+        outs = [None] * len(prompts)
+
+        def call(i):
+            _, out = _post(url, {"prompt": prompts[i],
+                                 "max_new_tokens": 5})
+            outs[i] = out["tokens"]
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+
+        # multi-prompt body in one request, and validation surfaces 400
+        _, multi = _post(url, {"prompt": prompts[:2], "max_new_tokens": 3})
+        assert len(multi["tokens"]) == 2
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(url, {"max_new_tokens": 3})
+        assert err.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(url, {"prompt": [1, 2], "max_new_tokens": 999})
+        assert err.value.code == 400
+        # engine-only server: :predict refuses loudly, not a 500
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post("http://%s:%d/v1/models/lm:predict"
+                  % (srv._host, srv._port), {"instances": [[1.0]]})
+        assert err.value.code == 400
+
+    for i, p in enumerate(prompts):
+        solo = generation.generate_jit(dec, params,
+                                       jnp.asarray([p], jnp.int32), 5)
+        assert outs[i] == np.asarray(solo)[0].tolist(), i
+    for a, b in zip(multi["tokens"], prompts[:2]):
+        assert a[:3] == b

@@ -8,7 +8,7 @@ PYTHON ?= python
 # bash for pipefail in the onchip recipe (dash lacks it)
 SHELL := /bin/bash
 
-.PHONY: test test-fast bench smoke install lint native clean
+.PHONY: test test-fast bench smoke install lint native clean chaos
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -31,6 +31,28 @@ SUITE_TIMEOUT ?= 2700
 
 test-fast:
 	$(PYTHON) -m pytest tests/ -q -x -m "not slow"
+
+# Fault-injection suite (PR 3: chaos.py + the supervision plane e2e).
+# These SIGKILL real trainer/executor processes and reform real
+# clusters, so they run SERIALLY — one pytest process per test, which
+# both isolates each kill's process tree and gives every test a hard
+# per-test wall-clock cap via coreutils timeout (pytest-timeout is not
+# a dependency). A wedged recovery fails in $(CHAOS_TEST_TIMEOUT)s
+# instead of hanging the suite. The `chaos` marker is also `slow`, so
+# tier-1 (`-m "not slow"`) never runs these under concurrent load —
+# the VERDICT-r5 flake regime.
+CHAOS_TEST_TIMEOUT ?= 300
+chaos:
+	@set -e; \
+	tests=$$($(PYTHON) -m pytest tests/ -q -m chaos --collect-only \
+	  -p no:randomly 2>/dev/null | grep '::' || true); \
+	test -n "$$tests" || { echo "no chaos tests collected"; exit 1; }; \
+	for t in $$tests; do \
+	  echo "== chaos: $$t"; \
+	  timeout -k 30 $(CHAOS_TEST_TIMEOUT) \
+	    $(PYTHON) -m pytest "$$t" -q -p no:randomly || exit 1; \
+	done; \
+	echo "chaos suite: all tests passed"
 
 # one-line JSON benchmark (real chip when present; CPU smoke elsewhere)
 bench:

@@ -43,6 +43,15 @@ Prints ONE JSON line. Fields:
                          cost is real and unbounded); ``*_warm`` fields
                          are the steady-state rerun. p50/p99 are
                          per-request submit->complete latencies.
+- ``recovery``         — the supervision plane (PR 3): MTTR of an
+                         injected mid-job trainer SIGKILL under
+                         ``cluster.run(..., supervise=...)``, with the
+                         per-stage breakdown (detect / reform / restore
+                         / first post-restore step) and the
+                         ``exactly_once`` verdict (final step count and
+                         consumed-data sum match an uninterrupted run).
+                         CPU-pinned trainers: the number tracks the
+                         supervision plane itself, not device bring-up.
 
 Fed batches carry uint8 images (the realistic decoded-image payload; a
 production input pipeline ships uint8 and normalizes on-device) with the
@@ -466,6 +475,145 @@ def _serving_decode_bench(on_tpu):
     return block
 
 
+def _recovery_map_fun(args, ctx):
+    """Supervision-aware trainer for the recovery bench: restore ->
+    attach -> one checkpointed step per batch -> publish. The chaos
+    kill-at-step site fires inside ``sup.step`` — AFTER that step's
+    checkpoint committed and its feed partition was recorded consumed,
+    so a killed step N is restorable at N with nothing double-fed."""
+    import json as _json
+    import os as _os
+
+    import numpy as _np
+
+    from tensorflowonspark_tpu import chaos as _chaos
+    from tensorflowonspark_tpu import checkpoint as _checkpoint
+    from tensorflowonspark_tpu import reservation as _reservation
+    from tensorflowonspark_tpu import supervisor as _supervisor
+
+    ckpt = _checkpoint.Checkpointer(args["dir"], chief=True)
+    like = {"step": _np.array(0, _np.int32),
+            "seen": _np.array(0.0, _np.float64)}
+    restored = ckpt.restore(like, fallback=True)
+    state = restored if restored is not None else like
+    step = int(state["step"])
+    start = step
+    sup = _supervisor.attach(
+        ctx, restored_step=step if restored is not None else None)
+    feed = ctx.get_data_feed(train_mode=True)
+
+    def _acked_up_to(n):
+        # n counts THIS attempt's steps (a reformed cluster's server
+        # starts with an empty ack set; already-acked partitions are
+        # drained driver-side and never re-fed)
+        client = _reservation.Client(ctx.cluster_meta["server_addr"])
+        try:
+            return _chaos.poll_until(lambda: len(client.acked()) >= n,
+                                     timeout=60)
+        finally:
+            client.close()
+
+    while not feed.should_stop():
+        batch = feed.next_batch(args["batch"])
+        if not batch:
+            continue
+        step += 1
+        state = {"step": _np.array(step, _np.int32),
+                 "seen": _np.array(float(state["seen"]) + sum(batch),
+                                   _np.float64)}
+        ckpt.save(step, state, force=True)
+        ckpt.wait()
+        _acked_up_to(step - start)  # one partition == one step
+        sup.step(step)  # chaos kill site
+    ckpt.close()
+    with open(_os.path.join(args["dir"], "final.json"), "w") as f:
+        _json.dump({"step": step, "seen": float(state["seen"])}, f)
+
+
+def _recovery_bench(batch=4, parts=8, kill_step=3, max_restarts=2,
+                    heartbeat_interval=0.25, poll_interval=0.1):
+    """MTTR of the supervision plane: one supervised job, one injected
+    trainer SIGKILL right after ``kill_step``'s checkpoint committed,
+    measured detect -> reform -> restore -> first-post-restore-step.
+
+    One feed partition == one device batch == one checkpointed step
+    (the exactly-once alignment docs/fault_tolerance.md documents), so
+    ``exactly_once`` asserts the recovered run's final step count AND
+    consumed-data sum match an uninterrupted run's.
+
+    Trainers are pinned to CPU (``JAX_PLATFORMS=cpu``): the number
+    published is the supervision plane's own latency — detection,
+    teardown, reformation, checkpoint restore — not device bring-up,
+    so it regression-tracks across boxes. scripts/profile_recovery.py
+    shares this harness.
+    """
+    import shutil
+    import tempfile
+
+    from tensorflowonspark_tpu import chaos, cluster, supervisor
+    from tensorflowonspark_tpu.engine import Context
+
+    work = tempfile.mkdtemp(prefix="tfos-recovery-")
+    ckpt_dir = os.path.join(work, "ckpt")
+    os.makedirs(ckpt_dir)
+    fuse = os.path.join(work, "fuse")
+    records = list(range(batch * parts))
+    try:
+        sc = Context(
+            num_executors=1, work_root=os.path.join(work, "engine"),
+            executor_env={
+                chaos.ENV_VAR: "kill_trainer_at_step={},fuse={}".format(
+                    kill_step, fuse),
+                "TFOS_FEED_TRANSPORT": "queue",
+                "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+        cfg = supervisor.SupervisorConfig(
+            policy=supervisor.RestartFromCheckpoint(
+                max_restarts=max_restarts, backoff=0.1),
+            heartbeat_interval=heartbeat_interval,
+            heartbeat_timeout=20.0, poll_interval=poll_interval,
+            classify_grace=10.0)
+        t0 = time.monotonic()
+        try:
+            tfc = cluster.run(sc, _recovery_map_fun,
+                              {"dir": ckpt_dir, "batch": batch},
+                              num_executors=1,
+                              input_mode=cluster.InputMode.SPARK,
+                              supervise=cfg)
+            tfc.train(sc.parallelize(records, parts), feed_timeout=120)
+        finally:
+            sc.stop()
+        wall = time.monotonic() - t0
+        # the fuse file's content is the kill's wall-clock fire time —
+        # the out-of-process evidence the detect span is anchored to
+        kill_wall = float(open(fuse).read()) if os.path.exists(fuse) \
+            else None
+        stages = supervisor.recovery_stages(tfc.events, kill_wall=kill_wall)
+        rep = tfc.report()
+        with open(os.path.join(ckpt_dir, "final.json")) as f:
+            final = json.load(f)
+        return {
+            "workload": {"partitions": parts, "batch": batch,
+                         "kill_at_step": kill_step,
+                         "policy": "RestartFromCheckpoint(max_restarts="
+                                   "{})".format(max_restarts)},
+            "injection_fired": kill_wall is not None,
+            "mttr_s": stages.get("mttr_s") if stages else None,
+            "stages": None if stages is None else {
+                k: stages[k] for k in ("detect_s", "reform_s",
+                                       "restore_s", "first_step_s")},
+            "formations": rep["formations"],
+            "failure_kinds": [f["kind"] for f in rep["failures"]],
+            "acked_partitions": rep["acked_partitions"],
+            "final_step": final["step"],
+            "expected_step": parts,
+            "exactly_once": final["step"] == parts and
+            final["seen"] == float(sum(records)),
+            "wall_s": round(wall, 3),
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def _probe_platform():
     """Device platform WITHOUT initializing jax in this process.
 
@@ -614,6 +762,21 @@ def main():
         fed_auto = _fed_median("auto",
                                reps=None if auto_full_reps else 1)
 
+    # Supervision plane: MTTR of an injected mid-job trainer SIGKILL
+    # (detect -> reform -> restore -> first post-restore step), published
+    # so recovery latency is regression-tracked alongside throughput.
+    # Runs in the fed regime (driver has not initialized jax; trainers
+    # are separate CPU-pinned processes). Rides the fed gate: the
+    # device-only subprocess child must not spin recovery clusters.
+    # TFOS_BENCH_RECOVERY=0 skips it.
+    recovery = None
+    if fed_enabled and os.environ.get("TFOS_BENCH_RECOVERY", "1") == "1":
+        try:
+            recovery = _recovery_bench()
+        except Exception as e:  # noqa: BLE001 - report, not die
+            print("recovery bench failed: {}".format(e), file=sys.stderr)
+            recovery = {"error": str(e)}
+
     # The device-only spin has no engine timeouts around it: a tunnel
     # that dies mid-run (observed round 5 — it served the fed runs then
     # wedged on the very next client, inside a C-level PJRT call that no
@@ -667,6 +830,7 @@ def main():
             "device_only": round(device_only, 2)
             if device_only is not None else None,
             "device_error": device_error,
+            "recovery": recovery,
             "error": "both cluster-fed transports failed",
         }))
         return
@@ -705,6 +869,9 @@ def main():
         # continuous-batching decode engine vs run-to-completion window
         # batcher on mixed-length traffic (PR 2; BENCH_r06+ tracks this)
         "serving_decode": serving_decode,
+        # supervision plane MTTR: injected trainer SIGKILL -> detect ->
+        # reform -> restore -> first step (PR 3; docs/fault_tolerance.md)
+        "recovery": recovery,
     }))
 
 

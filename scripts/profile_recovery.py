@@ -1,0 +1,114 @@
+"""MTTR stage breakdown of the supervision plane (PR 3).
+
+Where does a recovery's time go? Runs the SAME supervised-kill workload
+``bench.py``'s ``recovery`` block publishes — one supervised job, one
+chaos-injected trainer SIGKILL right after step N's checkpoint
+committed — and prints the per-stage attribution extracted from the
+supervision EventLog (supervisor.recovery_stages):
+
+- ``detect``     — kill (the chaos fuse's wall-clock fire time) ->
+                   the Supervisor's failure_detected event
+- ``reform``     — failure_detected -> the replacement cluster's
+                   formation barrier opening
+- ``restore``    — cluster_formed -> the trainer publishing its
+                   restored checkpoint step
+- ``first_step`` — restored -> the first post-restore training step
+
+plus the supervision ledger (formations, failure kinds, acked
+partitions) and the ``exactly_once`` verdict: the recovered run's final
+step count and consumed-data sum must match an uninterrupted run's.
+
+The harness is imported from bench.py (ONE recovery-measurement
+implementation, so the profiler's stage attribution describes the
+benched run shape); trainers are CPU-pinned there, so the numbers track
+the supervision plane itself, not device bring-up.
+
+Usage (CPU, hermetic):
+
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python scripts/profile_recovery.py [--parts 8] [--batch 4] \
+        [--kill-step 3] [--reps 1] [--heartbeat-interval 0.25] \
+        [--poll-interval 0.1] [--json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STAGES = ("detect_s", "reform_s", "restore_s", "first_step_s")
+
+
+def _median(values):
+    values = sorted(values)
+    return values[len(values) // 2]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--parts", type=int, default=8,
+                    help="feed partitions (== checkpointed steps)")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--kill-step", type=int, default=3,
+                    help="SIGKILL the trainer after this step commits")
+    ap.add_argument("--reps", type=int, default=1,
+                    help="repeat runs; stage table reports per-rep medians")
+    ap.add_argument("--heartbeat-interval", type=float, default=0.25)
+    ap.add_argument("--poll-interval", type=float, default=0.1)
+    ap.add_argument("--json", action="store_true",
+                    help="print one JSON blob instead of the table")
+    args = ap.parse_args(argv)
+
+    # bench.py's harness — ONE recovery-measurement implementation
+    from bench import _recovery_bench
+
+    runs = []
+    for rep in range(args.reps):
+        block = _recovery_bench(
+            batch=args.batch, parts=args.parts, kill_step=args.kill_step,
+            heartbeat_interval=args.heartbeat_interval,
+            poll_interval=args.poll_interval)
+        if not block["injection_fired"] or block["stages"] is None:
+            print("rep {}: injection never fired / no stages: {}".format(
+                rep, block), file=sys.stderr)
+            return 1
+        runs.append(block)
+
+    def _med(key):
+        return _median([r["stages"][key] for r in runs])
+
+    summary = {
+        "workload": runs[0]["workload"],
+        "reps": args.reps,
+        "mttr_s": _median([r["mttr_s"] for r in runs]),
+        "stages": {k: _med(k) for k in STAGES},
+        "exactly_once": all(r["exactly_once"] for r in runs),
+        "formations": [r["formations"] for r in runs],
+        "runs": runs,
+    }
+    if args.json:
+        print(json.dumps(summary))
+        return 0
+
+    w = runs[0]["workload"]
+    print("supervised recovery: {} partitions x batch {}, SIGKILL after "
+          "step {} ({})".format(args.parts, args.batch, args.kill_step,
+                                w["policy"]))
+    print("reps: {}   exactly_once: {}   formations: {}".format(
+        args.reps, summary["exactly_once"], summary["formations"]))
+    print()
+    mttr = summary["mttr_s"]
+    print("{:<14} {:>10} {:>8}".format("stage", "median_s", "% mttr"))
+    for key in STAGES:
+        v = summary["stages"][key]
+        pct = 100.0 * v / mttr if mttr else 0.0
+        print("{:<14} {:>10.3f} {:>7.1f}%".format(
+            key[:-2].replace("_", " "), v, pct))
+    print("{:<14} {:>10.3f}".format("mttr", mttr))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -177,26 +177,57 @@ class Checkpointer(object):
                                force=force)
         if saved:
             self._saved_steps.add(step)
+            # fault-injection site (chaos.py corrupt_checkpoint=N):
+            # garbles the step it just committed so the fallback-restore
+            # path is exercisable deterministically; O(1) when unarmed
+            from tensorflowonspark_tpu import chaos
+            chaos.on_checkpoint_saved(step, self.directory, wait=self.wait)
         return bool(saved)
 
     def latest_step(self):
         return self._mgr.latest_step()
 
-    def restore(self, state_like, step=None):
+    def restore(self, state_like, step=None, fallback=False):
         """Restore into the structure (and shardings) of ``state_like``.
 
         ``state_like`` is an init-shaped state; when its arrays carry
         shardings (the TP/PP case), orbax restores each process's shards
         in that layout. Returns the restored state, or None if no
         checkpoint exists.
+
+        ``fallback=True`` (the recovery posture — supervisor.py's
+        RestartFromCheckpoint contract assumes it): when the chosen step
+        fails to restore (the classic cause: a writer killed mid-commit
+        left a corrupt latest — chaos.py's corrupt_checkpoint injection
+        reproduces it), walk back through older steps until one
+        restores, instead of wedging the whole recovery on the one bad
+        step. The first error is re-raised only when EVERY step fails.
         """
         import orbax.checkpoint as ocp
 
-        step = step if step is not None else self._mgr.latest_step()
-        if step is None:
+        if step is not None:
+            candidates = [int(step)]
+        else:
+            candidates = sorted((int(s) for s in self._mgr.all_steps()),
+                                reverse=True)
+        if not candidates:
             return None
-        return self._mgr.restore(int(step),
-                                 args=ocp.args.StandardRestore(state_like))
+        first_error = None
+        for s in candidates:
+            try:
+                return self._mgr.restore(
+                    s, args=ocp.args.StandardRestore(state_like))
+            except Exception as e:  # noqa: BLE001 - orbax raises variously
+                if not fallback:
+                    raise
+                if first_error is None:
+                    first_error = e
+                logger.warning(
+                    "checkpoint step %d failed to restore (%s); "
+                    "falling back to the previous step", s, e)
+        raise RuntimeError(
+            "no checkpoint step under {} could be restored "
+            "(tried {})".format(self.directory, candidates)) from first_error
 
     def wait(self):
         self._mgr.wait_until_finished()

@@ -41,7 +41,8 @@ class TFCluster(object):
     """Handle to a running cluster; returned by :func:`run`."""
 
     def __init__(self, sc, cluster_info, cluster_meta, input_mode, server,
-                 async_result, queues, num_executors):
+                 async_result, queues, num_executors, executor_ids=None,
+                 exclude=frozenset()):
         self.sc = sc
         self.cluster_info = cluster_info
         self.cluster_meta = cluster_meta
@@ -50,6 +51,12 @@ class TFCluster(object):
         self.async_result = async_result
         self.queues = queues
         self.num_executors = num_executors
+        #: physical executor ids hosting this cluster's nodes (differs
+        #: from range(num_executors) when executors are blacklisted)
+        self.executor_ids = list(executor_ids) if executor_ids is not None \
+            else list(range(num_executors))
+        #: executor ids barred from running this cluster's data tasks
+        self.exclude = frozenset(exclude)
 
     # -- training --------------------------------------------------------
 
@@ -76,9 +83,14 @@ class TFCluster(object):
                     dataRDD.getNumPartitions(), max(num_epochs, 1))
         if num_epochs > 1:
             dataRDD = self.sc.union([dataRDD] * num_epochs)
-        dataRDD.foreachPartition(
-            node.train(self.cluster_info, self.cluster_meta,
-                       feed_timeout=feed_timeout, qname=qname))
+        fn = node.train(self.cluster_info, self.cluster_meta,
+                        feed_timeout=feed_timeout, qname=qname)
+        if self.exclude:
+            # engine-only kwarg: blacklisted executors must not pull feed
+            # tasks (they host no node for this cluster incarnation)
+            dataRDD.foreachPartition(fn, exclude=self.exclude)
+        else:
+            dataRDD.foreachPartition(fn)
 
     def inference(self, dataRDD, feed_timeout=600, qname="output"):
         """Feed an RDD through the cluster for inference; returns an RDD of
@@ -87,6 +99,11 @@ class TFCluster(object):
         """
         assert self.input_mode == InputMode.SPARK, \
             "inference() requires InputMode.SPARK"
+        if self.exclude:
+            raise NotImplementedError(
+                "inference() on a cluster with blacklisted executors is "
+                "not supported: the result RDD's job placement cannot "
+                "honor the exclusion")
         return dataRDD.mapPartitions(
             node.inference(self.cluster_info, self.cluster_meta,
                            feed_timeout=feed_timeout, qname=qname))
@@ -112,8 +129,8 @@ class TFCluster(object):
             except Exception as e:  # noqa: BLE001 - re-raised after cleanup
                 stream_error = e
         if self.input_mode == InputMode.SPARK:
-            workers = self.sc.parallelize(range(self.num_executors),
-                                          self.num_executors)
+            workers = self.sc.parallelize(self.executor_ids,
+                                          len(self.executor_ids))
             # EndFeed goes to every input-like queue the cluster created
             # (everything that isn't the output/error plane).
             feed_queues = tuple(q for q in self.queues
@@ -126,7 +143,9 @@ class TFCluster(object):
                     node.shutdown(self.cluster_info, self.cluster_meta,
                                   queues=feed_queues, grace_secs=grace_secs),
                     one_task_per_executor=True,
-                    fail_fast=False).get(timeout=timeout)
+                    fail_fast=False,
+                    **({"exclude": self.exclude} if self.exclude else {})
+                    ).get(timeout=timeout)
             except Exception as e:  # noqa: BLE001 - re-raised after cleanup
                 shutdown_error = e
 
@@ -141,14 +160,16 @@ class TFCluster(object):
         if self.input_mode == InputMode.TENSORFLOW:
             # Cleanup pass the SPARK branch gets from node.shutdown: kill
             # the chief's TensorBoard subprocess, drain the error queue.
-            workers = self.sc.parallelize(range(self.num_executors),
-                                          self.num_executors)
+            workers = self.sc.parallelize(self.executor_ids,
+                                          len(self.executor_ids))
             try:
                 workers.foreachPartitionAsync(
                     node.shutdown(self.cluster_info, self.cluster_meta,
                                   queues=(), grace_secs=grace_secs),
                     one_task_per_executor=True,
-                    fail_fast=False).get(timeout=timeout)
+                    fail_fast=False,
+                    **({"exclude": self.exclude} if self.exclude else {})
+                    ).get(timeout=timeout)
             except Exception as e:  # noqa: BLE001
                 if bootstrap_error is None:
                     shutdown_error = e
@@ -180,7 +201,8 @@ def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
         input_mode=InputMode.SPARK, log_dir=None, driver_ps_nodes=False,
         master_node="chief", reservation_timeout=reservation.DEFAULT_TIMEOUT,
         queues=("input", "output", "error"), eval_node=False,
-        manager_mode="local", filesystems=None):
+        manager_mode="local", filesystems=None, supervise=None,
+        exclude_executors=(), beat_interval=None):
     """Start a cluster: one node per executor, roles per the template.
 
     Reference: ``TFCluster.run`` (SURVEY.md §3.1). ``num_ps`` is accepted
@@ -198,7 +220,39 @@ def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
     never reach workers; this is the supported way to make ``hdfs://``/
     ``gs://`` paths resolvable cluster-wide. Openers ship by cloudpickle,
     so module-level functions or closures both work.
+
+    ``supervise``: a :class:`~tensorflowonspark_tpu.supervisor
+    .SupervisorConfig` opts the job into the supervision plane — returns
+    a :class:`~tensorflowonspark_tpu.supervisor.SupervisedCluster`
+    (same train/shutdown surface) that detects mid-job failures via
+    heartbeat leases and recovers per the configured policy
+    (restart-from-checkpoint, blacklist, fail). See
+    docs/fault_tolerance.md. ``exclude_executors`` / ``beat_interval``
+    are the supervision plane's plumbing: blacklist a set of engine
+    executor ids (built-in engine only) and override the heartbeat-lease
+    cadence.
     """
+    if supervise is not None:
+        if exclude_executors or beat_interval is not None:
+            # these are the supervision plane's own levers: the
+            # SupervisedCluster drives exclusions from its policy and
+            # the beat cadence from SupervisorConfig.heartbeat_interval;
+            # silently dropping caller values would be worse than
+            # refusing them
+            raise ValueError(
+                "exclude_executors / beat_interval cannot be combined "
+                "with supervise=: use the policy (Blacklist) and "
+                "SupervisorConfig.heartbeat_interval instead")
+        from tensorflowonspark_tpu import supervisor as supervisor_mod
+        return supervisor_mod.SupervisedCluster(
+            sc, map_fun, tf_args, num_executors, config=supervise,
+            run_kwargs=dict(
+                num_ps=num_ps, tensorboard=tensorboard,
+                input_mode=input_mode, log_dir=log_dir,
+                driver_ps_nodes=driver_ps_nodes, master_node=master_node,
+                reservation_timeout=reservation_timeout,
+                queues=tuple(queues), eval_node=eval_node,
+                manager_mode=manager_mode, filesystems=filesystems))
     if driver_ps_nodes:
         raise NotImplementedError(
             "driver_ps_nodes is not supported: async parameter-server DP "
@@ -212,18 +266,38 @@ def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
             "cluster needs at least {} executors for num_ps={}, master, "
             "eval_node={} but num_executors={}".format(
                 needed, num_ps, eval_node, num_executors))
+    exclude = frozenset(exclude_executors or ())
+    if exclude:
+        # Blacklist (supervision plane): form the cluster on the first
+        # num_executors alive, non-excluded engine executors. Needs the
+        # built-in engine's liveness view; a Spark sc has no analog.
+        alive = getattr(sc, "executors_alive", None)
+        if alive is None:
+            raise NotImplementedError(
+                "exclude_executors requires the built-in engine "
+                "(Context.executors_alive); Spark contexts cannot "
+                "blacklist at this layer")
+        executor_ids = [e for e in alive() if e not in exclude]
+        if len(executor_ids) < num_executors:
+            raise RuntimeError(
+                "cluster needs {} executors but only {} are alive and "
+                "not blacklisted ({} excluded)".format(
+                    num_executors, len(executor_ids), sorted(exclude)))
+        executor_ids = executor_ids[:num_executors]
+    else:
+        executor_ids = list(range(num_executors))
     template = {}
-    next_id = 0
+    pos = 0
     if num_ps > 0:
-        template["ps"] = list(range(next_id, next_id + num_ps))
-        next_id += num_ps
-    template[master_node] = [next_id]
-    next_id += 1
+        template["ps"] = executor_ids[pos:pos + num_ps]
+        pos += num_ps
+    template[master_node] = [executor_ids[pos]]
+    pos += 1
     if eval_node:
-        template["evaluator"] = [next_id]
-        next_id += 1
-    if next_id < num_executors:
-        template["worker"] = list(range(next_id, num_executors))
+        template["evaluator"] = [executor_ids[pos]]
+        pos += 1
+    if pos < len(executor_ids):
+        template["worker"] = executor_ids[pos:]
     logger.info("cluster template: %s", template)
 
     # 2. reservation barrier on the driver.
@@ -250,17 +324,21 @@ def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
         "reservation_timeout": reservation_timeout,
         # {scheme: opener}; travels inside the cloudpickled node closure
         "filesystems": dict(filesystems or {}),
+        # heartbeat-lease cadence for the supervision plane (node.py's
+        # beat thread); SupervisorConfig tightens it for fast detection
+        "beat_interval": float(beat_interval) if beat_interval else None,
     }
 
     # 4. async bootstrap job: one pinned task per executor.
     try:
-        nodeRDD = sc.parallelize(range(num_executors), num_executors)
+        nodeRDD = sc.parallelize(executor_ids, len(executor_ids))
         background = (input_mode == InputMode.SPARK)
         async_result = nodeRDD.foreachPartitionAsync(
             node.run(map_fun, tf_args, cluster_meta, tensorboard=tensorboard,
                      log_dir=log_dir, queues=tuple(queues),
                      background=background),
-            one_task_per_executor=True)
+            one_task_per_executor=True,
+            **({"exclude": exclude} if exclude else {}))
 
         # 5. wait for the cluster to form; fail fast if ANY node task died
         # (not only when all finished — the survivors are blocked at the
@@ -286,4 +364,5 @@ def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
                              n["port"]) for n in cluster_info])
 
     return TFCluster(sc, cluster_info, cluster_meta, input_mode, server,
-                     async_result, tuple(queues), num_executors)
+                     async_result, tuple(queues), num_executors,
+                     executor_ids=executor_ids, exclude=exclude)

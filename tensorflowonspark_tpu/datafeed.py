@@ -44,6 +44,7 @@ import time
 
 import numpy as np
 
+from tensorflowonspark_tpu import chaos
 from tensorflowonspark_tpu import frames as frames_lib
 from tensorflowonspark_tpu import tracing
 from tensorflowonspark_tpu.frames import ColumnarChunk
@@ -163,6 +164,7 @@ class DataFeed(object):
         self._queue_out = None if train_mode else mgr.get_queue(qname_out)
         self._pending = []  # segments: ColumnarChunk | _RingSegment | list
         self._backlog = []  # items decoded ahead from a coalesced frame
+        self._unpacked = 0  # queue pieces left before task_done is owed
         # Zero-copy consume path knobs (module docstring): both default on.
         self._zero_copy = os.environ.get("TFOS_FEED_ZERO_COPY", "1") == "1"
         self._staging_reuse = os.environ.get("TFOS_FEED_STAGING", "1") == "1"
@@ -185,8 +187,9 @@ class DataFeed(object):
         # _pending as they arrive, so the final batches step with no
         # queue traffic; and post-end-of-feed empty batches count as no
         # progress at all.
-        self._hb_at = 0.0
+        self._hb_at = None       # monotonic of the last heartbeat publish
         self._hb_batches = 0
+        self._last_progress = None  # monotonic of the last non-empty batch
 
     def next_batch(self, batch_size):
         """Next batch of up to ``batch_size`` records.
@@ -253,20 +256,38 @@ class DataFeed(object):
             self._stats["records"] += _seg_len(seg)
             self._stats["chunks"] += 1
             self._item_done()
+        # A trailing partition marker that traveled WITH the final chunk
+        # (tail coalescing) is consumed in-call: the feeder's queue join
+        # — and a supervised feed's partition ACK — then completes with
+        # the batch that finished the partition, not one call later.
+        # Only with _pending empty: leftover records mean the partition
+        # is NOT fully consumed yet, and its task_done must wait.
+        while count and not self._pending and self._backlog \
+                and isinstance(self._backlog[0], Marker):
+            item = self._backlog.pop(0)
+            self._item_done()
+            if isinstance(item, EndFeed):
+                self.done_feeding = True
         if count:
             # Non-empty batches only: an empty batch after end-of-feed is
             # not progress, and must not re-arm the shutdown grace (a
             # buggy map_fun spinning on empty next_batch calls would
             # otherwise hold off termination forever).
             self._hb_batches += 1
+            self._last_progress = time.monotonic()
             self._heartbeat()
+            # deterministic fault injection (chaos.py): kill/stall sites
+            # keyed on batches served — a no-op O(1) check when unarmed
+            chaos.on_batch(self, self._hb_batches)
         return self._combine(segs)
 
     def _heartbeat(self):
         """Publish batches-served progress to the kv, at most every 2s
         (one small RPC — negligible against a chunk's payload)."""
         now = time.monotonic()
-        if now - self._hb_at < 2.0:
+        if self._hb_at is not None and now - self._hb_at < 2.0:
+            return
+        if chaos.on_heartbeat():  # injected heartbeat outage (chaos.py)
             return
         self._hb_at = now
         try:
@@ -392,6 +413,15 @@ class DataFeed(object):
                     item = self._queue_in.get(block=True, timeout=5.0)
                     self.timers.add("queue_wait",
                                     time.monotonic() - t_wait)
+                    if isinstance(item, frames_lib.FrameList):
+                        # tail coalescing: one queue item carrying
+                        # several feed items ([final chunk, EndPartition]
+                        # today). _item_done fires the single task_done
+                        # on the LAST piece.
+                        pieces = list(item)
+                        self._unpacked = len(pieces)
+                        self._backlog.extend(pieces[1:])
+                        return pieces[0]
                     return item
                 except _queue.Empty:
                     pass
@@ -446,8 +476,15 @@ class DataFeed(object):
         return items
 
     def _item_done(self):
-        if self._queue_in is not None:
-            self._queue_in.task_done()
+        if self._queue_in is None:
+            return
+        if self._unpacked > 1:
+            # piece of a coalesced multi-item: the queue saw ONE put, so
+            # only the last piece's consumption calls task_done
+            self._unpacked -= 1
+            return
+        self._unpacked = 0
+        self._queue_in.task_done()
 
     def _stack_columns(self, batch):
         """Stack row records column-wise into {mapped_name: np.ndarray}."""
@@ -499,9 +536,24 @@ class DataFeed(object):
 
     def stats(self):
         """Consumer-side feed-plane counters: {records, chunks, wait_s,
-        staging_alloc, staging_reuse, stages: {stage: seconds}}."""
+        staging_alloc, staging_reuse, batches, heartbeat_age_s,
+        last_progress_age_s, stages: {stage: seconds}}.
+
+        ``heartbeat_age_s`` / ``last_progress_age_s`` (None until the
+        first publish / first non-empty batch) make the supervisor's
+        stall classification observable from user code: a growing
+        progress age with a live trainer is exactly the feeder-stall /
+        ring-wedge signature supervisor.py keys on. Schema is pinned by
+        tests/test_datafeed.py::test_stats_schema.
+        """
+        now = time.monotonic()
         out = dict(self._stats)
         out["stages"] = self.timers.snapshot()
+        out["batches"] = self._hb_batches
+        out["heartbeat_age_s"] = None if self._hb_at is None \
+            else now - self._hb_at
+        out["last_progress_age_s"] = None if self._last_progress is None \
+            else now - self._last_progress
         return out
 
     def should_stop(self):
@@ -536,6 +588,11 @@ class DataFeed(object):
                 seg.slot.drop()
         self._pending = []
         self._backlog = []
+        if self._queue_in is not None and self._unpacked:
+            # discarded pieces of a coalesced queue item: settle its one
+            # owed task_done so the feeder's join can still drain
+            self._unpacked = 0
+            self._queue_in.task_done()
         import queue as _queue
         count = 0
         if self._ring is not None:

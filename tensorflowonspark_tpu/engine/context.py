@@ -150,9 +150,23 @@ class _ExecutorHandle(object):
                     # Job already lost: don't ship a task whose only
                     # possible outcome is burning its own timeout (e.g. a
                     # feed task pushing 600s into a ring nobody drains).
+                    # Checked BEFORE the exclusion below, so an excluded
+                    # executor drains a dead job's tasks instead of
+                    # requeueing them forever (with every eligible
+                    # sibling dead, nobody else ever would).
                     task["result"]._fail(
                         task["task_id"],
                         "job aborted: an earlier task already failed")
+                    task = None
+                    continue
+                exclude = task.get("exclude")
+                if exclude and self.executor_id in exclude:
+                    # blacklisted for this job (supervision plane): hand
+                    # the task back for an eligible sibling; the short
+                    # sleep keeps an idle excluded executor from spinning
+                    # on its own requeue
+                    self.ctx._shared_tasks.put(task)
+                    time.sleep(0.02)
                     task = None
                     continue
                 self.conn.send({"type": "task", "job_id": task["job_id"],
@@ -380,12 +394,19 @@ class Context(object):
         return out
 
     def run_job(self, rdd, func, one_task_per_executor=False,
-                fail_fast=True):
+                fail_fast=True, exclude=()):
         """Ship ``func`` over every partition; returns :class:`AsyncResult`.
 
         ``fail_fast=False`` opts a job out of abort-on-first-failure:
         every task still runs and ``get()`` waits for all of them
         (cleanup/shutdown jobs).
+
+        ``exclude``: executor ids barred from running this job's tasks —
+        the supervision plane's blacklist (a repeatedly failing executor
+        keeps its process but receives no work). Pinned
+        (one_task_per_executor) jobs simply skip excluded executors in
+        the task->executor mapping; shared-pool tasks carry the set and
+        an excluded executor that pulls one hands it back.
 
         Fail-fast abort scope (deliberately BEST-EFFORT): the first
         failure wakes ``get()`` immediately and marks the job failed, and
@@ -401,23 +422,29 @@ class Context(object):
         raising as "job lost", not "cluster quiesced"; ``Context.stop``'s
         terminate-with-escalation is the hard bound on stragglers."""
         partitions = rdd._partitions
+        exclude = frozenset(exclude or ())
         result = AsyncResult(len(partitions), fail_fast=fail_fast)
         with self._lock:
             self._job_counter += 1
             job_id = self._job_counter
-            handles = {eid: h for eid, h in self._handles.items() if h.alive}
+            handles = {eid: h for eid, h in self._handles.items()
+                       if h.alive and eid not in exclude}
         if not handles:
-            raise RuntimeError("no executors alive to run job")
+            raise RuntimeError(
+                "no executors alive to run job" +
+                (" (after excluding {})".format(sorted(exclude))
+                 if exclude else ""))
         if one_task_per_executor and len(partitions) > len(handles):
             raise ValueError(
-                "job needs {} executors but only {} are alive".format(
-                    len(partitions), len(handles)))
+                "job needs {} executors but only {} are alive{}".format(
+                    len(partitions), len(handles),
+                    " and eligible" if exclude else ""))
         for task_id, part in enumerate(partitions):
             full = _compose(part.transform, func)
             task = {"job_id": job_id, "task_id": task_id,
                     "func": serializer.dumps(full),
                     "payload": serializer.dumps(part.payload),
-                    "result": result}
+                    "result": result, "exclude": exclude}
             if one_task_per_executor:
                 executor_id = sorted(handles)[task_id]
                 handles[executor_id].own_queue.put(task)

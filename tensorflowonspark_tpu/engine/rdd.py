@@ -124,12 +124,12 @@ class RDD(object):
             raise ValueError("RDD is empty")
         return got[0]
 
-    def foreachPartition(self, f):
+    def foreachPartition(self, f, exclude=()):
         """Run f over every partition; blocks; re-raises executor errors."""
-        self.foreachPartitionAsync(f).get()
+        self.foreachPartitionAsync(f, exclude=exclude).get()
 
     def foreachPartitionAsync(self, f, one_task_per_executor=False,
-                              fail_fast=True):
+                              fail_fast=True, exclude=()):
         """Async partition job -> :class:`AsyncResult` (reference:
         ``nodeRDD.foreachPartitionAsync(TFSparkNode.run(...))``).
 
@@ -138,7 +138,8 @@ class RDD(object):
         (SURVEY.md §3.1), a placement Spark gets from its scheduler and we
         make explicit. ``fail_fast=False`` opts out of
         abort-on-first-failure (cleanup jobs that must reach every
-        executor).
+        executor). ``exclude`` bars the named executor ids from this job
+        (the supervision plane's blacklist; see Context.run_job).
         """
         def run_and_discard(it, _f=f):
             _f(it)
@@ -146,7 +147,7 @@ class RDD(object):
 
         return self.ctx.run_job(self, run_and_discard,
                                 one_task_per_executor=one_task_per_executor,
-                                fail_fast=fail_fast)
+                                fail_fast=fail_fast, exclude=exclude)
 
     def saveAsTextFile(self, path):
         """Write one ``part-NNNNN`` file per partition under ``path``."""

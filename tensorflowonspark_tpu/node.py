@@ -391,6 +391,15 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                         executor_id=executor_id, ctx=ctx,
                         trainer_proc=None, tb_pid=tb_pid, shm_ring=ring)
 
+        # Supervision heartbeat lease (supervisor.py): a small status
+        # beat to the driver's reservation server, carrying the three
+        # liveness signals the Supervisor classifies — node state +
+        # feed progress (broker kv), trainer process exit status, and
+        # the beat's very arrival (executor liveness). Always on: the
+        # beat is one tiny JSON message per interval and the lease
+        # table is what makes an unsupervised cluster debuggable too.
+        _start_beat_thread(cluster_meta, mgr, executor_id)
+
         if background:
             # InputMode.SPARK: the trainer runs in a child process (it will
             # own the TPU); this bootstrap task returns so the executor's
@@ -429,6 +438,12 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             # out; SURVEY.md §5 failure-detection.)
             def _watch(proc=proc, mgr=mgr, executor_id=executor_id):
                 proc.join()
+                try:
+                    # surfaced to the supervisor via the heartbeat lease
+                    # payload AND readable from user/test code
+                    mgr.set("trainer_exit", proc.exitcode)
+                except Exception:  # noqa: BLE001 - broker may be gone
+                    pass
                 if proc.exitcode not in (0, None) and \
                         mgr.get("state") == "running":
                     msg = ("trainer on executor {} exited with code {} "
@@ -456,6 +471,92 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                 raise
 
     return _mapfn
+
+
+#: default seconds between heartbeat-lease beats (env: TFOS_BEAT_INTERVAL;
+#: supervised runs tighten it via SupervisorConfig -> cluster_meta)
+DEFAULT_BEAT_INTERVAL = 2.0
+
+
+def _beat_payload(mgr, executor_id):
+    """One heartbeat lease payload: the supervisor's raw signal set."""
+    proc = _state().get("trainer_proc")
+
+    def _kv(key):
+        try:
+            return mgr.get(key)
+        except Exception:  # noqa: BLE001 - broker may be gone at teardown
+            return None
+
+    return {"state": _kv("state"), "feed_hb": _kv("feed_hb"),
+            "train_step": _kv("train_step"),
+            "restored_step": _kv("restored_step"),
+            "feed_transport": _kv("feed_transport"),
+            "trainer_alive": None if proc is None else proc.is_alive(),
+            "trainer_exit": None if proc is None else proc.exitcode,
+            "executor_id": executor_id, "pid": os.getpid()}
+
+
+def _start_beat_thread(cluster_meta, mgr, executor_id):
+    """Publish this node's heartbeat lease to the reservation server.
+
+    Daemon thread; exits when this node's cluster incarnation ends
+    (shutdown pops the state's cluster_id; a reform replaces it) or the
+    node reaches the stopped state. A dead/unreachable server just drops
+    the connection and retries next tick — beats must never take a node
+    down. chaos.on_heartbeat() gates each send so the harness can
+    simulate an executor going dark without killing anything.
+    """
+    interval = float(os.environ.get("TFOS_BEAT_INTERVAL", 0) or
+                     cluster_meta.get("beat_interval") or
+                     DEFAULT_BEAT_INTERVAL)
+    cluster_id = cluster_meta["id"]
+    server_addr = cluster_meta["server_addr"]
+
+    def _beat_loop():
+        from tensorflowonspark_tpu import chaos
+        client = None
+        payload = None
+        try:
+            while _state().get("cluster_id") == cluster_id:
+                payload = _beat_payload(mgr, executor_id)
+                if not chaos.on_heartbeat():
+                    try:
+                        if client is None:
+                            client = reservation.Client(server_addr)
+                        client.beat(executor_id, payload)
+                    except Exception:  # noqa: BLE001 - beat must retry
+                        # ANY send failure (conn refused, EOF mid-reply,
+                        # codec error) drops the connection and retries
+                        # next tick — a beat thread that dies silently
+                        # blinds the supervisor to every later failure
+                        logger.debug("heartbeat send failed; will retry",
+                                     exc_info=True)
+                        if client is not None:
+                            try:
+                                client.close()
+                            except Exception:  # noqa: BLE001
+                                pass
+                        client = None
+                if payload.get("state") == "stopped":
+                    break
+                time.sleep(interval)
+            logger.info("beat loop for executor %s exiting: cluster_id=%r "
+                        "(beating %r), state=%r", executor_id,
+                        _state().get("cluster_id"), cluster_id,
+                        payload.get("state") if payload else None)
+        except BaseException:
+            logger.exception("beat loop for executor %s died", executor_id)
+            raise
+        finally:
+            if client is not None:
+                try:
+                    client.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    threading.Thread(target=_beat_loop, name="tfos-beat-%s" % executor_id,
+                     daemon=True).start()
 
 
 def _register_filesystems(cluster_meta):
@@ -539,6 +640,9 @@ def _trainer_main_fork(fn, tf_args, executor_id, job_name, task_index,
         level=os.environ.get("TFOS_LOG_LEVEL", "INFO"),
         format="%(asctime)s %(levelname)s trainer[{}] %(name)s: %(message)s"
         .format(executor_id))
+    # chaos.py scoping: `only=EID` injections fire in the one trainer
+    # whose executor matches (how a blacklist test kills one node of N)
+    os.environ["TFOS_TRAINER_EXECUTOR_ID"] = str(executor_id)
     authkey = bytes.fromhex(cluster_meta["authkey"])
     multiprocessing.current_process().authkey = authkey
     _register_filesystems(cluster_meta)  # spawn mode starts from scratch
@@ -628,19 +732,31 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
     """
 
     def _train(iterator):
-        mgr = _get_manager(cluster_info, cluster_meta, _local_executor_id())
-        state = mgr.get("state")
-        if state in ("terminating", "stopped", "error"):
-            logger.info("feed task skipping: node state is %r", state)
-            # Drain the partition so upstream iterators don't block.
-            for _ in iterator:
-                pass
-            return
-        count = _feed_partition(iterator, mgr, qname, feed_timeout)
-        _join_feed(mgr, qname, feed_timeout)  # until the partition is consumed
-        logger.info("fed %d records to %r", count, qname)
+        _feed_one_partition(iterator, cluster_info, cluster_meta,
+                            feed_timeout, qname)
 
     return _train
+
+
+def _feed_one_partition(iterator, cluster_info, cluster_meta, feed_timeout,
+                        qname="input"):
+    """Feed one partition into this executor's node; True iff the node
+    consumed it fully (the feed-level acknowledgement supervisor.py's
+    replay bookkeeping is built on). Shared by the plain ``train``
+    closure and the supervised acked-feed closure."""
+    mgr = _get_manager(cluster_info, cluster_meta, _local_executor_id())
+    state = mgr.get("state")
+    if state in ("terminating", "stopped", "error"):
+        logger.info("feed task skipping: node state is %r", state)
+        # Drain the partition so upstream iterators don't block.
+        for _ in iterator:
+            pass
+        return False
+    count = _feed_partition(iterator, mgr, qname, feed_timeout)
+    # block (bounded) until the partition is consumed
+    consumed = _join_feed(mgr, qname, feed_timeout)
+    logger.info("fed %d records to %r (consumed=%s)", count, qname, consumed)
+    return bool(consumed)
 
 
 def _feed_ring(qname):
@@ -795,12 +911,17 @@ def _feed_partition(iterator, mgr, qname, feed_timeout, cancel=None):
     end = marker.EndPartition()
     if prev is None:
         put(end, deadline)
-    elif ring is not None:
+    else:
+        # Both transports coalesce the final chunk with its EndPartition
+        # into ONE message. On the ring that halves the tail's message
+        # count; on the queue it additionally makes the partition ack
+        # prompt: the consumer unpacks the marker in the same next_batch
+        # call that returns the final chunk, so ``queue.join()`` — and a
+        # supervised feed's ACK — completes with the batch, not one call
+        # later (the off-by-one that would make a kill-after-step-N
+        # replay an already-consumed partition).
         from tensorflowonspark_tpu import frames as frames_lib
         put(frames_lib.FrameList([prev, end]), deadline)
-    else:
-        put(prev, deadline)
-        put(end, deadline)
     return count
 
 
@@ -1225,6 +1346,30 @@ def shutdown(cluster_info, cluster_meta, queues=("input",), grace_secs=0):
                 and proc.exitcode not in (0, None)):
             errors.append("trainer exited with code {} without reporting "
                           "an error (killed?)".format(proc.exitcode))
+
+        # Final supervision beat, SYNCHRONOUS and best-effort: popping
+        # cluster_id above silenced the beat thread, and a failure whose
+        # whole window (crash -> this teardown) fits inside one beat
+        # interval would otherwise never ride a beat at all — the
+        # supervisor would see only an unattributable shutdown error.
+        # This task is still running, so the driver's shutdown .get() is
+        # still blocked and the reservation server is provably alive:
+        # the terminal evidence (state, exit code) lands in the lease
+        # BEFORE the error below reaches the driver.
+        try:
+            exit_code = None if proc is None else proc.exitcode
+            fc = reservation.Client(tuple(cluster_meta["server_addr"]))
+            try:
+                fc.beat(_local_executor_id(), {
+                    "state": mgr.get("state"), "trainer_exit": exit_code,
+                    "trainer_alive": False if proc is not None else None,
+                    "executor_id": _local_executor_id(), "final": True,
+                    "errors": len(errors)})
+            finally:
+                fc.close()
+        except Exception:  # noqa: BLE001 - server may already be gone
+            pass
+
         if errors:
             raise RuntimeError(
                 "trainer on executor {} failed:\n{}".format(

@@ -17,6 +17,14 @@ TPU-native differences from the reference's design:
   chief's (host, coordinator_port) becomes the
   ``jax.distributed.initialize`` coordinator address — the piece
   ``TF_CONFIG`` provided in the reference.
+- The service stays up after the barrier opens and carries the
+  *supervision plane* (supervisor.py): BEAT messages register per-executor
+  heartbeat leases (liveness + a small status payload the driver-side
+  Supervisor classifies), and ACK messages record fed partitions as
+  consumed so a restart-from-checkpoint recovery replays only the
+  unacknowledged ones. The reference's server spoke only
+  REG/QUERY/QINFO/STOP and went idle after formation (SURVEY.md §5: no
+  failure detection beyond Spark task retry).
 """
 
 import json
@@ -140,6 +148,24 @@ class Server(object):
         self._sock = None
         self._thread = None
         self.done = threading.Event()
+        # supervision plane: heartbeat leases + consumed-partition acks
+        # (read by supervisor.Supervisor, which runs in this process)
+        self._sup_lock = threading.Lock()
+        self._leases = {}   # executor_id -> (monotonic recv time, payload)
+        self._acked = set()  # partition ids fully consumed by a trainer
+
+    def lease_snapshot(self):
+        """{executor_id: {"age": seconds since last beat, "payload": ...}}
+        — the supervisor's raw liveness view."""
+        now = time.monotonic()
+        with self._sup_lock:
+            return {eid: {"age": now - t, "payload": dict(payload)}
+                    for eid, (t, payload) in self._leases.items()}
+
+    def acked_partitions(self):
+        """Partition ids acknowledged as fully consumed (stable copy)."""
+        with self._sup_lock:
+            return set(self._acked)
 
     def start(self, host=None):
         """Bind and serve in the background; returns (host, port)."""
@@ -183,6 +209,19 @@ class Server(object):
                 elif mtype == "QINFO":
                     ms.send({"type": "INFO", "meta": self.reservations.get(),
                              "done": self.reservations.done()})
+                elif mtype == "BEAT":
+                    with self._sup_lock:
+                        self._leases[msg.get("executor_id")] = (
+                            time.monotonic(), msg.get("payload") or {})
+                    ms.send({"type": "OK"})
+                elif mtype == "ACK":
+                    with self._sup_lock:
+                        self._acked.add(msg.get("partition"))
+                    ms.send({"type": "OK"})
+                elif mtype == "ACKS":
+                    with self._sup_lock:
+                        acked = sorted(self._acked)
+                    ms.send({"type": "ACKS", "partitions": acked})
                 elif mtype == "STOP":
                     self.done.set()
                     self._close_listener()  # unblock _serve's accept()
@@ -271,6 +310,31 @@ class Client(object):
             time.sleep(poll_interval)
             # back off gently to keep the driver's accept loop unloaded
             poll_interval = min(poll_interval * 1.5, 2.0)
+
+    def beat(self, executor_id, payload=None):
+        """Refresh this executor's heartbeat lease (supervision plane).
+        ``payload`` is a small JSON-able status dict (trainer liveness,
+        feed progress, train step) the Supervisor classifies."""
+        resp = self._call({"type": "BEAT", "executor_id": executor_id,
+                           "payload": payload or {}})
+        if resp.get("type") != "OK":
+            raise RuntimeError("beat rejected: {!r}".format(resp))
+
+    def ack(self, partition):
+        """Record feed partition ``partition`` as fully consumed; a
+        supervised restart skips acked partitions on replay."""
+        resp = self._call({"type": "ACK", "partition": partition})
+        if resp.get("type") != "OK":
+            raise RuntimeError("ack rejected: {!r}".format(resp))
+
+    def acked(self):
+        """Partitions acknowledged so far (the driver-side view a trainer
+        or test can poll to observe the exactly-once boundary — e.g.
+        'my step N's partition has been recorded consumed')."""
+        resp = self._call({"type": "ACKS"})
+        if resp.get("type") != "ACKS":
+            raise RuntimeError("acks query rejected: {!r}".format(resp))
+        return set(resp.get("partitions") or ())
 
     def request_stop(self):
         try:

@@ -318,6 +318,21 @@ class DecodeEngine(object):
         """Blocking convenience: submit + result."""
         return self.submit(prompt, max_new_tokens).result(timeout)
 
+    def healthy(self):
+        """Scheduler-liveness report: {alive, scheduler_thread, stopping,
+        broken}. ``alive`` is the serving-fitness verdict — False once
+        the scheduler thread died (uncaught loop error), broke, or the
+        engine was stopped. supervisor.Supervisor.watch polls this and
+        ModelServer's /healthz reports it (503 when not alive)."""
+        with self._cv:
+            broken = self._broken
+            stopping = self._stopping
+        thread_alive = self._thread.is_alive()
+        return {"alive": thread_alive and not stopping and broken is None,
+                "scheduler_thread": thread_alive,
+                "stopping": stopping,
+                "broken": str(broken) if broken is not None else None}
+
     def compile_stats(self):
         """Live program counts for the engine's jitted fns (shared per
         (model, sampling-config) via ``generation.slot_step_fns``, so
@@ -786,6 +801,9 @@ class ModelServer(object):
         self._httpd = None
         self._thread = None
         self._host, self._port = host, port
+        #: set by supervisor.Supervisor.watch (or any operator hook) when
+        #: the serving path is known-bad; /healthz then answers 503
+        self._unhealthy = None
 
     # -- request handling ------------------------------------------------
 
@@ -851,6 +869,47 @@ class ModelServer(object):
                 "metadata": {"signature_def": self.signature,
                              "format": "tfos-tpu-export-v1"}}
 
+    # -- health (supervision plane) ---------------------------------------
+
+    def mark_unhealthy(self, reason):
+        """Flip /healthz to 503. Called by supervisor.Supervisor.watch
+        when the watched engine's scheduler dies, or by any operator
+        hook; load balancers drain the replica instead of timing out
+        against a server whose accept loop is fine but whose decode
+        plane is gone."""
+        self._unhealthy = str(reason)
+        logger.error("serving marked unhealthy: %s", reason)
+
+    def healthz(self):
+        """(status_code, body) for GET /healthz.
+
+        503 once the supervisor marked the server unhealthy OR the
+        mounted engine's scheduler is dead (checked live, so even an
+        unwatched server stops answering 200 over a dead decode plane).
+        The body carries the engine's liveness detail plus the
+        queue-depth / slot-occupancy gauges and token counts from its
+        tracing.Counters — the numbers an operator needs to tell "dead"
+        from "saturated"."""
+        body = {"status": "ok", "model": self.name}
+        engine = self.engine
+        if engine is not None:
+            health = engine.healthy()
+            snap = engine.counters.snapshot()
+            body["engine"] = health
+            body["queue_depth"] = snap["gauges"].get("queue_depth", 0)
+            body["slot_occupancy"] = snap["gauges"].get("slot_occupancy", 0)
+            body["counts"] = snap["counts"]
+            if not health["alive"]:
+                body["status"] = "unhealthy"
+                body["reason"] = health.get("broken") or \
+                    "decode engine scheduler is not running"
+                return 503, body
+        if self._unhealthy is not None:
+            body["status"] = "unhealthy"
+            body["reason"] = self._unhealthy
+            return 503, body
+        return 200, body
+
     def status(self):
         return {"model_version_status": [{
             "version": "1", "state": "AVAILABLE",
@@ -874,6 +933,8 @@ class ModelServer(object):
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self.path == "/healthz":
+                    return self._send(*server.healthz())
                 base = "/v1/models/%s" % server.name
                 if self.path == base:
                     return self._send(200, server.status())

@@ -1,0 +1,876 @@
+"""Supervision plane: failure detection, classification, and recovery.
+
+The reference has no answer to a mid-job trainer or executor death
+beyond Spark's coarse task retry (SURVEY.md §5): a killed trainer
+strands the reservation barrier and the whole job reruns from scratch.
+This module is the missing subsystem — it AGGREGATES liveness from three
+signals the framework already produces, CLASSIFIES the failure, and
+DRIVES a pluggable recovery policy end to end:
+
+Signals (all ride the per-executor heartbeat lease that node.py's beat
+thread publishes through the existing reservation ``Server``):
+
+1. the lease itself — a missing/expired lease is executor loss (the
+   whole bootstrap process died or went dark);
+2. DataFeed progress counters (``feed_hb`` batches-served, via the
+   broker kv) — a frozen counter with a live trainer is a feed-plane
+   stall (queue transport) or ring wedge (shm transport);
+3. trainer-process exit status surfaced by node.py's watchdog — an
+   abnormal exit code (OOM SIGKILL's ``-9``) is a trainer crash.
+
+Failure taxonomy: ``trainer_crash`` | ``feeder_stall`` | ``ring_wedge``
+| ``executor_lost`` (plus ``engine_dead`` for watched serving engines
+and synthesized kinds for failures that surface as task errors before a
+beat can attribute them). docs/fault_tolerance.md has the policy matrix.
+
+Recovery policies:
+
+- :class:`RestartFromCheckpoint` — bounded retries with exponential
+  backoff: tear the attempt down, resubmit the job via ``cluster.run``,
+  let the map_fun restore the latest step through
+  ``checkpoint.Checkpointer`` (the proven resubmit+restore story from
+  tests/test_resume.py), and replay only the feed partitions no trainer
+  acknowledged as consumed.
+- :class:`Blacklist` — additionally exclude an executor that failed
+  ``max_failures`` times and reform the cluster at reduced width (the
+  built-in engine's job scheduler honors the exclusion).
+- :class:`FailJob` — clean teardown, error re-raised on the driver
+  (exactly today's unsupervised behavior, made explicit).
+
+Entry point: ``cluster.run(..., supervise=SupervisorConfig(...))``
+returns a :class:`SupervisedCluster` with the familiar
+``train``/``shutdown`` surface. The serving plane hooks in through
+:meth:`Supervisor.watch`, which marks a ``ModelServer`` unhealthy (503
+on ``/healthz``) the moment its ``DecodeEngine`` scheduler thread dies.
+
+Replay granularity and the delivery guarantee, stated precisely:
+partitions are acknowledged when the node *consumed* them (feeder join
+succeeded) — NOT when a checkpoint covering them committed. Replay
+never double-feeds an acked partition, so records consumed after the
+last committed checkpoint are lost with the crashed trainer's state
+(at-most-once over that window), while unacked partitions replay in
+full. Recovery is therefore exactly-once precisely when every consumed
+partition's checkpoint committed before the crash — the aligned
+one-partition-per-checkpointed-step shape ``bench.py recovery`` and
+tests/test_recovery.py pin, where the consume→commit window is the gap
+between a partition's final ``next_batch`` and that step's
+``ckpt.save`` returning. A map_fun that checkpoints coarser (or an
+uncontrolled crash landing inside that window) under-counts rather
+than double-counts; both modes remain strictly tighter than the
+reference's whole-job rerun, but choose checkpoint cadence knowing
+which side of the boundary you are on.
+"""
+
+import logging
+import threading
+import time
+
+from tensorflowonspark_tpu import tracing
+
+logger = logging.getLogger(__name__)
+
+#: classification kinds the monitor emits from lease evidence
+KINDS = ("trainer_crash", "feeder_stall", "ring_wedge", "executor_lost")
+
+
+class FailureEvent(object):
+    """One classified failure: what died, where, and the evidence."""
+
+    __slots__ = ("kind", "executor_id", "detail", "payload", "t", "wall")
+
+    def __init__(self, kind, executor_id=None, detail="", payload=None):
+        self.kind = kind
+        self.executor_id = executor_id
+        self.detail = detail
+        self.payload = payload or {}
+        self.t = time.monotonic()
+        self.wall = time.time()
+
+    def as_dict(self):
+        return {"kind": self.kind, "executor_id": self.executor_id,
+                "detail": self.detail, "wall": self.wall}
+
+    def __str__(self):
+        where = "" if self.executor_id is None \
+            else " on executor {}".format(self.executor_id)
+        return "{}{}: {}".format(self.kind, where, self.detail)
+
+
+class Decision(object):
+    """A policy's verdict on one failure."""
+
+    __slots__ = ("action", "delay", "exclude", "reason")
+
+    FAIL = "fail"
+    RESTART = "restart"
+
+    def __init__(self, action, delay=0.0, exclude=frozenset(), reason=""):
+        self.action = action
+        self.delay = float(delay)
+        self.exclude = frozenset(exclude)
+        self.reason = reason
+
+
+class FailJob(object):
+    """Clean teardown; the error re-raises on the driver (the
+    unsupervised default, made explicit and composable)."""
+
+    def decide(self, event, restarts, failure_counts, excluded,
+               num_executors):
+        return Decision(Decision.FAIL,
+                        reason="FailJob policy: no recovery attempted")
+
+
+class RestartFromCheckpoint(object):
+    """Resubmit-and-restore with bounded exponential backoff.
+
+    ``max_restarts`` bounds recovery attempts across the job (not per
+    executor); backoff grows ``backoff * backoff_factor**restarts``
+    capped at ``max_backoff``. The restore itself happens trainer-side:
+    a supervised map_fun opens its ``checkpoint.Checkpointer`` and
+    restores the latest step (``fallback=True`` recommended — a writer
+    killed mid-commit can leave a corrupt latest), exactly the
+    resubmit+restore contract tests/test_resume.py proves.
+    """
+
+    def __init__(self, max_restarts=2, backoff=1.0, backoff_factor=2.0,
+                 max_backoff=60.0):
+        self.max_restarts = int(max_restarts)
+        self.backoff = float(backoff)
+        self.backoff_factor = float(backoff_factor)
+        self.max_backoff = float(max_backoff)
+
+    def decide(self, event, restarts, failure_counts, excluded,
+               num_executors):
+        if restarts >= self.max_restarts:
+            return Decision(
+                Decision.FAIL,
+                reason="gave up after {} restart(s)".format(restarts))
+        delay = min(self.backoff * self.backoff_factor ** restarts,
+                    self.max_backoff)
+        return Decision(Decision.RESTART, delay=delay,
+                        reason="restart {} of {}".format(
+                            restarts + 1, self.max_restarts))
+
+
+class Blacklist(RestartFromCheckpoint):
+    """RestartFromCheckpoint that additionally excludes a repeatedly
+    failing executor and reforms the cluster at reduced width.
+
+    ``max_failures``: attributed failures before an executor is
+    blacklisted. ``min_width``: floor on the reformed cluster's size —
+    dropping below it fails the job (a 1-node "cluster" may be exactly
+    what you want for drain-and-finish, or not; choose explicitly).
+    """
+
+    def __init__(self, max_failures=2, min_width=1, max_restarts=4, **kw):
+        super(Blacklist, self).__init__(max_restarts=max_restarts, **kw)
+        self.max_failures = int(max_failures)
+        self.min_width = int(min_width)
+
+    def decide(self, event, restarts, failure_counts, excluded,
+               num_executors):
+        base = super(Blacklist, self).decide(
+            event, restarts, failure_counts, excluded, num_executors)
+        if base.action == Decision.FAIL:
+            return base
+        newly = {eid for eid, n in failure_counts.items()
+                 if eid is not None and n >= self.max_failures} \
+            - set(excluded)
+        width_after = num_executors - len(set(excluded) | newly)
+        if newly and width_after < self.min_width:
+            return Decision(
+                Decision.FAIL,
+                reason="blacklisting {} would shrink the cluster below "
+                       "min_width={}".format(sorted(newly), self.min_width))
+        reason = base.reason
+        if newly:
+            reason += "; blacklisting executor(s) {} -> width {}".format(
+                sorted(newly), width_after)
+        return Decision(Decision.RESTART, delay=base.delay, exclude=newly,
+                        reason=reason)
+
+
+class SupervisorConfig(object):
+    """Knobs for the supervision plane.
+
+    Args:
+      policy: recovery policy (default :class:`RestartFromCheckpoint`).
+      heartbeat_interval: seconds between node heartbeat-lease beats
+        (shipped to nodes via cluster_meta).
+      heartbeat_timeout: lease age classified as executor loss. Must
+        comfortably exceed the interval; 5x is a sane floor.
+      stall_timeout: seconds of frozen feed progress (with a live
+        trainer) classified as feeder stall / ring wedge. Set it above
+        the slowest legitimate step time.
+      poll_interval: monitor classification cadence.
+      classify_grace: how long a surfaced task error waits for the
+        monitor to attribute it to a lease before a generic event is
+        synthesized.
+      shutdown_timeout / drain_timeout: bounds on attempt teardown and
+        post-abort job drain — a recovery must never hang on the very
+        wedge it is recovering from.
+    """
+
+    def __init__(self, policy=None, heartbeat_interval=1.0,
+                 heartbeat_timeout=15.0, stall_timeout=120.0,
+                 poll_interval=0.5, classify_grace=3.0,
+                 shutdown_timeout=120.0, drain_timeout=60.0):
+        self.policy = policy if policy is not None else RestartFromCheckpoint()
+        self.heartbeat_interval = float(heartbeat_interval)
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.stall_timeout = float(stall_timeout)
+        self.poll_interval = float(poll_interval)
+        self.classify_grace = float(classify_grace)
+        self.shutdown_timeout = float(shutdown_timeout)
+        self.drain_timeout = float(drain_timeout)
+
+
+class Supervisor(object):
+    """Driver-side monitor: aggregates leases, classifies failures,
+    tracks recovery milestones, and watches serving engines.
+
+    One instance supervises one cluster *attempt* (bound to that
+    attempt's reservation ``Server``); the shared :class:`tracing
+    .EventLog` carries the timeline across attempts. Also usable
+    standalone (``Supervisor()``) purely as an engine watcher via
+    :meth:`watch`.
+    """
+
+    def __init__(self, server=None, executors=(), config=None, events=None,
+                 attempt=1):
+        self.server = server
+        self.executors = list(executors)
+        self.config = config or SupervisorConfig()
+        self.events = events if events is not None else tracing.EventLog()
+        self.attempt = attempt
+        self._lock = threading.Lock()
+        self._failures = []
+        self._failure_evt = threading.Event()
+        self._reported = set()      # executor ids already attributed
+        self._progress = {}         # eid -> (feed_hb value, t of change)
+        self._restored_step = None
+        self._restored_seen = False
+        self._first_step_seen = False
+        self._watched = []          # serving engines under watch
+        self._stop = threading.Event()
+        self._thread = None
+        self._started = time.monotonic()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="tfos-supervisor", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 - monitor must survive
+                logger.exception("supervisor poll failed")
+            self._stop.wait(self.config.poll_interval)
+
+    # -- classification --------------------------------------------------
+
+    def poll_once(self, now=None):
+        """One classification pass (the monitor thread's body; exposed
+        so unit tests drive it deterministically without the thread)."""
+        now = now if now is not None else time.monotonic()
+        if self.server is not None:
+            leases = self.server.lease_snapshot()
+            for event in self._classify(leases, now):
+                self._report(event)
+            self._track_recovery(leases)
+        self._check_watched()
+
+    def _classify(self, leases, now):
+        """Lease snapshot -> new FailureEvents (one per executor, ever:
+        an executor already attributed stays attributed)."""
+        events = []
+        cfg = self.config
+        for eid in self.executors:
+            if eid in self._reported:
+                continue
+            lease = leases.get(eid)
+            if lease is None:
+                # never beat at all: only suspicious once formation slack
+                # has passed (the barrier opened before we were built, so
+                # the first beat should land within one timeout)
+                if now - self._started > cfg.heartbeat_timeout:
+                    events.append(FailureEvent(
+                        "executor_lost", eid,
+                        "no heartbeat lease registered within "
+                        "{:.0f}s".format(cfg.heartbeat_timeout)))
+                continue
+            payload = lease["payload"]
+            state = payload.get("state")
+            if state == "stopped":
+                # Node lifecycle completed cleanly: nothing to classify.
+                continue
+            if lease["age"] > cfg.heartbeat_timeout \
+                    and state != "terminating":
+                # 'terminating' leases age out BY DESIGN: teardown
+                # silences the beat thread after one final synchronous
+                # beat, so a finished node aging past heartbeat_timeout
+                # during a slow sibling's shutdown is NOT executor loss
+                # (misattributing it would poison Blacklist's
+                # failure_counts for a healthy executor). Crash evidence
+                # carried by that final beat still classifies below.
+                events.append(FailureEvent(
+                    "executor_lost", eid,
+                    "heartbeat lease expired (age {:.1f}s > "
+                    "{:.0f}s)".format(lease["age"], cfg.heartbeat_timeout),
+                    payload))
+                continue
+            exit_code = payload.get("trainer_exit")
+            if exit_code not in (None, 0):
+                events.append(FailureEvent(
+                    "trainer_crash", eid,
+                    "trainer exited with code {}".format(exit_code),
+                    payload))
+                continue
+            if state == "error":
+                events.append(FailureEvent(
+                    "trainer_crash", eid, "node state is 'error'", payload))
+                continue
+            if payload.get("trainer_alive") is False and exit_code is None \
+                    and state == "running":
+                events.append(FailureEvent(
+                    "trainer_crash", eid,
+                    "trainer process dead with no exit status", payload))
+                continue
+            hb = payload.get("feed_hb")
+            if hb is None or state != "running":
+                continue
+            prev = self._progress.get(eid)
+            if prev is None or prev[0] != hb:
+                self._progress[eid] = (hb, now)
+            elif now - prev[1] > cfg.stall_timeout:
+                kind = "ring_wedge" \
+                    if payload.get("feed_transport") == "shm" \
+                    else "feeder_stall"
+                events.append(FailureEvent(
+                    kind, eid,
+                    "feed progress frozen at {} batches for {:.0f}s "
+                    "with a live trainer".format(hb, now - prev[1]),
+                    payload))
+        return events
+
+    def _report(self, event):
+        with self._lock:
+            if event.executor_id is not None:
+                self._reported.add(event.executor_id)
+            self._failures.append(event)
+        self.events.record("failure_detected", attempt=self.attempt,
+                           kind=event.kind, executor=event.executor_id,
+                           detail=event.detail)
+        logger.error("supervisor detected failure: %s", event)
+        self._failure_evt.set()
+
+    def _track_recovery(self, leases):
+        """Record the restore / first-post-restore-step milestones the
+        MTTR stage breakdown is computed from."""
+        for eid, lease in leases.items():
+            payload = lease["payload"]
+            restored = payload.get("restored_step")
+            if restored is not None and not self._restored_seen:
+                self._restored_seen = True
+                self._restored_step = int(restored)
+                self.events.record("restored", attempt=self.attempt,
+                                   step=int(restored), executor=eid)
+            step = payload.get("train_step")
+            if step is not None and self._restored_seen \
+                    and not self._first_step_seen \
+                    and int(step) > (self._restored_step or 0):
+                self._first_step_seen = True
+                self.events.record("first_step", attempt=self.attempt,
+                                   step=int(step), executor=eid)
+
+    # -- failure access --------------------------------------------------
+
+    def first_failure(self):
+        with self._lock:
+            return self._failures[0] if self._failures else None
+
+    def failures(self):
+        with self._lock:
+            return list(self._failures)
+
+    def wait_for_failure(self, timeout):
+        self._failure_evt.wait(timeout)
+        return self.first_failure()
+
+    # -- serving-plane watch ---------------------------------------------
+
+    def watch(self, engine, server=None):
+        """Watch a serving ``DecodeEngine``; when its scheduler thread
+        dies (or the engine breaks), mark ``server`` (a ``ModelServer``)
+        unhealthy so ``GET /healthz`` answers 503 — a dead scheduler
+        must not leave the HTTP surface answering as if healthy."""
+        self._watched.append({"engine": engine, "server": server,
+                              "dead": False})
+        self.start()
+        return self
+
+    def _check_watched(self):
+        for entry in self._watched:
+            if entry["dead"]:
+                continue
+            health = entry["engine"].healthy()
+            if health.get("alive"):
+                continue
+            entry["dead"] = True
+            reason = "decode engine scheduler dead: {}".format(
+                health.get("broken") or
+                ("stopped" if health.get("stopping")
+                 else "scheduler thread exited"))
+            self.events.record("engine_dead", reason=reason)
+            if entry["server"] is not None:
+                entry["server"].mark_unhealthy(reason)
+            self._report(FailureEvent("engine_dead", None, reason))
+
+    # -- remote abort ----------------------------------------------------
+
+    def abort_attempt(self, cluster_info, cluster_meta, reason):
+        """Flip every node's broker state to 'error' so blocked feeders,
+        joins, and DataFeed consumers unwind (their bounded waits all
+        check state) — the driver's only lever against a wedge that will
+        never surface a task error on its own. Best effort per node."""
+        import multiprocessing
+
+        from tensorflowonspark_tpu import manager
+        authkey = bytes.fromhex(cluster_meta["authkey"])
+        multiprocessing.current_process().authkey = authkey
+        for node_meta in cluster_info:
+            try:
+                mgr = manager.connect(tuple(node_meta["mgr_addr"]), authkey)
+                try:
+                    mgr.get_queue("error").put(
+                        "supervisor abort: {}".format(reason), block=False)
+                except Exception:  # noqa: BLE001 - error queue may be full
+                    pass
+                mgr.set("state", "error")
+            except Exception:  # noqa: BLE001 - node may be gone entirely
+                logger.debug("abort could not reach executor %s",
+                             node_meta.get("executor_id"), exc_info=True)
+
+
+# -- supervised feed closures (run on executors) ---------------------------
+
+def _drain_iter(iterator):
+    for _ in iterator:
+        pass
+
+
+def acked_feed(cluster_info, cluster_meta, acked, feed_timeout=600,
+               qname="input"):
+    """Feed closure for ``mapPartitionsWithIndex``: feeds a partition to
+    the local node and ACKs it against the reservation server once the
+    node consumed it; partitions in ``acked`` (consumed by a previous
+    attempt) are drained without feeding — the replay-only-unacked
+    mechanic of RestartFromCheckpoint."""
+    acked = frozenset(acked)
+
+    def _fn(idx, iterator):
+        from tensorflowonspark_tpu import node as node_mod
+        from tensorflowonspark_tpu import reservation as reservation_mod
+        if idx in acked:
+            for _ in iterator:
+                pass
+            return iter(())
+        consumed = node_mod._feed_one_partition(
+            iterator, cluster_info, cluster_meta, feed_timeout, qname)
+        if consumed:
+            client = reservation_mod.Client(cluster_meta["server_addr"])
+            try:
+                client.ack(idx)
+            finally:
+                client.close()
+        return iter(())
+
+    return _fn
+
+
+# -- trainer-side helpers --------------------------------------------------
+
+class TrainerSide(object):
+    """Trainer-process handle publishing recovery milestones.
+
+    Writes ``restored_step`` / ``train_step`` into the node's broker kv,
+    which the heartbeat lease carries to the driver — how the supervisor
+    sees "restore finished" and "first post-restore step" without any
+    new channel. Also hosts the chaos kill-at-step injection site, AFTER
+    the step (and its checkpoint) committed, so a killed step N is
+    restorable at N.
+    """
+
+    def __init__(self, mgr, restored_step=None):
+        self.mgr = mgr
+        if restored_step is not None:
+            self.report_restore(restored_step)
+
+    def report_restore(self, step):
+        self.mgr.set("restored_step", int(step))
+        self.mgr.set("train_step", int(step))
+
+    def step(self, step):
+        from tensorflowonspark_tpu import chaos
+        self.mgr.set("train_step", int(step))
+        chaos.on_step(int(step))
+
+    def hook(self, base=0):
+        """``Trainer.train_loop`` hook: publishes ``base + step_no``."""
+        def _hook(step_no, state, metrics):
+            self.step(base + step_no)
+        return _hook
+
+
+def attach(ctx, restored_step=None):
+    """Supervision-aware map_fun boilerplate::
+
+        restored = ckpt.restore(state, fallback=True)
+        start = 0 if restored is None else int(restored["step"])
+        sup = supervisor.attach(ctx, restored_step=start)
+        ...
+        sup.step(int(state["step"]))   # after each step's checkpoint
+    """
+    return TrainerSide(ctx.mgr, restored_step=restored_step)
+
+
+# -- MTTR extraction -------------------------------------------------------
+
+def recovery_stages(events, kill_wall=None):
+    """MTTR stage breakdown from a supervision :class:`tracing.EventLog`.
+
+    Stages (seconds, None when the span's endpoints are absent):
+    ``detect`` (fault injection -> failure_detected; needs ``kill_wall``,
+    e.g. a chaos fuse's fire time), ``reform`` (failure_detected ->
+    cluster_formed), ``restore`` (cluster_formed -> restored), and
+    ``first_step`` (restored -> first post-restore step). ``mttr_s`` is
+    fault->first_step when ``kill_wall`` is known, else
+    detection->first_step.
+    """
+    detected = events.last("failure_detected")
+    if detected is None:
+        return None
+
+    def _after(name):
+        for event in events.events(name):
+            if event["t"] >= detected["t"]:
+                return event
+        return None
+
+    formed = _after("cluster_formed")
+    restored = _after("restored")
+    first = _after("first_step")
+
+    def _span(a, b):
+        return None if a is None or b is None else round(b["t"] - a["t"], 3)
+
+    out = {
+        "detect_s": None if kill_wall is None
+        else round(detected["wall"] - kill_wall, 3),
+        "reform_s": _span(detected, formed),
+        "restore_s": _span(formed, restored),
+        "first_step_s": _span(restored, first),
+    }
+    if first is not None:
+        out["mttr_s"] = round(first["wall"] - kill_wall, 3) \
+            if kill_wall is not None else round(first["t"] - detected["t"], 3)
+    else:
+        out["mttr_s"] = None
+    return out
+
+
+# -- the supervised cluster lifecycle --------------------------------------
+
+class SupervisedCluster(object):
+    """``cluster.run(..., supervise=cfg)``'s return value: the familiar
+    ``train``/``shutdown`` surface with the detect->decide->recover loop
+    inside.
+
+    Built-in-engine semantics: attempts reform clusters on the same
+    executor processes (a dead trainer is a child process; the executor
+    survives), and :class:`Blacklist` exclusions route jobs away from an
+    executor without restarting the engine. InputMode.SPARK jobs replay
+    only unacked feed partitions; InputMode.TENSORFLOW jobs resubmit the
+    whole (self-reading) map_fun, which restores from its checkpoint.
+    """
+
+    def __init__(self, sc, map_fun, tf_args, num_executors, config=None,
+                 run_kwargs=None):
+        from tensorflowonspark_tpu import cluster as cluster_mod
+        self._cluster_mod = cluster_mod
+        self.sc = sc
+        self.map_fun = map_fun
+        self.tf_args = tf_args
+        self.num_executors = int(num_executors)
+        self.config = config if isinstance(config, SupervisorConfig) \
+            else SupervisorConfig()
+        self.run_kwargs = dict(run_kwargs or {})
+        self.input_mode = self.run_kwargs.get(
+            "input_mode", cluster_mod.InputMode.SPARK)
+        self.events = tracing.EventLog()
+        self.excluded = set()
+        self.failure_counts = {}
+        self.attempts = []          # one dict per FAILED attempt
+        self.formations = 0
+        self._acked = set()
+        self._tfc = None
+        self._supervisor = None
+        self._done = False
+        self.events.record("job_start", num_executors=self.num_executors)
+        self._form()
+
+    # -- public surface --------------------------------------------------
+
+    @property
+    def cluster_info(self):
+        return self._tfc.cluster_info if self._tfc is not None else None
+
+    def tensorboard_url(self):
+        return self._tfc.tensorboard_url() if self._tfc is not None else None
+
+    def train(self, dataRDD, num_epochs=0, feed_timeout=600, qname="input"):
+        """Supervised feed: like ``TFCluster.train`` but partitions are
+        acked as consumed, failures classify and recover per the policy,
+        and the final (clean) shutdown happens inside — a successful
+        ``train`` leaves nothing running. Raises when the policy gives
+        up; ``report()`` carries the full timeline either way."""
+        InputMode = self._cluster_mod.InputMode
+        assert self.input_mode == InputMode.SPARK, \
+            "supervised train() requires InputMode.SPARK"
+        if hasattr(dataRDD, "foreachRDD"):
+            raise NotImplementedError(
+                "supervised streaming training is not supported; use the "
+                "unsupervised cluster for DStreams")
+        if num_epochs > 1:
+            dataRDD = self.sc.union([dataRDD] * num_epochs)
+        # the ack ledger is per-train(): partition ordinals are indices
+        # into THIS dataRDD, and a second train() on a fresh dataset
+        # must not inherit the first one's acks (it would silently drain
+        # every colliding partition unfed — total data loss dressed up
+        # as success)
+        self._acked = set()
+        self.events.record("train_start",
+                           partitions=dataRDD.getNumPartitions())
+        while True:
+            if self._tfc is None:
+                try:
+                    self._form()
+                except Exception as e:  # noqa: BLE001 - policy decides
+                    self._recover_or_raise(
+                        FailureEvent("reform_failed", None, str(e)))
+                    continue
+            failure = self._run_feed_attempt(dataRDD, feed_timeout, qname)
+            if failure is None:
+                failure = self._final_shutdown()
+                if failure is None:
+                    self._done = True
+                    self.events.record("job_complete",
+                                       formations=self.formations)
+                    return
+            self._recover_or_raise(failure)
+
+    def inference(self, dataRDD, feed_timeout=600, qname="output"):
+        raise NotImplementedError(
+            "supervised inference is not implemented: the result-RDD "
+            "contract (exactly one output row per input record) has no "
+            "replay story yet; run inference unsupervised")
+
+    def shutdown(self, ssc=None, grace_secs=0, timeout=None):
+        """SPARK mode: finalize (train() already supervised the work).
+        TENSORFLOW mode: the supervised attempt loop lives HERE — each
+        attempt awaits the inline map_fun job and a failure reforms the
+        cluster so the resubmitted map_fun restores from its checkpoint.
+        Returns :meth:`report`."""
+        if ssc is not None:
+            raise NotImplementedError(
+                "supervised streaming shutdown is not supported")
+        InputMode = self._cluster_mod.InputMode
+        while not self._done:
+            if self._tfc is None:
+                try:
+                    self._form()
+                except Exception as e:  # noqa: BLE001 - policy decides
+                    self._recover_or_raise(
+                        FailureEvent("reform_failed", None, str(e)))
+                    continue
+            if self.input_mode == InputMode.TENSORFLOW:
+                failure = self._await_result(self._tfc.async_result)
+                if failure is None:
+                    failure = self._final_shutdown(grace_secs=grace_secs)
+            else:
+                failure = self._final_shutdown(grace_secs=grace_secs)
+            if failure is None:
+                self._done = True
+                self.events.record("job_complete",
+                                   formations=self.formations)
+                break
+            self._recover_or_raise(failure)
+        return self.report()
+
+    def report(self):
+        """The supervision ledger: formations, failures, exclusions,
+        ack coverage, MTTR stages, and the raw event timeline."""
+        return {
+            "formations": self.formations,
+            "failures": [a["failure"] for a in self.attempts],
+            "excluded": sorted(self.excluded),
+            "acked_partitions": len(self._acked),
+            "recovery": recovery_stages(self.events),
+            "events": self.events.events(),
+        }
+
+    # -- attempt machinery -----------------------------------------------
+
+    def _form(self):
+        width = self.num_executors - len(self.excluded)
+        attempt_no = len(self.attempts) + 1
+        self.events.record("reform_start", attempt=attempt_no, width=width)
+        tfc = self._cluster_mod.run(
+            self.sc, self.map_fun, self.tf_args, width,
+            exclude_executors=frozenset(self.excluded),
+            beat_interval=self.config.heartbeat_interval,
+            **self.run_kwargs)
+        self.formations += 1
+        self._tfc = tfc
+        self._supervisor = Supervisor(
+            server=tfc.server, executors=tfc.executor_ids,
+            config=self.config, events=self.events,
+            attempt=attempt_no).start()
+        self.events.record("cluster_formed", attempt=attempt_no,
+                           width=width, executors=list(tfc.executor_ids))
+
+    def _run_feed_attempt(self, dataRDD, feed_timeout, qname):
+        tfc = self._tfc
+        mapped = dataRDD.mapPartitionsWithIndex(acked_feed(
+            tfc.cluster_info, tfc.cluster_meta, frozenset(self._acked),
+            feed_timeout=feed_timeout, qname=qname))
+        kwargs = {"exclude": tfc.exclude} if tfc.exclude else {}
+        result = mapped.foreachPartitionAsync(_drain_iter, **kwargs)
+        failure = self._await_result(result)
+        # harvest acks even on failure: the next attempt must not replay
+        # what this one's trainers already consumed
+        self._acked |= tfc.server.acked_partitions()
+        return failure
+
+    def _await_result(self, result):
+        """Poll a job result against the monitor; None on success, else
+        the classified FailureEvent. A monitor-detected failure aborts
+        the attempt remotely first so blocked tasks unwind."""
+        sup = self._supervisor
+        while True:
+            failure = sup.first_failure()
+            if failure is not None:
+                # monitor OFF before the remote abort: the abort flips
+                # every node's state to 'error', and a still-polling
+                # monitor would attribute those self-inflicted errors to
+                # healthy executors — poisoning failure_counts, which
+                # Blacklist decides exclusions from
+                sup.stop()
+                sup.abort_attempt(self._tfc.cluster_info,
+                                  self._tfc.cluster_meta, str(failure))
+                self._drain_result(result)
+                return failure
+            err = result.first_error()
+            if err is not None:
+                # task error beat the monitor: give classification one
+                # grace window to attribute it to a lease
+                failure = sup.wait_for_failure(self.config.classify_grace)
+                return failure if failure is not None else FailureEvent(
+                    "task_failure", None, str(err))
+            if result.done():
+                return None
+            time.sleep(self.config.poll_interval)
+
+    def _drain_result(self, result, timeout=None):
+        deadline = time.monotonic() + (timeout or self.config.drain_timeout)
+        while not result.done() and time.monotonic() < deadline:
+            time.sleep(0.1)
+
+    def _final_shutdown(self, grace_secs=0):
+        """Shut the live cluster down cleanly; None on success, else the
+        failure it surfaced (monitor-attributed when possible)."""
+        tfc, sup = self._tfc, self._supervisor
+        try:
+            tfc.shutdown(grace_secs=grace_secs,
+                         timeout=self.config.shutdown_timeout)
+        except Exception as e:  # noqa: BLE001 - classified below
+            # A shutdown-surfaced error is usually the monitor's failure
+            # seen through a different channel (a trainer killed so fast
+            # its node drained the whole feed as error-state no-ops, so
+            # the job "completed" before a beat carried the crash): give
+            # classification one grace window to attribute it to a lease
+            # — the exact analog of the task-error path in _await_result.
+            # An unattributed shutdown_failure carries no executor_id and
+            # can never advance Blacklist's failure_counts.
+            failure = sup.wait_for_failure(self.config.classify_grace) \
+                if sup is not None else None
+            self._stop_monitor()
+            self._tfc = None
+            return failure if failure is not None else FailureEvent(
+                "shutdown_failure", None, str(e))
+        self._stop_monitor()
+        self._tfc = None
+        return None
+
+    def _stop_monitor(self):
+        if self._supervisor is not None:
+            self._supervisor.stop()
+
+    def _teardown_attempt(self, attempt_no, failure):
+        self.events.record("attempt_teardown", attempt=attempt_no,
+                           kind=failure.kind)
+        self._stop_monitor()
+        tfc, self._tfc = self._tfc, None
+        if tfc is None:
+            return
+        try:
+            tfc.shutdown(grace_secs=1,
+                         timeout=self.config.shutdown_timeout)
+        except Exception as e:  # noqa: BLE001 - this IS the failure
+            logger.info("attempt %d teardown surfaced: %s", attempt_no, e)
+
+    def _recover_or_raise(self, failure):
+        attempt_no = len(self.attempts) + 1
+        restarts = len(self.attempts)  # restarts already performed
+        self.attempts.append({"attempt": attempt_no,
+                              "failure": failure.as_dict()})
+        if failure.executor_id is not None:
+            self.failure_counts[failure.executor_id] = \
+                self.failure_counts.get(failure.executor_id, 0) + 1
+        self._teardown_attempt(attempt_no, failure)
+        decision = self.config.policy.decide(
+            failure, restarts, dict(self.failure_counts),
+            frozenset(self.excluded), self.num_executors)
+        self.events.record("decision", attempt=attempt_no,
+                           action=decision.action, delay=decision.delay,
+                           exclude=sorted(decision.exclude),
+                           reason=decision.reason)
+        if decision.action == Decision.FAIL:
+            self._done = True
+            self.events.record("job_failed", attempt=attempt_no,
+                               kind=failure.kind)
+            raise RuntimeError(
+                "supervised job failed after {} attempt(s) — {} ({})".format(
+                    attempt_no, failure, decision.reason))
+        if decision.exclude:
+            self.excluded |= set(decision.exclude)
+            self.events.record("blacklisted",
+                               executors=sorted(decision.exclude))
+        if decision.delay:
+            logger.info("supervisor backing off %.1fs before restart",
+                        decision.delay)
+            time.sleep(decision.delay)
+        # the next loop iteration (train) or shutdown pass reforms

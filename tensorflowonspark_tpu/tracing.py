@@ -24,9 +24,15 @@ code; its own plumbing is unobservable. Here the framework exposes:
   loops: serving.DecodeEngine exports queue depth, slot occupancy, and
   tokens-per-step through one of these, and bench.py / scripts/
   profile_serving.py read the snapshots.
+- :class:`EventLog` — timestamped named events for the supervision plane
+  (supervisor.py): failure detected, attempt torn down, cluster
+  reformed, checkpoint restored, first post-restore step. The MTTR
+  numbers ``bench.py recovery`` and scripts/profile_recovery.py publish
+  are spans over one of these logs.
 """
 
 import logging
+import threading
 import time
 
 logger = logging.getLogger(__name__)
@@ -108,6 +114,59 @@ class Counters(object):
         occupancy per step."""
         d = self._counts.get(denominator, 0)
         return self._counts.get(numerator, 0) / d if d else 0.0
+
+
+class EventLog(object):
+    """Append-only timestamped event record for supervision timelines.
+
+    Each event carries both clocks: ``t`` (monotonic — span math) and
+    ``wall`` (epoch — correlating with out-of-process evidence like a
+    chaos fuse file's fire time). Thread-safe: the supervisor's monitor
+    thread and the supervised-run driver loop both append.
+    """
+
+    def __init__(self):
+        self._events = []
+        self._lock = threading.Lock()
+
+    def record(self, name, **detail):
+        """Append one event; returns its dict (already stamped)."""
+        event = {"name": name, "t": time.monotonic(), "wall": time.time()}
+        if detail:
+            event.update(detail)
+        with self._lock:
+            self._events.append(event)
+        logger.debug("event %s %s", name, detail)
+        return event
+
+    def events(self, name=None):
+        """All events (or those named ``name``), oldest first."""
+        with self._lock:
+            events = list(self._events)
+        if name is not None:
+            events = [e for e in events if e["name"] == name]
+        return events
+
+    def last(self, name, **match):
+        """Most recent event named ``name`` whose fields match, or None."""
+        for event in reversed(self.events(name)):
+            if all(event.get(k) == v for k, v in match.items()):
+                return event
+        return None
+
+    def span(self, from_name, to_name, **match):
+        """Seconds between the last matching ``from_name`` and the first
+        matching ``to_name`` at or after it; None when either is absent.
+        The from/to pairing is how MTTR stages (detect -> reform ->
+        restore -> first step) are extracted from one log."""
+        start = self.last(from_name, **match)
+        if start is None:
+            return None
+        for event in self.events(to_name):
+            if event["t"] >= start["t"] and \
+                    all(event.get(k) == v for k, v in match.items()):
+                return event["t"] - start["t"]
+        return None
 
 
 class _StageSpan(object):

@@ -1,4 +1,5 @@
-"""Chaos pass on the feed plane (VERDICT r4 task 7).
+"""Chaos pass on the feed plane (VERDICT r4 task 7; deflaked + folded
+into the chaos harness in PR 3).
 
 SIGKILL is the one exit that runs no handlers: no atexit, no except, no
 queue puts. These tests kill real processes at the worst moments —
@@ -7,22 +8,36 @@ mid-queue-join, the whole feeder/executor process mid-feed — and assert
 the three survival properties the reference's feed plane lacked
 (SURVEY.md §5 failure detection): no wedged feeder, a driver-side error
 that names the death, and no leaked /dev/shm segments afterwards.
+
+The kill choreography lives in chaos.py, not here: trainer-side kills
+are armed injection points (``TFOS_CHAOS`` rides executor_env into the
+forked trainer) fired at instrumented framework sites, and the
+out-of-process executor kill uses ``chaos.kill_when`` — every wait is
+event/deadline polling (``chaos.poll_until``), never a fixed sleep. The
+two load-sensitive variants VERDICT r5 flagged were flaky precisely
+because each test re-derived this logic with its own sleeps.
+
+Run via ``make chaos`` (serial, per-test wall-clock caps); the ``chaos``
+marker keeps the suite out of the tier-1 ``not slow`` gate.
 """
 
 import glob
 import os
-import signal
 import time
 
 import numpy as np
 import pytest
 
-from tensorflowonspark_tpu import cluster, shm
+from tensorflowonspark_tpu import chaos, cluster, shm
 from tensorflowonspark_tpu.engine import Context
 from tensorflowonspark_tpu.engine.context import TaskError
 
-pytestmark = pytest.mark.skipif(
-    not shm.available(), reason="native shm ring unavailable")
+pytestmark = [
+    pytest.mark.chaos,
+    pytest.mark.slow,
+    pytest.mark.skipif(not shm.available(),
+                       reason="native shm ring unavailable"),
+]
 
 RING_CAPACITY = 64 * 1024 * 1024  # the MIN_USEFUL_CAPACITY floor
 
@@ -31,26 +46,33 @@ def _rings():
     return glob.glob("/dev/shm/tfos-*")
 
 
-def _sc(tmp_path, transport, n=1):
-    return Context(
-        num_executors=n, work_root=str(tmp_path / "engine"),
-        executor_env={"TFOS_FEED_TRANSPORT": transport,
-                      "TFOS_SHM_CAPACITY": str(RING_CAPACITY)})
+def _sc(tmp_path, transport, n=1, chaos_spec=None):
+    env = {"TFOS_FEED_TRANSPORT": transport,
+           "TFOS_SHM_CAPACITY": str(RING_CAPACITY)}
+    if chaos_spec:
+        # the executor exports it; fork/spawn hands it to the trainer,
+        # whose instrumented sites (datafeed.next_batch) fire the kill
+        env[chaos.ENV_VAR] = chaos_spec
+    return Context(num_executors=n, work_root=str(tmp_path / "engine"),
+                   executor_env=env)
 
 
 def test_trainer_sigkill_mid_shm_write(tmp_path):
     """Feeder blocked INSIDE ring.write when the trainer dies: the bounded
     write's state check must abort the feed (no wedge), shutdown must
-    surface the kill, and the ring must not leak."""
-    def read_one_then_sigkill(args, ctx):
-        # trainer: prove the feed is live, then die the ugly way
-        feed = ctx.get_data_feed(train_mode=True)
-        feed.next_batch(8)
-        os.kill(os.getpid(), signal.SIGKILL)
+    surface the kill, and the ring must not leak.
 
-    sc = _sc(tmp_path, "shm")
+    Kill site: ``kill_trainer_at_batch=1`` — SIGKILL inside the first
+    ``next_batch`` return, while the feeder still has ~96MB to push
+    through a 64MB ring."""
+    def read_batches(args, ctx):
+        feed = ctx.get_data_feed(train_mode=True)
+        while not feed.should_stop():
+            feed.next_batch(8)  # chaos fires inside the first call
+
+    sc = _sc(tmp_path, "shm", chaos_spec="kill_trainer_at_batch=1")
     try:
-        tfc = cluster.run(sc, read_one_then_sigkill, {}, num_executors=1,
+        tfc = cluster.run(sc, read_batches, {}, num_executors=1,
                           input_mode=cluster.InputMode.SPARK)
         # > capacity + one in-flight chunk, so the feeder is guaranteed
         # to be blocked in a ring write when the trainer is gone:
@@ -72,26 +94,23 @@ def test_trainer_sigkill_mid_shm_write(tmp_path):
 def test_trainer_sigkill_mid_queue_join(tmp_path):
     """Feeder parked in the queue join when the trainer dies: the chunked
     join's state check must return (the reference's bare queue.join()
-    hangs here forever), and shutdown must name the exit code."""
-    def read_one_then_sigkill_after(args, ctx):
-        # consume one batch, then die — but only once the feeder has
-        # finished writing the partition and is (about to be) parked in
-        # its join. Poll-with-deadline, not a fixed linger: on a loaded
-        # 1-core box a fixed sleep races the feeder both ways. The
-        # EndPartition marker landing in the input queue (qsize >= 1
-        # after this trainer consumed the partition's one chunk) IS the
-        # "feeder finished writing" event.
-        feed = ctx.get_data_feed(train_mode=True)
-        feed.next_batch(8)
-        deadline = time.monotonic() + 30
-        while time.monotonic() < deadline and feed._queue_in.qsize() < 1:
-            time.sleep(0.1)
-        os.kill(os.getpid(), signal.SIGKILL)
+    hangs here forever), and shutdown must name the exit code.
 
-    sc = _sc(tmp_path, "queue")
+    Kill site: ``kill_trainer_when_queued=1`` — fires on the first
+    batch served while the trainer holds the partition's UNCONSUMED
+    EndPartition marker (it rides the feeder's tail-coalesced final
+    put), which proves the feeder finished writing and is parked in
+    its join on the owed task_done — an event, not a timing guess.
+    This is the deflaked form of the VERDICT-r5 flake: the old
+    trainer-side qsize poll raced the feeder under load."""
+    def read_batches(args, ctx):
+        feed = ctx.get_data_feed(train_mode=True)
+        while not feed.should_stop():
+            feed.next_batch(8)  # chaos polls + fires inside the first call
+
+    sc = _sc(tmp_path, "queue", chaos_spec="kill_trainer_when_queued=1")
     try:
-        tfc = cluster.run(sc, read_one_then_sigkill_after, {},
-                          num_executors=1,
+        tfc = cluster.run(sc, read_batches, {}, num_executors=1,
                           input_mode=cluster.InputMode.SPARK)
         # small feed: fully written long before the trainer dies, so the
         # feeder is inside _join_feed when the kill lands
@@ -111,10 +130,18 @@ def test_trainer_sigkill_mid_queue_join(tmp_path):
 def test_feeder_executor_sigkill_leaves_no_ring(tmp_path):
     """SIGKILL the whole executor (feeder + broker + ring owner) mid-feed:
     the driver must surface the death, the orphaned trainer must abort on
-    its own (dead broker), and engine stop must sweep the leaked ring."""
+    its own (dead broker), and engine stop must sweep the leaked ring.
+
+    Kill site: ``chaos.kill_when`` from the test process — the trainer
+    cannot shoot its own executor (the injection points are in-process),
+    so the harness's out-of-process assassin owns this choreography:
+    trigger = the trainer's pid file landing (its first consumed batch
+    proved the feed is flowing), settle = a floor for the feeder to be
+    mid-write again, and a missed trigger means no kill at all — the
+    positive assertion below then fails loudly, not flakily."""
     def record_pid_and_crawl(args, ctx):
         # after the first real batch proves the feed is flowing, publish
-        # our pid (the test's kill signal), then consume slowly so the
+        # our pid (the assassin's trigger), then consume slowly so the
         # feeder stays mid-write when the executor is shot
         feed = ctx.get_data_feed(train_mode=True)
         feed.next_batch(1)
@@ -136,27 +163,10 @@ def test_feeder_executor_sigkill_leaves_no_ring(tmp_path):
         # deadline; the blocked-mid-write abort is test 1's job
         rows = [np.zeros(16384, np.float32) for _ in range(256)]
         executor_pid = sc._procs[0].pid
-
-        import threading
-
-        def assassin():
-            # wait for the trainer to prove the feed is flowing (the pid
-            # file lands after its first consumed batch), then shoot the
-            # executor while its feed task is mid-feed. Poll-with-
-            # deadline; the deadline is generous because missing it just
-            # means the kill never fires and train() below succeeds —
-            # which fails the pytest.raises loudly, not flakily.
-            deadline = time.monotonic() + 60
-            while not os.path.exists(pid_file):
-                if time.monotonic() > deadline:
-                    return
-                time.sleep(0.1)
-            time.sleep(0.5)  # minimum settle, not a deadline: the feeder
-            # is still streaming 256 slow-consumed rows at this point
-            os.kill(executor_pid, signal.SIGKILL)
-
-        killer = threading.Thread(target=assassin, daemon=True)
-        killer.start()
+        killer = chaos.kill_when(
+            lambda: executor_pid,
+            trigger=lambda: os.path.exists(pid_file),
+            settle=0.5, deadline=60)
         with pytest.raises(TaskError, match="died|connection lost"):
             tfc.train(sc.parallelize(rows, 2), feed_timeout=60)
         killer.join(timeout=60)
@@ -170,15 +180,16 @@ def test_feeder_executor_sigkill_leaves_no_ring(tmp_path):
         # ~13s unloaded), then needs a 5s read timeout + the dead-broker
         # RPC to error out — 120s is a no-hang bound, not a latency SLO.
         trainer_pid = int(open(pid_file).read())
-        deadline = time.monotonic() + 120
-        while time.monotonic() < deadline:
+
+        def _trainer_gone():
             try:
                 os.kill(trainer_pid, 0)
+                return False
             except ProcessLookupError:
-                break
-            time.sleep(0.5)
-        else:
-            pytest.fail("orphaned trainer still alive after 120s")
+                return True
+
+        assert chaos.poll_until(_trainer_gone, timeout=120, interval=0.5), \
+            "orphaned trainer still alive after 120s"
     finally:
         sc.stop()
     # stop() swept the dead executor's ring (pid-liveness check)

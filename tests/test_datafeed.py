@@ -137,3 +137,36 @@ def test_numpy_batches_pad_to_batch_mapped_columns():
     assert b["image"].shape == (8, 4) and b["y"].shape == (8,)
     assert list(b["y"]) == [0, 1, 2, 0, 1, 2, 0, 1]
     np.testing.assert_array_equal(b["image"][3], b["image"][0])
+
+
+def test_stats_schema():
+    """Pins the stats() schema the supervision plane documents: the
+    supervisor's stall classification (heartbeat/progress ages) must be
+    observable from user code (ISSUE 3 satellite; docs/fault_tolerance
+    .md 'observability')."""
+    mgr = _mgr()
+    q = mgr.get_queue("input")
+    feed = DataFeed(mgr, train_mode=True)
+    s = feed.stats()
+    required = {"records", "chunks", "wait_s", "staging_alloc",
+                "staging_reuse", "stages", "batches", "heartbeat_age_s",
+                "last_progress_age_s"}
+    assert required <= set(s), sorted(required - set(s))
+    # before the first batch: no progress, no heartbeat -> ages are None
+    assert s["batches"] == 0
+    assert s["heartbeat_age_s"] is None
+    assert s["last_progress_age_s"] is None
+
+    q.put([1, 2, 3])
+    q.put(EndFeed())
+    feed.next_batch(3)
+    s = feed.stats()
+    assert s["batches"] == 1
+    assert isinstance(s["heartbeat_age_s"], float)
+    assert isinstance(s["last_progress_age_s"], float)
+    assert 0.0 <= s["last_progress_age_s"] < 60.0
+    # empty post-end batches are NOT progress: the age keeps growing
+    assert feed.next_batch(3) == []
+    s2 = feed.stats()
+    assert s2["batches"] == 1
+    assert s2["last_progress_age_s"] >= s["last_progress_age_s"]

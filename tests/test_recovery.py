@@ -1,0 +1,628 @@
+"""Supervision-plane tests: detection, policy, and end-to-end recovery.
+
+The reference's only recovery story is Spark task retry — a killed
+trainer strands the reservation barrier and the whole job reruns from
+scratch (SURVEY.md §5). supervisor.py is the missing subsystem; these
+tests pin it layer by layer:
+
+- chaos.py spec grammar, fuses, and corruption helpers (the harness the
+  whole chaos suite and ``bench.py recovery`` stand on);
+- tracing.EventLog and the MTTR stage extraction;
+- the three policies' decision tables (FailJob / RestartFromCheckpoint /
+  Blacklist), driven directly — no cluster needed;
+- Supervisor classification against a scripted lease server: trainer
+  crash, executor loss, feeder stall vs ring wedge, and the
+  already-attributed / healthy negatives;
+- the reservation server's BEAT/ACK supervision surface;
+- ModelServer /healthz + Supervisor.watch (engine death -> 503);
+- Checkpointer restore(fallback=True) walking back over a corrupt
+  latest (armed-injection form included);
+- [chaos] the acceptance e2e: a trainer SIGKILLed mid-epoch recovers
+  automatically to the SAME final step count as an uninterrupted run,
+  and a twice-killed executor is blacklisted with the cluster reformed
+  at width N-1.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import cloudpickle
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import chaos, cluster, reservation, serving, \
+    supervisor, tracing
+from tensorflowonspark_tpu.engine import Context
+
+# Executor processes cannot import this test module, so its map_funs
+# must ship by value (the engine's cloudpickle serializer honors this).
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(autouse=True)
+def _disarmed(monkeypatch):
+    """Every test starts and ends with no armed injections: a leaked
+    spec from one test must not fire inside another's framework calls.
+    (disarm() drops the explicit spec; the env var needs clearing too —
+    this process's TFOS_CHAOS would otherwise re-arm on next check.)"""
+    monkeypatch.delenv(chaos.ENV_VAR, raising=False)
+    chaos.disarm()
+    yield
+    chaos.disarm()
+
+
+# -- chaos harness ---------------------------------------------------------
+
+def test_parse_spec_points_and_fields(tmp_path):
+    spec = ("kill_trainer_at_step=3,only=1,fuse={};"
+            "drop_heartbeats_for=2.5").format(tmp_path / "fuse")
+    out = chaos.parse_spec(spec)
+    assert set(out) == {"kill_trainer_at_step", "drop_heartbeats_for"}
+    inj = out["kill_trainer_at_step"]
+    assert inj.value == 3 and inj.only == 1
+    assert inj.fuse == str(tmp_path / "fuse")
+    assert out["drop_heartbeats_for"].value == 2.5
+
+
+def test_parse_spec_stall_alias():
+    out = chaos.parse_spec("stall_ring_slot=4")
+    assert set(out) == {"stall_consumer_for"}
+    assert out["stall_consumer_for"].value == 4
+
+
+@pytest.mark.parametrize("bad", [
+    "frobnicate=1",               # unknown point
+    "kill_trainer_at_step",       # no value
+    "kill_trainer_at_step=1,zap", # field without =
+    "kill_trainer_at_step=1,zap=2",  # unknown field
+])
+def test_parse_spec_rejects_typos_loudly(bad):
+    with pytest.raises(ValueError):
+        chaos.parse_spec(bad)
+
+
+def test_arm_overrides_env_and_disarm_restores_env(monkeypatch):
+    monkeypatch.setenv(chaos.ENV_VAR, "kill_trainer_at_step=7")
+    assert chaos.armed("kill_trainer_at_step").value == 7
+    chaos.arm("kill_trainer_at_step=9")
+    assert chaos.armed("kill_trainer_at_step").value == 9
+    chaos.disarm()  # explicit spec dropped -> env applies again
+    assert chaos.armed("kill_trainer_at_step").value == 7
+    monkeypatch.delenv(chaos.ENV_VAR)
+    assert chaos.armed("kill_trainer_at_step") is None
+
+
+def test_fuse_is_single_shot_across_incarnations(tmp_path):
+    fuse = str(tmp_path / "fuse")
+    inj = chaos.Injection("kill_trainer_at_step", 3, fuse=fuse)
+    assert inj.ready()
+    inj.mark_fired()
+    assert os.path.exists(fuse), "firing must create the fuse file"
+    # a "restarted process" re-parses the same spec: the fuse disarms it
+    fresh = chaos.parse_spec(
+        "kill_trainer_at_step=3,fuse={}".format(fuse))["kill_trainer_at_step"]
+    assert not fresh.ready()
+
+
+def test_only_scopes_to_executor(monkeypatch):
+    inj = chaos.Injection("kill_trainer_at_step", 1, only=2)
+    monkeypatch.delenv("TFOS_TRAINER_EXECUTOR_ID", raising=False)
+    assert not inj.ready()  # unscoped process: never fires
+    monkeypatch.setenv("TFOS_TRAINER_EXECUTOR_ID", "1")
+    assert not inj.ready()
+    monkeypatch.setenv("TFOS_TRAINER_EXECUTOR_ID", "2")
+    assert inj.ready()
+
+
+def test_drop_heartbeats_window_expires(monkeypatch):
+    chaos.arm("drop_heartbeats_for=0.2")
+    assert chaos.on_heartbeat() is True  # window opens on first attempt
+    assert chaos.poll_until(lambda: not chaos.on_heartbeat(), timeout=5), \
+        "suppression window never expired"
+    # spent: no further suppression
+    assert chaos.on_heartbeat() is False
+
+
+def test_corrupt_latest_checkpoint_garbles_files(tmp_path):
+    root = tmp_path / "ckpt"
+    for step in (3, 7):
+        d = root / str(step) / "state"
+        d.mkdir(parents=True)
+        (d / "data.bin").write_bytes(b"A" * 64)
+    assert chaos.latest_step_on_disk(str(root)) == 7
+    assert chaos.corrupt_latest_checkpoint(str(root)) == 7
+    garbled = (root / "7" / "state" / "data.bin").read_bytes()
+    assert garbled.startswith(b"\xde\xad\xbe\xef") and len(garbled) == 32
+    # older steps untouched — that is what fallback restore walks back to
+    assert (root / "3" / "state" / "data.bin").read_bytes() == b"A" * 64
+    assert chaos.corrupt_latest_checkpoint(str(tmp_path / "empty")) is None
+
+
+def test_poll_until_is_event_driven():
+    t0 = time.monotonic()
+    assert chaos.poll_until(lambda: True, timeout=10)
+    assert time.monotonic() - t0 < 1, "a held predicate must return at once"
+    assert not chaos.poll_until(lambda: False, timeout=0.1)
+
+
+# -- EventLog + MTTR stage extraction --------------------------------------
+
+def test_eventlog_record_last_span():
+    log = tracing.EventLog()
+    log.record("failure_detected", attempt=1)
+    log.record("cluster_formed", attempt=2)
+    log.record("failure_detected", attempt=2)
+    assert [e["attempt"] for e in log.events("failure_detected")] == [1, 2]
+    assert log.last("failure_detected")["attempt"] == 2
+    assert log.last("failure_detected", attempt=1)["attempt"] == 1
+    assert log.last("nope") is None
+    assert log.span("failure_detected", "cluster_formed", attempt=2) is None
+    span = log.span("failure_detected", "cluster_formed")
+    assert span is None  # formed precedes the LAST detection
+    log.record("cluster_formed")
+    assert log.span("failure_detected", "cluster_formed") >= 0
+
+
+def test_recovery_stages_breakdown():
+    log = tracing.EventLog()
+    # attempt-1 milestones must NOT leak into the post-failure stages
+    log.record("restored", step=0)
+    kill_wall = time.time()
+    log.record("failure_detected", kind="trainer_crash")
+    log.record("cluster_formed", attempt=2)
+    log.record("restored", step=3)
+    log.record("first_step", step=4)
+    stages = supervisor.recovery_stages(log, kill_wall=kill_wall)
+    for key in ("detect_s", "reform_s", "restore_s", "first_step_s",
+                "mttr_s"):
+        assert stages[key] is not None and stages[key] >= 0, (key, stages)
+    assert supervisor.recovery_stages(tracing.EventLog()) is None
+    # missing milestones degrade to None spans, not a crash
+    partial = tracing.EventLog()
+    partial.record("failure_detected")
+    got = supervisor.recovery_stages(partial)
+    assert got["mttr_s"] is None and got["restore_s"] is None
+
+
+# -- policies --------------------------------------------------------------
+
+def _evt(kind="trainer_crash", eid=0):
+    return supervisor.FailureEvent(kind, eid, "test")
+
+
+def test_failjob_policy_never_restarts():
+    d = supervisor.FailJob().decide(_evt(), 0, {}, frozenset(), 2)
+    assert d.action == supervisor.Decision.FAIL
+
+
+def test_restart_policy_backoff_then_gives_up():
+    p = supervisor.RestartFromCheckpoint(max_restarts=2, backoff=1.0,
+                                         backoff_factor=2.0, max_backoff=1.5)
+    d0 = p.decide(_evt(), 0, {0: 1}, frozenset(), 2)
+    d1 = p.decide(_evt(), 1, {0: 2}, frozenset(), 2)
+    assert (d0.action, d1.action) == (supervisor.Decision.RESTART,) * 2
+    assert d0.delay == 1.0 and d1.delay == 1.5  # capped at max_backoff
+    assert p.decide(_evt(), 2, {0: 3}, frozenset(), 2).action == \
+        supervisor.Decision.FAIL
+
+
+def test_blacklist_policy_excludes_after_max_failures():
+    p = supervisor.Blacklist(max_failures=2, min_width=1, max_restarts=4)
+    d1 = p.decide(_evt(eid=1), 0, {1: 1}, frozenset(), 2)
+    assert d1.action == supervisor.Decision.RESTART and not d1.exclude
+    d2 = p.decide(_evt(eid=1), 1, {1: 2}, frozenset(), 2)
+    assert d2.exclude == frozenset({1})
+    # already-excluded executors are not re-excluded
+    d3 = p.decide(_evt(eid=1), 2, {1: 3}, frozenset({1}), 2)
+    assert d3.action == supervisor.Decision.RESTART and not d3.exclude
+
+
+def test_blacklist_policy_respects_min_width():
+    p = supervisor.Blacklist(max_failures=1, min_width=2, max_restarts=4)
+    d = p.decide(_evt(eid=1), 0, {1: 1}, frozenset(), 2)
+    assert d.action == supervisor.Decision.FAIL
+    assert "min_width" in d.reason
+
+
+# -- Supervisor classification against a scripted lease server -------------
+
+class _FakeLeaseServer(object):
+    def __init__(self):
+        self.leases = {}  # eid -> (age, payload)
+
+    def set(self, eid, age=0.0, **payload):
+        self.leases[eid] = (age, payload)
+
+    def lease_snapshot(self):
+        return {eid: {"age": age, "payload": dict(p)}
+                for eid, (age, p) in self.leases.items()}
+
+    def acked_partitions(self):
+        return set()
+
+
+def _sup(server, executors=(0,), **cfg_kw):
+    cfg_kw.setdefault("heartbeat_timeout", 5.0)
+    cfg_kw.setdefault("stall_timeout", 10.0)
+    cfg = supervisor.SupervisorConfig(**cfg_kw)
+    return supervisor.Supervisor(server=server, executors=list(executors),
+                                 config=cfg)
+
+
+def test_classify_trainer_crash_from_exit_code():
+    srv = _FakeLeaseServer()
+    srv.set(0, state="running", trainer_exit=-9, trainer_alive=False)
+    sup = _sup(srv)
+    sup.poll_once()
+    failure = sup.first_failure()
+    assert failure.kind == "trainer_crash" and failure.executor_id == 0
+    assert "-9" in failure.detail
+    # an attributed executor stays attributed: no duplicate events
+    sup.poll_once()
+    assert len(sup.failures()) == 1
+
+
+def test_classify_trainer_dead_without_exit_status():
+    srv = _FakeLeaseServer()
+    srv.set(0, state="running", trainer_alive=False, trainer_exit=None)
+    sup = _sup(srv)
+    sup.poll_once()
+    assert sup.first_failure().kind == "trainer_crash"
+
+
+def test_classify_executor_lost_on_expired_lease():
+    srv = _FakeLeaseServer()
+    srv.set(0, age=6.0, state="running")
+    sup = _sup(srv)
+    sup.poll_once()
+    assert sup.first_failure().kind == "executor_lost"
+
+
+def test_classify_executor_lost_when_lease_never_registers():
+    sup = _sup(_FakeLeaseServer())
+    now = time.monotonic()
+    sup.poll_once(now=now)  # inside formation slack: nothing yet
+    assert sup.first_failure() is None
+    sup.poll_once(now=now + 6.0)
+    assert sup.first_failure().kind == "executor_lost"
+
+
+def test_classify_feeder_stall_vs_ring_wedge():
+    for transport, kind in (("queue", "feeder_stall"), ("shm", "ring_wedge")):
+        srv = _FakeLeaseServer()
+        srv.set(0, state="running", trainer_alive=True, feed_hb=42,
+                feed_transport=transport)
+        sup = _sup(srv)
+        now = time.monotonic()
+        sup.poll_once(now=now)            # registers the progress marker
+        sup.poll_once(now=now + 11.0)     # frozen past stall_timeout
+        failure = sup.first_failure()
+        assert failure is not None and failure.kind == kind, (transport,
+                                                              failure)
+
+
+def test_healthy_and_progressing_cluster_raises_nothing():
+    srv = _FakeLeaseServer()
+    srv.set(0, state="running", trainer_alive=True, feed_hb=1,
+            feed_transport="queue")
+    sup = _sup(srv)
+    now = time.monotonic()
+    sup.poll_once(now=now)
+    srv.set(0, state="running", trainer_alive=True, feed_hb=2,
+            feed_transport="queue")
+    sup.poll_once(now=now + 11.0)  # hb moved: stale window reset
+    # trainer exited CLEANLY: not a crash
+    srv.set(0, state="stopped", trainer_alive=False, trainer_exit=0)
+    sup.poll_once(now=now + 12.0)
+    assert sup.failures() == []
+
+
+def test_recovery_milestones_tracked_from_leases():
+    srv = _FakeLeaseServer()
+    sup = _sup(srv)
+    srv.set(0, state="running", restored_step=3, train_step=3)
+    sup.poll_once()
+    srv.set(0, state="running", restored_step=3, train_step=4)
+    sup.poll_once()
+    assert sup.events.last("restored")["step"] == 3
+    assert sup.events.last("first_step")["step"] == 4
+
+
+# -- reservation server: BEAT / ACK supervision surface --------------------
+
+def test_reservation_beat_lease_and_partition_acks():
+    server = reservation.Server(1)
+    addr = server.start(host="127.0.0.1")
+    try:
+        c = reservation.Client(addr)
+        c.beat(0, {"state": "running", "feed_hb": 5})
+        snap = server.lease_snapshot()
+        assert snap[0]["payload"] == {"state": "running", "feed_hb": 5}
+        assert snap[0]["age"] < 5.0
+        age1 = snap[0]["age"]
+        c.beat(0, {"state": "running", "feed_hb": 6})  # lease refreshes
+        snap = server.lease_snapshot()
+        assert snap[0]["age"] <= age1 + 1.0
+        assert snap[0]["payload"]["feed_hb"] == 6
+        for p in (3, 3, 5):
+            c.ack(p)
+        assert server.acked_partitions() == {3, 5}
+        c.close()
+    finally:
+        server.stop()
+
+
+# -- ModelServer /healthz + Supervisor.watch -------------------------------
+
+class _FakeEngine(object):
+    def __init__(self):
+        self.alive = True
+        self.broken = None
+        self.counters = tracing.Counters()
+        self.counters.gauge("queue_depth", 2)
+        self.counters.gauge("slot_occupancy", 3)
+        self.counters.inc("decode_steps", 10)
+
+    def healthy(self):
+        return {"alive": self.alive and self.broken is None,
+                "scheduler_thread": self.alive,
+                "stopping": False, "broken": self.broken}
+
+    def stop(self):
+        self.alive = False
+
+
+def test_healthz_reports_engine_liveness_and_counters():
+    ms = serving.ModelServer(None, engine=_FakeEngine())
+    code, body = ms.healthz()
+    assert code == 200 and body["status"] == "ok"
+    assert body["queue_depth"] == 2 and body["slot_occupancy"] == 3
+    assert body["counts"]["decode_steps"] == 10
+    ms.engine.broken = "scheduler exploded"
+    code, body = ms.healthz()
+    assert code == 503 and body["reason"] == "scheduler exploded"
+
+
+def test_healthz_mark_unhealthy_flips_http_route():
+    ms = serving.ModelServer(None, engine=_FakeEngine(), port=0)
+    host, port = ms.start()
+    url = "http://{}:{}/healthz".format(host, port)
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read())["status"] == "ok"
+        ms.mark_unhealthy("supervisor says dead")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(url, timeout=10)
+        assert err.value.code == 503
+        assert json.loads(err.value.read())["reason"] == \
+            "supervisor says dead"
+    finally:
+        ms.stop()
+
+
+def test_supervisor_watch_marks_server_unhealthy_on_engine_death():
+    engine = _FakeEngine()
+    ms = serving.ModelServer(None, engine=engine)
+    sup = supervisor.Supervisor(
+        config=supervisor.SupervisorConfig(poll_interval=0.05))
+    try:
+        sup.watch(engine, server=ms)
+        time.sleep(0.2)
+        assert ms._unhealthy is None, "live engine must stay healthy"
+        engine.broken = "thread died"
+        assert chaos.poll_until(lambda: ms._unhealthy is not None, timeout=10)
+        assert ms.healthz()[0] == 503
+        assert sup.first_failure().kind == "engine_dead"
+    finally:
+        sup.stop()
+
+
+# -- Checkpointer fallback restore over a corrupt latest -------------------
+
+def _np_state(step):
+    # 0-d ndarrays, not numpy scalars: orbax's standard handler rejects
+    # np.int32(n) leaves outright
+    return {"step": np.array(step, np.int32),
+            "w": np.arange(4, dtype=np.float32) * step}
+
+
+def test_restore_fallback_walks_past_corrupt_latest(tmp_path):
+    from tensorflowonspark_tpu import checkpoint
+
+    root = str(tmp_path / "ck")
+    ckpt = checkpoint.Checkpointer(root, chief=True)
+    assert ckpt.save(1, _np_state(1), force=True)
+    assert ckpt.save(2, _np_state(2), force=True)
+    ckpt.wait()
+    assert chaos.corrupt_latest_checkpoint(root) == 2
+    like = _np_state(0)
+    restored = ckpt.restore(like, fallback=True)
+    assert int(restored["step"]) == 1
+    np.testing.assert_array_equal(restored["w"], _np_state(1)["w"])
+    ckpt.close()
+
+
+def test_corrupt_checkpoint_injection_point(tmp_path):
+    """The armed form: chaos garbles step N the moment save(N) commits —
+    the deterministic reproduction of 'writer killed mid-commit'."""
+    from tensorflowonspark_tpu import checkpoint
+
+    root = str(tmp_path / "ck")
+    chaos.arm("corrupt_checkpoint=2")
+    ckpt = checkpoint.Checkpointer(root, chief=True)
+    ckpt.save(1, _np_state(1), force=True)
+    ckpt.save(2, _np_state(2), force=True)  # fires: step 2 garbled on disk
+    ckpt.wait()
+    restored = ckpt.restore(_np_state(0), fallback=True)
+    assert int(restored["step"]) == 1
+    ckpt.close()
+
+
+# -- end-to-end recovery (chaos suite: real SIGKILLs, real clusters) -------
+
+#: one feed partition == one device batch == one checkpointed step — the
+#: exactly-once alignment docs/fault_tolerance.md documents
+BATCH, PARTS = 4, 6
+
+
+def _supervised_ctx(tmp_path, n=1, chaos_spec=None):
+    env = {"TFOS_FEED_TRANSPORT": "queue"}
+    if chaos_spec:
+        env[chaos.ENV_VAR] = chaos_spec
+    return Context(num_executors=n, work_root=str(tmp_path / "engine"),
+                   executor_env=env)
+
+
+def _ckpt_train_fun(args, ctx):
+    """Supervision-aware map_fun: restore -> attach -> step/checkpoint
+    per batch -> publish; writes the final step on clean completion.
+
+    The exactly-once boundary is pinned event-driven: before a step is
+    published (= before the kill site can fire), the trainer waits for
+    the reservation server to record this step's partition as consumed
+    — the one ordering the replay bookkeeping needs, observed via the
+    ACKS query rather than assumed via a sleep."""
+    import json as _json
+    import os as _os
+
+    import numpy as _np
+
+    from tensorflowonspark_tpu import chaos as _chaos
+    from tensorflowonspark_tpu import checkpoint as _checkpoint
+    from tensorflowonspark_tpu import reservation as _reservation
+    from tensorflowonspark_tpu import supervisor as _supervisor
+
+    ckpt = _checkpoint.Checkpointer(args["dir"], chief=True)
+    like = {"step": _np.array(0, _np.int32),
+            "seen": _np.array(0.0, _np.float64)}
+    restored = ckpt.restore(like, fallback=True)
+    state = restored if restored is not None else like
+    step = int(state["step"])
+    start = step
+    sup = _supervisor.attach(
+        ctx, restored_step=step if restored is not None else None)
+    feed = ctx.get_data_feed(train_mode=True)
+
+    def _acked_up_to(n):
+        # n counts THIS attempt's steps: a reformed cluster has a fresh
+        # reservation server whose ack set starts empty (already-acked
+        # partitions are drained driver-side, never re-fed or re-acked)
+        client = _reservation.Client(ctx.cluster_meta["server_addr"])
+        try:
+            return _chaos.poll_until(lambda: len(client.acked()) >= n,
+                                     timeout=30)
+        finally:
+            client.close()
+
+    while not feed.should_stop():
+        batch = feed.next_batch(args["batch"])
+        if not batch:
+            continue
+        step += 1
+        state = {"step": _np.array(step, _np.int32),
+                 "seen": _np.array(float(state["seen"]) + sum(batch),
+                                   _np.float64)}
+        ckpt.save(step, state, force=True)
+        ckpt.wait()
+        _acked_up_to(step - start)  # one partition == one step
+        sup.step(step)  # chaos kill site — AFTER checkpoint AND ack
+    ckpt.close()
+    with open(_os.path.join(args["dir"], "final.json"), "w") as f:
+        _json.dump({"step": step, "seen": float(state["seen"])}, f)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_supervised_recovery_matches_uninterrupted_step_count(tmp_path):
+    """Acceptance e2e: SIGKILL the trainer right after step 3's
+    checkpoint committed; the supervisor must detect, reform, restore
+    step 3, replay only unacked partitions, and finish at the SAME final
+    step count (and data sum) an uninterrupted run produces — with no
+    human intervention."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    os.makedirs(ckpt_dir)
+    fuse = str(tmp_path / "fuse")
+    records = list(range(BATCH * PARTS))
+    sc = _supervised_ctx(
+        tmp_path, chaos_spec="kill_trainer_at_step=3,fuse={}".format(fuse))
+    cfg = supervisor.SupervisorConfig(
+        policy=supervisor.RestartFromCheckpoint(max_restarts=2, backoff=0.1),
+        heartbeat_interval=0.25, heartbeat_timeout=20.0,
+        poll_interval=0.1, classify_grace=10.0)
+    try:
+        tfc = cluster.run(sc, _ckpt_train_fun,
+                          {"dir": ckpt_dir, "batch": BATCH},
+                          num_executors=1,
+                          input_mode=cluster.InputMode.SPARK, supervise=cfg)
+        assert isinstance(tfc, supervisor.SupervisedCluster)
+        tfc.train(sc.parallelize(records, PARTS), feed_timeout=60)
+    finally:
+        sc.stop()
+
+    assert os.path.exists(fuse), "the injection never fired"
+    final = json.load(open(os.path.join(ckpt_dir, "final.json")))
+    # exactly-once: same step count AND same consumed-data sum as an
+    # uninterrupted run (no partition lost, none double-fed)
+    assert final["step"] == PARTS, final
+    assert final["seen"] == float(sum(records)), final
+
+    rep = tfc.report()
+    assert rep["formations"] == 2, rep
+    assert [f["kind"] for f in rep["failures"]] == ["trainer_crash"]
+    assert rep["acked_partitions"] == PARTS
+    stages = rep["recovery"]
+    assert stages is not None and stages["mttr_s"] is not None, rep
+    assert stages["restore_s"] is not None
+    assert stages["first_step_s"] is not None
+
+
+def _blacklist_train_fun(args, ctx):
+    """Every trainer steps once at start (the scoped kill site fires in
+    the targeted executor only), then consumes the feed to completion."""
+    from tensorflowonspark_tpu import supervisor as _supervisor
+
+    sup = _supervisor.attach(ctx)
+    sup.step(1)  # chaos: kill_trainer_at_step=1,only=<eid> fires HERE
+    feed = ctx.get_data_feed(train_mode=True)
+    while not feed.should_stop():
+        feed.next_batch(args["batch"])
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_blacklist_excludes_twice_killed_executor(tmp_path):
+    """Executor 1's trainer dies on every attempt (no fuse — the
+    injection re-arms in each incarnation); after max_failures=2 the
+    Blacklist policy must exclude it and reform at width N-1=1, where
+    the scoped injection no longer fires and the job completes."""
+    records = list(range(BATCH * PARTS))
+    sc = _supervised_ctx(tmp_path, n=2,
+                         chaos_spec="kill_trainer_at_step=1,only=1")
+    cfg = supervisor.SupervisorConfig(
+        policy=supervisor.Blacklist(max_failures=2, min_width=1,
+                                    max_restarts=4, backoff=0.1),
+        heartbeat_interval=0.25, heartbeat_timeout=20.0,
+        poll_interval=0.1, classify_grace=10.0)
+    try:
+        tfc = cluster.run(sc, _blacklist_train_fun, {"batch": BATCH},
+                          num_executors=2,
+                          input_mode=cluster.InputMode.SPARK, supervise=cfg)
+        tfc.train(sc.parallelize(records, PARTS), feed_timeout=60)
+    finally:
+        sc.stop()
+
+    rep = tfc.report()
+    assert rep["excluded"] == [1], rep
+    assert rep["formations"] == 3, rep
+    assert all(f["kind"] == "trainer_crash" and f["executor_id"] == 1
+               for f in rep["failures"]), rep
+    # the final formation ran at reduced width
+    formed = [e for e in rep["events"] if e["name"] == "cluster_formed"]
+    assert formed[-1]["width"] == 1 and formed[-1]["executors"] == [0]
+    blacklisted = [e for e in rep["events"] if e["name"] == "blacklisted"]
+    assert blacklisted and blacklisted[0]["executors"] == [1]

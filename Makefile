@@ -32,7 +32,10 @@ SUITE_TIMEOUT ?= 2700
 test-fast:
 	$(PYTHON) -m pytest tests/ -q -x -m "not slow"
 
-# Fault-injection suite (PR 3: chaos.py + the supervision plane e2e).
+# Fault-injection suite (PR 3: chaos.py + the supervision plane e2e;
+# PR 4 adds the serving leg — scheduler-kill auto-restart, decode
+# stall, injected client disconnect from test_serving_lifecycle.py —
+# collected by the same `chaos` marker).
 # These SIGKILL real trainer/executor processes and reform real
 # clusters, so they run SERIALLY — one pytest process per test, which
 # both isolates each kill's process tree and gives every test a hard

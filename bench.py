@@ -416,6 +416,12 @@ def _engine_leg(dec, params, reqs, slots):
                      eng.counters.rate("decode_tokens", "decode_steps"), 2),
                  "decode_steps": counts.get("decode_steps", 0),
                  "prefills": counts.get("prefills", 0),
+                 # request-lifecycle tallies (PR 4): all zero on this
+                 # clean workload — published so a regression that sheds
+                 # or evicts benched traffic is VISIBLE, not silent
+                 "lifecycle": {k: counts.get(k, 0) for k in
+                               ("shed", "cancelled", "deadline_exceeded",
+                                "engine_restarts")},
                  "stage_ms": eng.timers.per_ms(),
                  "stage_s_total": {k: round(v, 3) for k, v in
                                    eng.timers.snapshot().items()}}

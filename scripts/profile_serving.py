@@ -12,9 +12,11 @@ attribution the engine's own tracing hooks collect:
                       (admission scans, EOS checks, stream delivery)
 
 plus the engine's counters (tokens/step = effective slot occupancy,
-prefills, steps), compile stats (programs vs buckets), and a
-cold/warm split so compile cost is attributed separately from
-steady-state decode.
+prefills, steps), compile stats (programs vs buckets), the request-
+lifecycle tallies (shed / cancelled / deadline_exceeded /
+engine_restarts — all zero on this clean workload; nonzero means the
+harness itself is evicting benched traffic), and a cold/warm split so
+compile cost is attributed separately from steady-state decode.
 
 Usage (CPU, hermetic):
 
@@ -106,6 +108,7 @@ def main(argv=None):
         print("  stages (mean ms/call): {}".format(r["stage_ms"]))
         print("  stages (total s):      {}".format(r["stage_s_total"]))
         print("  compile: {}".format(r["compile"]))
+        print("  lifecycle: {}".format(r["lifecycle"]))
 
 
 if __name__ == "__main__":

@@ -54,6 +54,24 @@ Injection points (each checked at an instrumented framework site):
   garble every file of that step on disk (fired by checkpoint.py); the
   restore-with-fallback path is the recovery under test.
 
+Serving-plane points (PR 4 — fired at serving.DecodeEngine's
+instrumented sites, so the request-lifecycle story is deterministically
+testable):
+
+- ``kill_scheduler_at_step=N`` — raise :class:`SchedulerKilled` inside
+  the decode scheduler loop once N decode steps completed: the thread
+  dies exactly as an uncaught device error would kill it (threads have
+  no SIGKILL; an in-loop raise is the faithful equivalent), outstanding
+  handles fail retriable, and the supervisor's RestartEngine policy is
+  the recovery under test.
+- ``stall_decode_for=T`` — the scheduler sleeps T seconds once at a
+  step boundary: in-flight deadlines expire while the engine stays
+  alive — the slow-replica signature deadline eviction exists for.
+- ``disconnect_client_at_token=N`` — the first request to reach N
+  emitted tokens is cancelled as if its client disconnected
+  mid-stream; the step-boundary slot-free path is the behavior under
+  test.
+
 Every fire is logged loudly. All checks are O(1) dict lookups when
 nothing is armed, so instrumented sites cost nothing in production.
 """
@@ -71,7 +89,16 @@ ENV_VAR = "TFOS_CHAOS"
 #: spec keys that accept the generic grammar above
 POINTS = ("kill_trainer_at_step", "kill_trainer_at_batch",
           "kill_trainer_when_queued", "stall_consumer_for",
-          "stall_ring_slot", "drop_heartbeats_for", "corrupt_checkpoint")
+          "stall_ring_slot", "drop_heartbeats_for", "corrupt_checkpoint",
+          "kill_scheduler_at_step", "stall_decode_for",
+          "disconnect_client_at_token")
+
+
+class SchedulerKilled(RuntimeError):
+    """kill_scheduler_at_step fired: the decode scheduler thread dies
+    by raising this (the thread-level analog of SIGKILL — threads
+    cannot be signalled, and any uncaught raise kills the loop the
+    same way a real device error does)."""
 
 
 class Injection(object):
@@ -245,6 +272,41 @@ def on_batch(feed, batches_served):
         logger.warning("CHAOS stalling consumer for %gs "
                        "(ring slots stay pinned)", inj.value)
         time.sleep(inj.value)
+
+
+def on_decode_step(steps_done):
+    """Decode-scheduler site (serving.DecodeEngine._loop), called at
+    each step boundary with the number of COMPLETED decode steps.
+    ``stall_decode_for`` sleeps here (once); ``kill_scheduler_at_step``
+    raises :class:`SchedulerKilled` once ``steps_done`` reaches N."""
+    inj = armed("stall_decode_for")
+    if inj is not None:
+        inj.mark_fired()
+        logger.warning("CHAOS stalling decode scheduler for %gs",
+                       inj.value)
+        time.sleep(inj.value)
+    inj = armed("kill_scheduler_at_step")
+    if inj is not None and steps_done >= inj.value:
+        inj.mark_fired()
+        logger.error("CHAOS firing kill_scheduler_at_step "
+                     "(step %d >= %g): killing the decode scheduler",
+                     steps_done, inj.value)
+        raise SchedulerKilled(
+            "chaos: decode scheduler killed at step {}".format(steps_done))
+
+
+def on_token(tokens_emitted):
+    """Token-delivery site (serving.DecodeEngine._deliver); True means
+    'this request's client just disconnected' — the engine cancels the
+    request and the step-boundary eviction frees its slot. Fires once,
+    on the first request to reach N emitted tokens."""
+    inj = armed("disconnect_client_at_token")
+    if inj is None or tokens_emitted < inj.value:
+        return False
+    inj.mark_fired()
+    logger.warning("CHAOS disconnect_client_at_token: simulating client "
+                   "disconnect after %d tokens", tokens_emitted)
+    return True
 
 
 def on_heartbeat():

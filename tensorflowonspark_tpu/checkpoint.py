@@ -82,7 +82,15 @@ class Checkpointer(object):
                 fs.require_local(directory, "checkpointing"))
             self._remote = False
         self.chief = chief
-        if chief and not self._remote:
+        if not self._remote:
+            # Every process needs the LOCAL root to exist before the
+            # manager is built: current orbax walks the root at
+            # construction (`_load_checkpoint_infos`) and raises on a
+            # missing path, so a non-chief with `create=False` could
+            # never construct against a not-yet-created directory. An
+            # empty root is inert (no steps), and exist_ok makes the
+            # multi-process mkdir race benign — commit semantics still
+            # belong to orbax's create/primary-host logic below.
             os.makedirs(self.directory, exist_ok=True)
         # ``create`` must be PROCESS-UNIFORM under jax.distributed:
         # orbax's create path runs a named sync_global_devices barrier,

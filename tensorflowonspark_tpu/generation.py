@@ -224,6 +224,16 @@ def _set_cursor_leaves(cache, idx):
     cursors before each step makes the device cache's own increments
     advisory, so inactive slots just re-write one stale position in
     place instead of walking off the end of the cache.
+
+    This same discipline is what makes MID-FLIGHT EVICTION (PR 4:
+    cancel / deadline, serving.DecodeEngine._evict_expired) free: an
+    evicted request's slot is simply marked free on the host — no
+    device-side cleanup exists or is needed, because a freed slot's
+    stale K/V was already unreachable (cursor pinned, next occupant's
+    prefill scatters over the full rows) and neighbors never see it.
+    Eviction therefore cannot perturb concurrent sequences, which is
+    why cancelled-neighbor outputs stay bitwise-identical
+    (tests/test_serving_lifecycle.py pins this).
     """
     def repl(path, leaf):
         if _leaf_name(path) in _CURSOR_LEAVES:

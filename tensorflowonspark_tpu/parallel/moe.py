@@ -48,7 +48,7 @@ def moe_ffn(x, router_w, w_in, w_out, mesh, expert_axis="expert",
 
     Returns ([tokens, hidden], aux_loss).
     """
-    from jax import shard_map
+    from tensorflowonspark_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     num_experts = w_in.shape[0]

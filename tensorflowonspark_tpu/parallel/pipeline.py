@@ -28,7 +28,7 @@ def pipeline_apply(stage_fn, stage_params, microbatches, mesh,
 
     Returns [M, mb, ...]: outputs of the last stage, replicated.
     """
-    from jax import shard_map
+    from tensorflowonspark_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     num_stages = mesh.shape[stage_axis]

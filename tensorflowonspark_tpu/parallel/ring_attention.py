@@ -143,7 +143,7 @@ def ring_flash_attention(q, k, v, mesh, seq_axis="seq", causal=False,
     ~2x the average work); zigzag makes each step cost ~one half-block
     pair everywhere, recovering the factor-2.
     """
-    from jax import shard_map
+    from tensorflowonspark_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from tensorflowonspark_tpu.ops.flash_attention import (
@@ -282,7 +282,7 @@ def ring_attention(q, k, v, mesh, seq_axis="seq", causal=False, scale=None):
     riding ICI neighbor links.
     """
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from tensorflowonspark_tpu.compat import shard_map
 
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     axis_size = mesh.shape[seq_axis]

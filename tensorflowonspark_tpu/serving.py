@@ -43,15 +43,68 @@ Two batching planes live here, serving different traffic shapes:
 import collections
 import json
 import logging
+import math
 import queue as queue_mod
+import socket
 import threading
 import time
 
 import numpy as np
 
+from tensorflowonspark_tpu import chaos
+
 logger = logging.getLogger(__name__)
 
 _STREAM_DONE = object()
+
+
+class Retriable(RuntimeError):
+    """The request failed for a TRANSIENT serving-side reason — shed at
+    admission, engine draining, or the engine mid-restart. The client
+    should retry (the HTTP surface answers 503 with ``Retry-After``);
+    nothing about the request itself was wrong."""
+
+    #: advisory seconds before a retry is worth attempting
+    retry_after = 1.0
+
+
+class Shed(Retriable):
+    """Admission control refused the request because its deadline is
+    infeasible under the engine's measured rates: estimated queue wait
+    plus prefill plus decode exceeds the time the client gave us.
+    Shedding at the door is the load-shedding half of tail-latency
+    control — doing the work anyway would burn a slot on an answer the
+    client has already abandoned."""
+
+    def __init__(self, msg, retry_after=1.0):
+        super(Shed, self).__init__(msg)
+        self.retry_after = max(1.0, float(retry_after))
+
+
+class Draining(Retriable):
+    """The engine/server is draining (graceful shutdown): in-flight
+    requests finish, new work must go to another replica."""
+
+    retry_after = 5.0
+
+
+class EngineFailed(Retriable):
+    """The decode scheduler died. Outstanding handles fail with this so
+    clients retry (against this replica once the supervisor's
+    RestartEngine policy rebuilds the engine, or against another)."""
+
+
+class Cancelled(RuntimeError):
+    """The request was cancelled — ``handle.cancel()``, the consumer
+    closed its :meth:`GenerationHandle.stream` generator, or the HTTP
+    client disconnected. Its slot was freed at the next decode-step
+    boundary."""
+
+
+class DeadlineExceeded(Cancelled):
+    """The request's deadline passed before it completed; the engine
+    evicted it at the next decode-step boundary (a special case of
+    cancellation — ``except Cancelled`` catches both)."""
 
 
 class GenerationHandle(object):
@@ -62,18 +115,28 @@ class GenerationHandle(object):
     one by one, the continuous-batching point) or block on
     :meth:`result` for the full sequence. ``latency`` is submit-to-
     completion wall time, the number the serving bench percentiles.
+
+    Lifecycle control: ``deadline`` (absolute ``time.monotonic``) makes
+    the engine evict the request at the first decode-step boundary past
+    it; :meth:`cancel` requests the same eviction explicitly. Either
+    way the slot frees immediately for queued work instead of decoding
+    to ``max_new_tokens`` for a client that is gone, and
+    :meth:`result`/:meth:`stream` raise :class:`DeadlineExceeded` /
+    :class:`Cancelled`.
     """
 
-    def __init__(self, prompt, max_new_tokens):
+    def __init__(self, prompt, max_new_tokens, deadline=None):
         # constructed by DecodeEngine AFTER validate() normalized both
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
+        self.deadline = deadline  # absolute monotonic, or None
         self.submitted = time.monotonic()
         self.completed = None
         self._tokens = []
         self._q = queue_mod.Queue()
         self._done = threading.Event()
         self._error = None
+        self._cancel_requested = False
 
     # -- scheduler side --------------------------------------------------
 
@@ -87,12 +150,44 @@ class GenerationHandle(object):
         self._done.set()
         self._q.put(_STREAM_DONE)
 
+    def _evictable(self, now):
+        """(error or None) — why the scheduler should evict this request
+        at the current step boundary."""
+        if self._cancel_requested:
+            return Cancelled("request cancelled")
+        if self.deadline is not None and now > self.deadline:
+            return DeadlineExceeded(
+                "deadline exceeded after {} of {} tokens".format(
+                    len(self._tokens), self.max_new_tokens))
+        return None
+
     # -- client side -----------------------------------------------------
+
+    def cancel(self):
+        """Ask the engine to stop generating: the request is evicted at
+        the next decode-step boundary and its slot freed. Returns True
+        if the cancellation was registered, False if the request had
+        already completed (its result stands). Idempotent."""
+        if self._done.is_set():
+            return False
+        self._cancel_requested = True
+        return True
 
     def stream(self, timeout=600.0):
         """Yield generated tokens as the engine emits them. ``timeout``
         bounds the wait for EACH token (TimeoutError, matching
-        :meth:`result`'s surface)."""
+        :meth:`result`'s surface).
+
+        Abandoning the generator — ``close()``, or ``break``/a consumer
+        exception followed by GC closing it — CANCELS the request: a
+        consumer that stopped reading must not leave the slot decoding
+        to ``max_new_tokens`` for nobody (the classic streaming slot
+        leak). Iterate to the end if you want the request to finish.
+        The per-token TimeoutError does NOT cancel by itself (it may be
+        a poll signal; ``result()`` still works afterwards) — but note
+        the raise FINISHES the generator, so close/GC after a timeout
+        cannot detect abandonment anymore: a consumer that gives up
+        after a TimeoutError must call :meth:`cancel` itself."""
         while True:
             try:
                 item = self._q.get(timeout=timeout)
@@ -103,7 +198,13 @@ class GenerationHandle(object):
                 if self._error is not None:
                     raise self._error
                 return
-            yield item
+            try:
+                yield item
+            except GeneratorExit:
+                # close()/GC landed at the yield: the consumer is gone
+                # (cancel() is a no-op if the request already finished)
+                self.cancel()
+                raise
 
     def result(self, timeout=600.0):
         """Block until complete; returns prompt + generated tokens."""
@@ -179,6 +280,20 @@ class DecodeEngine(object):
         it, sustained overload grows the queue without limit while
         every client times out and abandons work the engine still
         decodes to completion.
+
+    Request lifecycle (PR 4): ``submit(..., deadline_s=T)`` attaches a
+    completion deadline. Admission SHEDS the request
+    (:class:`Shed` -> HTTP 503 + Retry-After) when the deadline is
+    infeasible under the engine's own measured rates (see
+    :meth:`estimate_admission`); an admitted request past its deadline
+    — or cancelled via ``handle.cancel()`` / stream abandonment — is
+    EVICTED at the next decode-step boundary, freeing its slot for
+    queued work. :meth:`drain` refuses new work and finishes every
+    admitted request (graceful shutdown); :meth:`respawn` rebuilds a
+    fresh engine from this one's construction config (the supervisor's
+    RestartEngine recovery). Lifecycle counts ride ``counters``:
+    ``shed`` / ``cancelled`` / ``deadline_exceeded`` /
+    ``engine_restarts``.
     """
 
     def __init__(self, model, params, slots=8, total_len=None,
@@ -189,6 +304,15 @@ class DecodeEngine(object):
 
         from tensorflowonspark_tpu import generation, tracing
 
+        # construction config, verbatim, so respawn() can rebuild an
+        # identical engine after a scheduler death (supervisor.py's
+        # RestartEngine policy) — deliberately the ORIGINAL params
+        # object, not any later mutation of self.params
+        self._spawn_args = dict(
+            model=model, params=params, slots=slots, total_len=total_len,
+            buckets=buckets, temperature=temperature, top_k=top_k,
+            top_p=top_p, eos_token=eos_token, rng=rng,
+            max_queue=max_queue)
         self._generation = generation
         total_len = int(total_len or model.max_len)
         if total_len > model.max_len:
@@ -224,7 +348,16 @@ class DecodeEngine(object):
         self._queue = collections.deque()
         self._cv = threading.Condition()
         self._stopping = False
+        self._draining = False
         self._broken = None
+        self._failed_requests = 0  # admitted-but-failed ledger (drain)
+        # admission-control evidence: EWMAs of this engine's own recent
+        # decode-step and prefill wall times (scheduler thread writes,
+        # submit path reads under _cv). None until the first sample —
+        # a cold engine never sheds (no evidence, no refusal).
+        self._step_ewma = None
+        self._prefill_ewma = None
+        self._ewma_alpha = 0.3
         self._slot_req = [None] * self.slots
         self._idx = np.zeros(self.slots, np.int32)
         self._last = np.zeros(self.slots, np.int32)
@@ -266,34 +399,83 @@ class DecodeEngine(object):
                     len(prompt), max_new, self.total_len))
         return prompt, max_new
 
-    def submit(self, prompt, max_new_tokens):
+    def submit(self, prompt, max_new_tokens, deadline_s=None):
         """Queue one request; returns its :class:`GenerationHandle`.
 
         Validation happens HERE, on the caller's thread, so a malformed
         request raises to its client instead of poisoning the shared
         scheduler loop (same discipline as ``_Batcher.submit``).
+
+        ``deadline_s`` (seconds from now) bounds the request's whole
+        life: admission sheds it when the deadline is infeasible under
+        measured rates (:class:`Shed`), and an admitted request past
+        its deadline is evicted at the next decode-step boundary
+        (:class:`DeadlineExceeded` from ``result``/``stream``).
         """
-        return self._submit_validated(*self.validate(prompt,
-                                                     max_new_tokens))
+        return self._submit_many([self.validate(prompt, max_new_tokens)],
+                                 deadline_s=deadline_s)[0]
 
-    def _submit_validated(self, prompt, max_new):
-        """submit() minus validation — for callers (ModelServer.generate)
-        that already ran :meth:`validate` over a whole body."""
-        return self._submit_many([(prompt, max_new)])[0]
+    def estimate_admission(self, max_new_tokens):
+        """{'queue_wait_s', 'service_s'} — what admitting a request of
+        ``max_new_tokens`` now would plausibly cost, from the engine's
+        own measured rates (EWMA decode-step and prefill wall times).
 
-    def _submit_many(self, vetted):
-        """Atomically queue a whole vetted body: either every request is
-        admitted or none is (QueueFull / stopped / broken raise before
-        any handle exists), so a mid-batch refusal never leaves earlier
-        prompts of the same body decoding for a client that already got
-        its error. max_new==0 requests complete inline (the prompt IS
-        the answer) but still pass the liveness checks — a dead engine
-        must refuse degenerate requests as loudly as real ones."""
+        The model: queued requests each owe one serial prefill; decode
+        steps are shared, so the token backlog (queued max_new plus
+        what in-flight slots still owe) drains at ``slots`` tokens per
+        step. ``service_s`` is the request's own prefill + max_new
+        steps. Zeros until the engine has served anything — admission
+        control sheds on EVIDENCE, never on a cold engine's guess.
+        """
         with self._cv:
+            return self._estimate_locked(int(max_new_tokens))
+
+    def _estimate_locked(self, max_new, extra_requests=0, extra_tokens=0):
+        """``extra_requests``/``extra_tokens``: work ahead of this
+        request that is not in the queue yet — the earlier members of
+        the same multi-prompt body during whole-body shed vetting. A
+        body's members queue together, so member k waits behind members
+        0..k-1 exactly as it would behind queued strangers."""
+        step = self._step_ewma or 0.0
+        prefill = self._prefill_ewma or 0.0
+        backlog = extra_tokens + sum(h.max_new_tokens
+                                     for h in self._queue)
+        for s in range(self.slots):
+            handle = self._slot_req[s]
+            if handle is not None:
+                backlog += max(
+                    handle.max_new_tokens - len(handle._tokens), 0)
+        wait = (len(self._queue) + extra_requests) * prefill \
+            + backlog * step / self.slots
+        return {"queue_wait_s": wait,
+                "service_s": prefill + max_new * step}
+
+    def _submit_many(self, vetted, deadline_s=None):
+        """Atomically queue a whole vetted body: either every request is
+        admitted or none is (QueueFull / Shed / stopped / draining /
+        broken raise before any handle exists), so a mid-batch refusal
+        never leaves earlier prompts of the same body decoding for a
+        client that already got its error. max_new==0 requests complete
+        inline (the prompt IS the answer) but still pass the liveness
+        checks — a dead engine must refuse degenerate requests as
+        loudly as real ones."""
+        if deadline_s is not None:
+            deadline_s = float(deadline_s)
+            if not deadline_s > 0:
+                raise ValueError(
+                    "deadline_s must be > 0, got {}".format(deadline_s))
+        with self._cv:
+            # draining outranks stopped: a drained engine ends with
+            # BOTH flags set, and a request that raced past the HTTP
+            # layer's drain check must still get the retriable 503
+            # ("go to another replica"), never a 500 'engine stopped'
+            if self._draining:
+                raise Draining(
+                    "engine is draining; not accepting new requests")
             if self._stopping:
                 raise RuntimeError("engine stopped")
             if self._broken is not None:
-                raise RuntimeError(
+                raise EngineFailed(
                     "engine failed: {}".format(self._broken))
             queueing = sum(1 for _, mn in vetted if mn > 0)
             if self.max_queue is not None and \
@@ -301,9 +483,41 @@ class DecodeEngine(object):
                 raise QueueFull(
                     "admission queue full ({} waiting, max_queue {})"
                     .format(len(self._queue), self.max_queue))
+            if deadline_s is not None:
+                # shed the WHOLE body if any member's deadline is
+                # infeasible under measured rates — same atomicity as
+                # QueueFull (nothing of a refused body may decode).
+                # Members are priced CUMULATIVELY: member k queues
+                # behind members 0..k-1 of its own body, so a jointly-
+                # infeasible body (each member cheap, the sum not)
+                # refuses instead of admitting work that will 504.
+                # max_new==0 members complete inline — they never
+                # queue, prefill, or decode, so they are neither
+                # priced nor charged to later members
+                ahead_requests = ahead_tokens = 0
+                for _, max_new in vetted:
+                    if max_new == 0:
+                        continue
+                    est = self._estimate_locked(
+                        max_new, extra_requests=ahead_requests,
+                        extra_tokens=ahead_tokens)
+                    need = est["queue_wait_s"] + est["service_s"]
+                    if need > deadline_s:
+                        self.counters.inc("shed", len(vetted))
+                        raise Shed(
+                            "deadline {:.2f}s infeasible: estimated "
+                            "queue wait {:.2f}s + service {:.2f}s"
+                            .format(deadline_s, est["queue_wait_s"],
+                                    est["service_s"]),
+                            retry_after=math.ceil(est["queue_wait_s"]))
+                    ahead_requests += 1
+                    ahead_tokens += max_new
+            deadline = None if deadline_s is None \
+                else time.monotonic() + deadline_s
             handles = []
             for prompt, max_new in vetted:
-                handle = GenerationHandle(prompt, max_new)
+                handle = GenerationHandle(prompt, max_new,
+                                          deadline=deadline)
                 if max_new == 0:
                     handle._finish()
                 else:
@@ -320,18 +534,80 @@ class DecodeEngine(object):
 
     def healthy(self):
         """Scheduler-liveness report: {alive, scheduler_thread, stopping,
-        broken}. ``alive`` is the serving-fitness verdict — False once
-        the scheduler thread died (uncaught loop error), broke, or the
-        engine was stopped. supervisor.Supervisor.watch polls this and
+        draining, broken}. ``alive`` is the serving-fitness verdict —
+        False once the scheduler thread died (uncaught loop error),
+        broke, or the engine was stopped. A DRAINING engine is still
+        alive (it is finishing admitted work); it just refuses new
+        requests. supervisor.Supervisor.watch polls this and
         ModelServer's /healthz reports it (503 when not alive)."""
         with self._cv:
             broken = self._broken
             stopping = self._stopping
+            draining = self._draining
         thread_alive = self._thread.is_alive()
         return {"alive": thread_alive and not stopping and broken is None,
                 "scheduler_thread": thread_alive,
                 "stopping": stopping,
+                "draining": draining,
                 "broken": str(broken) if broken is not None else None}
+
+    def outstanding(self):
+        """Queued + in-flight request count (the number drain waits on)."""
+        with self._cv:
+            return len(self._queue) + len(self._active_slots())
+
+    def drain(self, timeout=None):
+        """Graceful shutdown: refuse new submissions (:class:`Draining`),
+        finish every ADMITTED request — queued and in-flight — then stop
+        the scheduler. Returns True when nothing admitted was lost;
+        False when ``timeout`` (seconds) expired first or the engine
+        broke mid-drain, in which case the stragglers fail with the
+        stop/break error. ``timeout=None`` waits as long as the work
+        takes (the zero-loss posture). Idempotent with :meth:`stop` —
+        and honest about it: drain on an engine that already stopped
+        (or broke) with requests in flight reports False, because
+        those requests were FAILED, not finished (the emptied queue is
+        a loss ledger, not a clean one).
+        """
+        with self._cv:
+            if self._stopping:
+                return self.outstanding() == 0 \
+                    and self._failed_requests == 0
+            if not self._draining:
+                self._draining = True
+                logger.info(
+                    "decode engine draining: %d queued, %d in flight",
+                    len(self._queue), len(self._active_slots()))
+            failed_before = self._failed_requests
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        while True:
+            with self._cv:
+                left = len(self._queue) + len(self._active_slots())
+                dead = self._broken is not None \
+                    or not self._thread.is_alive()
+            if left == 0 or dead:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                logger.warning(
+                    "drain timed out with %d request(s) outstanding; "
+                    "they will fail with the stop error", left)
+                break
+            time.sleep(0.02)
+        self.stop()
+        # a loop death mid-drain fails-and-clears outstanding work, so
+        # left==0 alone would misreport lost requests as a clean drain
+        return left == 0 and self._failed_requests == failed_before
+
+    def respawn(self):
+        """A fresh engine built from this engine's construction config
+        (original model/params/slots/sampling/queue bound), SHARING its
+        counters and timers so lifecycle counts — ``engine_restarts``,
+        tokens, shed/cancel tallies — continue across the restart. The
+        supervisor's RestartEngine policy rebuilds through this after a
+        scheduler death; call :meth:`stop` on the dead engine first."""
+        return DecodeEngine(counters=self.counters, timers=self.timers,
+                            **self._spawn_args)
 
     def compile_stats(self):
         """Live program counts for the engine's jitted fns (shared per
@@ -389,9 +665,49 @@ class DecodeEngine(object):
         return [s for s in range(self.slots)
                 if self._slot_req[s] is not None]
 
+    def _ewma(self, prev, sample):
+        return sample if prev is None \
+            else self._ewma_alpha * sample \
+            + (1.0 - self._ewma_alpha) * prev
+
+    def _evict(self, handle, err):
+        handle._finish(err)
+        self.counters.inc("deadline_exceeded"
+                          if isinstance(err, DeadlineExceeded)
+                          else "cancelled")
+        logger.info("evicted request after %d/%d tokens: %s",
+                    len(handle._tokens), handle.max_new_tokens, err)
+
+    def _prune_queue_locked(self, now):
+        """Drop cancelled/expired requests from the admission queue
+        (caller holds ``_cv``) — they must never reach a prefill."""
+        if not any(h._evictable(now) for h in self._queue):
+            return
+        kept = collections.deque()
+        for handle in self._queue:
+            err = handle._evictable(now)
+            if err is None:
+                kept.append(handle)
+            else:
+                self._evict(handle, err)
+        self._queue = kept
+
+    def _evict_expired(self, now):
+        """Free every active slot whose request is cancelled or past
+        its deadline — THE step-boundary eviction: the slot is reusable
+        by the very next admission scan instead of decoding to
+        ``max_new_tokens`` for a client that is gone. Scheduler thread
+        only (slot state is its own)."""
+        for s in self._active_slots():
+            err = self._slot_req[s]._evictable(now)
+            if err is not None:
+                self._evict(self._slot_req[s], err)
+                self._slot_req[s] = None
+
     def _loop(self):
         import jax.numpy as jnp
 
+        steps = 0
         try:
             while True:
                 with self._cv:
@@ -402,6 +718,7 @@ class DecodeEngine(object):
                         self._fail_outstanding(
                             RuntimeError("engine stopped"))
                         return
+                    self._prune_queue_locked(time.monotonic())
                     admits = []
                     for s in range(self.slots):
                         if self._slot_req[s] is None and self._queue:
@@ -418,15 +735,27 @@ class DecodeEngine(object):
                 # device work
                 for s, handle in admits:
                     self._admit(s, handle)
+                # step-boundary eviction: cancelled / past-deadline
+                # requests free their slots BEFORE the step computes
+                # for them, so the next admission scan can reuse them
+                self._evict_expired(time.monotonic())
                 active = self._active_slots()
                 self.counters.gauge("slot_occupancy", len(active))
                 if not active:
                     continue
+                # serving chaos sites: stall_decode_for / a scheduler
+                # kill lands here, between steps — the same boundary
+                # every other scheduling decision uses
+                chaos.on_decode_step(steps)
+                t0 = time.monotonic()
                 with self.timers.timed("decode_step"):
                     self._cache, toks = self._decode_fn(
                         self.params, self._cache, jnp.asarray(self._last),
                         jnp.asarray(self._idx), self._next_key())
                     toks = np.asarray(toks)  # the per-step host sync
+                self._step_ewma = self._ewma(self._step_ewma,
+                                             time.monotonic() - t0)
+                steps += 1
                 self.counters.inc("decode_steps")
                 with self.timers.timed("host_schedule"):
                     for s in active:
@@ -439,12 +768,18 @@ class DecodeEngine(object):
                     # rate("decode_tokens", "decode_steps") is true
                     # decode occupancy (bounded by slots)
                     self.counters.inc("decode_tokens", len(active))
+                    # re-publish occupancy AFTER deliveries: when the
+                    # last slot frees on a completion the loop parks in
+                    # cv.wait, and a gauge frozen at the pre-step value
+                    # would read "occupied" on an idle engine forever
+                    self.counters.gauge("slot_occupancy",
+                                        len(self._active_slots()))
         except BaseException as e:  # noqa: BLE001 - fail every client
             logger.exception("decode engine loop died")
             with self._cv:
                 self._broken = e
                 self._fail_outstanding(
-                    RuntimeError("decode engine failed: {}".format(e)))
+                    EngineFailed("decode engine failed: {}".format(e)))
 
     def _fail_outstanding(self, err):
         """Fail every queued and in-flight handle (scheduler thread
@@ -457,6 +792,14 @@ class DecodeEngine(object):
         self._queue.clear()
         for handle in failed:
             handle._finish(err)
+        # the loss ledger drain()'s verdict reads: these requests were
+        # ADMITTED and did not finish — an emptied queue must not be
+        # mistaken for "nothing was lost"
+        self._failed_requests += len(failed)
+        # the gauges must tell the truth on a dead/stopped engine:
+        # nothing is queued or occupied anymore
+        self.counters.gauge("queue_depth", 0)
+        self.counters.gauge("slot_occupancy", 0)
 
     def _admit(self, slot, handle):
         """Prefill ``handle``'s prompt into ``slot`` and emit its first
@@ -470,11 +813,14 @@ class DecodeEngine(object):
         # (the slot was occupied at pop time, so if this prefill dies
         # the loop's failure path finds the handle in _slot_req instead
         # of stranding its client on a timeout)
+        t0 = time.monotonic()
         with self.timers.timed("prefill"):
             self._cache, first = self._prefill_fn(
                 self.params, self._cache, jnp.int32(slot),
                 jnp.asarray(toks), jnp.int32(n), self._next_key())
             first = int(first)
+        self._prefill_ewma = self._ewma(self._prefill_ewma,
+                                        time.monotonic() - t0)
         self.counters.inc("prefills")
         self._idx[slot] = n
         self._last[slot] = first
@@ -496,6 +842,11 @@ class DecodeEngine(object):
             handle._finish()
             self._slot_req[slot] = None
             self.counters.inc("requests_completed")
+        elif chaos.on_token(len(handle._tokens)):
+            # chaos disconnect_client_at_token: the client vanished
+            # mid-stream; eviction happens at the next step boundary,
+            # exactly like a real disconnect-driven cancel
+            handle.cancel()
 
 
 class _BadRequest(ValueError):
@@ -804,6 +1155,20 @@ class ModelServer(object):
         #: set by supervisor.Supervisor.watch (or any operator hook) when
         #: the serving path is known-bad; /healthz then answers 503
         self._unhealthy = None
+        #: graceful-drain latch (drain() / SIGTERM): /healthz answers a
+        #: distinct 503 'draining' and POST routes refuse with 503 while
+        #: admitted work finishes. The lock + memo make drain()
+        #: genuinely idempotent — a second caller (double SIGTERM)
+        #: waits for the first drain and returns its verdict
+        self._draining = False
+        self._drain_lock = threading.Lock()
+        self._drain_result = None
+        #: POST requests currently inside a handler (admitted work's
+        #: RESPONSES count too: drain must not stop the server while a
+        #: finished generation is still being written to a slow client
+        #: — handler threads are daemons and die at interpreter exit)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
 
     # -- request handling ------------------------------------------------
 
@@ -822,13 +1187,21 @@ class ModelServer(object):
                 outputs = self._apply(self._variables, batch)
         return _to_json(outputs, row_format)
 
-    def generate(self, payload):
+    def generate(self, payload, client_gone=None):
         """{'prompt': [[...], ...], 'max_new_tokens': N} -> {'tokens': ...}.
 
         Each prompt becomes one engine request; the handles resolve
         concurrently (slot-interleaved), so a multi-prompt body — or many
         single-prompt clients — shares the same decode steps. A single
         flat prompt list is accepted and answered un-nested.
+
+        Lifecycle fields: ``deadline_s`` (seconds the client will wait)
+        rides the body into the engine — infeasible deadlines shed at
+        admission (503 + Retry-After), expired in-flight requests evict
+        at the next step boundary (504). ``client_gone`` (a callable
+        from the HTTP layer) is polled while waiting; a disconnected
+        client CANCELS its requests — no slot keeps decoding for a
+        closed socket.
         """
         # snapshot: stop() nulls the attribute, and a handler already
         # past this check must reach the engine's own clean "stopped"
@@ -849,6 +1222,14 @@ class ModelServer(object):
             max_new = int(max_new)
         except (TypeError, ValueError):
             raise _BadRequest("max_new_tokens must be an integer")
+        deadline_s = payload.get("deadline_s")
+        if deadline_s is not None:
+            try:
+                deadline_s = float(deadline_s)
+            except (TypeError, ValueError):
+                raise _BadRequest("deadline_s must be a number")
+            if not deadline_s > 0:
+                raise _BadRequest("deadline_s must be > 0")
         try:
             # vet the WHOLE body before submitting any of it: a 400 must
             # not leave earlier prompts of the same body decoding for a
@@ -856,12 +1237,43 @@ class ModelServer(object):
             vetted = [engine.validate(p, max_new) for p in prompts]
         except (ValueError, TypeError) as e:
             raise _BadRequest(str(e))
-        # atomic whole-body admission: QueueFull surfaces as 429 with
-        # nothing queued, instead of part of the body decoding for a
-        # client that got an error
-        handles = engine._submit_many(vetted)
-        tokens = [h.result() for h in handles]
+        # atomic whole-body admission: QueueFull surfaces as 429 (and a
+        # Shed as 503) with nothing queued, instead of part of the body
+        # decoding for a client that got an error
+        handles = engine._submit_many(vetted, deadline_s=deadline_s)
+        try:
+            tokens = [self._await_handle(h, handles, client_gone)
+                      for h in handles]
+        except BaseException:
+            # the response is an error for the WHOLE body: siblings
+            # still decoding would burn slots for an answer the client
+            # will never see — cancel them on the way out
+            for h in handles:
+                h.cancel()
+            raise
         return {"tokens": tokens[0] if flat else tokens}
+
+    @staticmethod
+    def _await_handle(handle, body, client_gone, poll_s=0.05,
+                      timeout=600.0):
+        """result() that also watches the client's socket: a client
+        that disconnected mid-wait cancels the WHOLE body's requests
+        (their slots free at the next step boundary) instead of the
+        server decoding on for a closed connection."""
+        if client_gone is None:
+            return handle.result(timeout)
+        deadline = time.monotonic() + timeout
+        while not handle._done.wait(poll_s):
+            if client_gone():
+                cancelled = [h for h in body if h.cancel()]
+                logger.info("client disconnected mid-generate; "
+                            "cancelled %d request(s)", len(cancelled))
+                raise Cancelled("client disconnected")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    "generation did not complete within {}s"
+                    .format(timeout))
+        return handle.result(0.1)
 
     def metadata(self):
         return {"model_spec": {"name": self.name,
@@ -870,6 +1282,15 @@ class ModelServer(object):
                              "format": "tfos-tpu-export-v1"}}
 
     # -- health (supervision plane) ---------------------------------------
+
+    def attach_engine(self, engine):
+        """(Re-)arm the :generate path with ``engine`` and clear any
+        unhealthy mark — the supervisor's RestartEngine policy calls
+        this after rebuilding a dead engine, flipping /healthz back to
+        200 so load balancers resume routing."""
+        self.engine = engine
+        self._unhealthy = None
+        logger.info("serving re-armed with a fresh decode engine")
 
     def mark_unhealthy(self, reason):
         """Flip /healthz to 503. Called by supervisor.Supervisor.watch
@@ -886,10 +1307,14 @@ class ModelServer(object):
         503 once the supervisor marked the server unhealthy OR the
         mounted engine's scheduler is dead (checked live, so even an
         unwatched server stops answering 200 over a dead decode plane).
-        The body carries the engine's liveness detail plus the
-        queue-depth / slot-occupancy gauges and token counts from its
-        tracing.Counters — the numbers an operator needs to tell "dead"
-        from "saturated"."""
+        A DRAINING server answers a distinct 503 ``status: "draining"``
+        — the load balancer's cue to stop routing while admitted work
+        finishes (an LB cannot tell "dying" from "retiring" through a
+        bare 503, and the two warrant different alerting). The body
+        carries the engine's liveness detail plus the queue-depth /
+        slot-occupancy gauges and token counts from its
+        tracing.Counters — the numbers an operator needs to tell
+        "dead" from "saturated" from "retiring"."""
         body = {"status": "ok", "model": self.name}
         engine = self.engine
         if engine is not None:
@@ -899,11 +1324,26 @@ class ModelServer(object):
             body["queue_depth"] = snap["gauges"].get("queue_depth", 0)
             body["slot_occupancy"] = snap["gauges"].get("slot_occupancy", 0)
             body["counts"] = snap["counts"]
+            if self._draining:
+                # draining outranks the liveness checks below: mid-
+                # drain the engine transitions draining -> stopped by
+                # DESIGN, and reporting that as "unhealthy" would page
+                # an operator for a planned retirement
+                body["status"] = "draining"
+                body["reason"] = "server is draining; " \
+                    "{} request(s) still in flight".format(
+                        engine.outstanding()
+                        if health["scheduler_thread"] else 0)
+                return 503, body
             if not health["alive"]:
                 body["status"] = "unhealthy"
                 body["reason"] = health.get("broken") or \
                     "decode engine scheduler is not running"
                 return 503, body
+        if self._draining:
+            body["status"] = "draining"
+            body["reason"] = "server is draining"
+            return 503, body
         if self._unhealthy is not None:
             body["status"] = "unhealthy"
             body["reason"] = self._unhealthy
@@ -915,6 +1355,90 @@ class ModelServer(object):
             "version": "1", "state": "AVAILABLE",
             "status": {"error_code": "OK", "error_message": ""}}]}
 
+    # -- graceful drain ----------------------------------------------------
+
+    def drain(self, timeout=None):
+        """Graceful shutdown, in load-balancer order: flip /healthz to
+        the distinct ``draining`` 503 (LBs stop routing), refuse new
+        POST work (503 + Retry-After), let every ADMITTED request
+        finish — the engine's :meth:`DecodeEngine.drain` zero-loss
+        contract, plus DELIVERY of their responses — then stop the HTTP
+        server and engine. ``timeout`` is ONE overall bound covering
+        both engine completion and response delivery; ``timeout=None``
+        waits for the engine as long as the work takes but caps the
+        post-drain delivery wait at 30s (a client that stops READING
+        its response is indistinguishable from a dead one — waiting
+        forever on its socket would wedge the shutdown). Returns True
+        only when every admitted request finished AND its response was
+        handed to the HTTP layer; False on any expiry. Idempotent, and
+        safe from any thread: a concurrent second call (a double
+        SIGTERM spawns two drain threads) blocks until the first drain
+        finishes and returns its verdict instead of re-running the
+        teardown."""
+        # flip the latch BEFORE queueing on the lock: healthz and the
+        # POST routes must refuse immediately even while another
+        # caller's drain is mid-flight
+        self._draining = True
+        with self._drain_lock:
+            if self._drain_result is not None:
+                return self._drain_result
+            logger.info("serving %r draining", self.name)
+            overall = None if timeout is None \
+                else time.monotonic() + max(float(timeout), 0.0)
+            engine = self.engine
+            drained = True
+            if engine is not None:
+                drained = engine.drain(
+                    timeout=None if overall is None
+                    else max(overall - time.monotonic(), 0.0))
+            # zero loss includes DELIVERY: the engine finishing a
+            # handle is not the client having its tokens — wait for
+            # in-flight POST handlers (daemon threads the interpreter
+            # would otherwise kill mid-write) to finish responding.
+            # The batcher must still be alive here: an admitted
+            # :predict inside this window finishes through it, so its
+            # teardown comes AFTER the wait
+            delivery_deadline = overall if overall is not None \
+                else time.monotonic() + 30.0
+            while True:
+                with self._inflight_lock:
+                    left = self._inflight
+                if left == 0:
+                    break
+                if time.monotonic() >= delivery_deadline:
+                    logger.warning(
+                        "drain: %d response(s) still being written at "
+                        "the delivery deadline", left)
+                    drained = False  # undelivered responses ARE loss
+                    break
+                time.sleep(0.02)
+            if self._batcher is not None:
+                self._batcher.stop()
+                self._batcher = None
+            self.stop()
+            logger.info("serving %r drained (%s) and stopped", self.name,
+                        "zero loss" if drained else "TIMED OUT with "
+                        "requests outstanding")
+            self._drain_result = drained
+            return drained
+
+    def install_sigterm_drain(self, timeout=None):
+        """Arm SIGTERM -> :meth:`drain` (the k8s/rolling-restart
+        contract: the orchestrator sends SIGTERM, the replica finishes
+        admitted work and exits instead of killing it). Must run on the
+        MAIN thread (the ``signal`` module's rule); the handler hands
+        the drain to a helper thread so the signal frame returns
+        immediately. Returns the previous handler."""
+        import signal as signal_mod
+
+        def _on_sigterm(signum, frame):
+            logger.warning("SIGTERM: draining serving %r", self.name)
+            threading.Thread(target=self.drain,
+                             kwargs={"timeout": timeout},
+                             name="tfos-serving-drain").start()
+
+        return signal_mod.signal(signal_mod.SIGTERM, _on_sigterm)
+
     # -- http plumbing ---------------------------------------------------
 
     def start(self):
@@ -924,13 +1448,32 @@ class ModelServer(object):
         server = self
 
         class Handler(BaseHTTPRequestHandler):
-            def _send(self, code, obj):
+            def _send(self, code, obj, headers=None):
                 body = json.dumps(obj).encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _client_gone(self):
+                """True once the client closed its connection: the
+                request socket is readable with EOF (nothing more was
+                sent, and a live client waiting on its response sends
+                nothing). Polled by the generate wait loop so a
+                disconnect cancels the engine work it was waiting on."""
+                import select
+                try:
+                    readable, _, _ = select.select(
+                        [self.connection], [], [], 0)
+                    if not readable:
+                        return False
+                    return self.connection.recv(
+                        1, socket.MSG_PEEK) == b""
+                except (OSError, ValueError):
+                    return True
 
             def do_GET(self):
                 if self.path == "/healthz":
@@ -943,14 +1486,31 @@ class ModelServer(object):
                 return self._send(404, {"error": "not found: %s" % self.path})
 
             def do_POST(self):
+                with server._inflight_lock:
+                    server._inflight += 1
+                try:
+                    return self._do_post_tracked()
+                finally:
+                    with server._inflight_lock:
+                        server._inflight -= 1
+
+            def _do_post_tracked(self):
                 routes = {"/v1/models/%s:predict" % server.name:
                           server.predict,
                           "/v1/models/%s:generate" % server.name:
-                          server.generate}
+                          lambda payload: server.generate(
+                              payload, client_gone=self._client_gone)}
                 handler = routes.get(self.path)
                 if handler is None:
                     return self._send(404,
                                       {"error": "not found: %s" % self.path})
+                if server._draining:
+                    # drain contract: no new work — in-flight requests
+                    # finish, fresh ones go to another replica
+                    return self._send(
+                        503, {"error": "server is draining",
+                              "status": "draining"},
+                        headers={"Retry-After": "5"})
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     payload = json.loads(self.rfile.read(n) or b"{}")
@@ -961,6 +1521,27 @@ class ModelServer(object):
                 except QueueFull as e:
                     # backpressure, not failure: retry later
                     return self._send(429, {"error": str(e)})
+                except DeadlineExceeded as e:
+                    # admitted but evicted past its deadline — the
+                    # gateway-timeout shape, not a server fault
+                    return self._send(504, {"error": str(e)})
+                except Cancelled as e:
+                    # request cancelled (usually: this client hung up);
+                    # 499 is the de-facto client-closed-request code.
+                    # The write is best-effort — the socket is likely
+                    # gone, and a broken pipe here must not crash the
+                    # handler thread into socketserver's stderr dump
+                    try:
+                        return self._send(499, {"error": str(e)})
+                    except OSError:
+                        return
+                except Retriable as e:
+                    # shed / draining / engine mid-restart: transient
+                    # by definition, so tell the client WHEN to retry
+                    return self._send(
+                        503, {"error": str(e)},
+                        headers={"Retry-After":
+                                 str(int(math.ceil(e.retry_after)))})
                 except Exception as e:  # noqa: BLE001 - surface as 500
                     logger.exception("%s failed", self.path)
                     return self._send(500, {"error": str(e)})
@@ -1012,12 +1593,20 @@ def main(argv=None):
                          "one batched model call inside this window "
                          "(0 = off); the generative path's throughput "
                          "lever")
+    ap.add_argument("--drain-timeout", type=float, default=None,
+                    help="bound (seconds) on the SIGTERM graceful "
+                         "drain; default: wait for all admitted work "
+                         "(zero loss)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     server = ModelServer(args.model_dir, name=args.name,
                          host=args.host, port=args.port,
                          batch_window_ms=args.batch_window_ms)
     host, port = server.start()
+    # rolling-restart contract: SIGTERM flips /healthz to 'draining',
+    # admitted requests finish, then the serve thread exits and main
+    # returns — the orchestrator's grace period does the rest
+    server.install_sigterm_drain(timeout=args.drain_timeout)
     print("serving %s at http://%s:%d/v1/models/%s" % (
         args.model_dir, host, port, args.name))
     try:

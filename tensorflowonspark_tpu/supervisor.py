@@ -36,12 +36,17 @@ Recovery policies:
   built-in engine's job scheduler honors the exclusion).
 - :class:`FailJob` — clean teardown, error re-raised on the driver
   (exactly today's unsupervised behavior, made explicit).
+- :class:`RestartEngine` — the SERVING-plane policy (PR 4): a watched
+  ``DecodeEngine`` whose scheduler died is rebuilt from its own
+  construction config with bounded backoff and re-armed on the
+  ``ModelServer``, instead of 503-ing forever.
 
 Entry point: ``cluster.run(..., supervise=SupervisorConfig(...))``
 returns a :class:`SupervisedCluster` with the familiar
 ``train``/``shutdown`` surface. The serving plane hooks in through
 :meth:`Supervisor.watch`, which marks a ``ModelServer`` unhealthy (503
-on ``/healthz``) the moment its ``DecodeEngine`` scheduler thread dies.
+on ``/healthz``) the moment its ``DecodeEngine`` scheduler thread dies
+— and, given ``restart=RestartEngine(...)``, auto-restarts the engine.
 
 Replay granularity and the delivery guarantee, stated precisely:
 partitions are acknowledged when the node *consumed* them (feeder join
@@ -189,6 +194,43 @@ class Blacklist(RestartFromCheckpoint):
                 sorted(newly), width_after)
         return Decision(Decision.RESTART, delay=base.delay, exclude=newly,
                         reason=reason)
+
+
+class RestartEngine(object):
+    """Serving-plane recovery policy for :meth:`Supervisor.watch`: when
+    a watched ``DecodeEngine``'s scheduler dies (uncaught loop error —
+    NOT a deliberate stop/drain), rebuild the engine from its own
+    construction config (``engine.respawn()``) with bounded exponential
+    backoff and re-arm the ``ModelServer``, instead of leaving the
+    replica answering 503 forever.
+
+    The dying loop already failed every outstanding handle with the
+    retriable ``serving.EngineFailed`` (clients retry; HTTP surfaces it
+    as 503 + Retry-After), so a restart only has to bring the engine
+    back for FRESH requests; ``tracing.Counters``' ``engine_restarts``
+    counts the rebuilds (the respawned engine shares the dead one's
+    counters). ``max_restarts`` bounds rebuilds per watch entry; when
+    exhausted the server is marked unhealthy permanently — the same
+    terminal state as an unwatched death, reached honestly.
+    """
+
+    def __init__(self, max_restarts=3, backoff=0.5, backoff_factor=2.0,
+                 max_backoff=30.0):
+        self.max_restarts = int(max_restarts)
+        self.backoff = float(backoff)
+        self.backoff_factor = float(backoff_factor)
+        self.max_backoff = float(max_backoff)
+
+    def decide(self, restarts):
+        if restarts >= self.max_restarts:
+            return Decision(
+                Decision.FAIL,
+                reason="gave up after {} engine restart(s)".format(restarts))
+        delay = min(self.backoff * self.backoff_factor ** restarts,
+                    self.max_backoff)
+        return Decision(Decision.RESTART, delay=delay,
+                        reason="engine restart {} of {}".format(
+                            restarts + 1, self.max_restarts))
 
 
 class SupervisorConfig(object):
@@ -412,12 +454,22 @@ class Supervisor(object):
 
     # -- serving-plane watch ---------------------------------------------
 
-    def watch(self, engine, server=None):
+    def watch(self, engine, server=None, restart=None):
         """Watch a serving ``DecodeEngine``; when its scheduler thread
         dies (or the engine breaks), mark ``server`` (a ``ModelServer``)
         unhealthy so ``GET /healthz`` answers 503 — a dead scheduler
-        must not leave the HTTP surface answering as if healthy."""
+        must not leave the HTTP surface answering as if healthy.
+
+        ``restart`` (a :class:`RestartEngine`) upgrades the response
+        from mark-and-abandon to RECOVER: the dead engine is stopped,
+        rebuilt via ``engine.respawn()`` after the policy's backoff,
+        and re-armed on ``server`` (``attach_engine`` clears the
+        unhealthy mark, /healthz returns to 200). Deliberate deaths —
+        ``stop()`` / ``drain()`` flip ``stopping`` first — are never
+        resurrected: an operator retiring a replica must not fight its
+        own supervisor."""
         self._watched.append({"engine": engine, "server": server,
+                              "restart": restart, "restarts": 0,
                               "dead": False})
         self.start()
         return self
@@ -435,9 +487,75 @@ class Supervisor(object):
                 ("stopped" if health.get("stopping")
                  else "scheduler thread exited"))
             self.events.record("engine_dead", reason=reason)
+            self._report(FailureEvent("engine_dead", None, reason))
+            if entry["restart"] is not None \
+                    and not health.get("stopping") \
+                    and not health.get("draining") \
+                    and hasattr(entry["engine"], "respawn"):
+                # draining counts as deliberate too: an engine that
+                # crashes MID-DRAIN belongs to the operator retiring
+                # it (ModelServer.drain is about to stop the server) —
+                # respawning it would leak a fresh scheduler against a
+                # server that is going away
+                self._restart_engine(entry, reason)
+                continue
             if entry["server"] is not None:
                 entry["server"].mark_unhealthy(reason)
-            self._report(FailureEvent("engine_dead", None, reason))
+
+    def _restart_engine(self, entry, reason):
+        """Drive one RestartEngine recovery: decide -> backoff ->
+        stop the corpse -> respawn -> re-arm, retrying failed respawns
+        INSIDE this call until the policy exhausts. The retry loop must
+        live here, not across polls: stopping the corpse flips its
+        ``stopping`` flag, so a later poll would read the death as
+        deliberate and silently disable recovery with restart budget
+        remaining. Runs on the monitor thread (backoff + retries pause
+        other classification — acceptable for a serving-only
+        supervisor; use one Supervisor per concern if that bites)."""
+        server = entry["server"]
+        old = entry["engine"]
+        while not self._stop.is_set():
+            decision = entry["restart"].decide(entry["restarts"])
+            if decision.action != Decision.RESTART:
+                self.events.record("engine_restart_exhausted",
+                                   reason=decision.reason)
+                if server is not None:
+                    server.mark_unhealthy(
+                        "{} ({})".format(reason, decision.reason))
+                return
+            if server is not None:
+                # 503 for the rebuild window: a restart takes real time
+                # (backoff + engine construction) and the LB must not
+                # route into it
+                server.mark_unhealthy(
+                    "engine restarting: {}".format(reason))
+            if decision.delay:
+                logger.info("engine restart backing off %.1fs",
+                            decision.delay)
+                if self._stop.wait(decision.delay):
+                    return  # supervisor stopped mid-backoff
+            entry["restarts"] += 1
+            try:
+                # stop() joins the (dead) scheduler and fails any
+                # handle the corpse still holds; respawn() rebuilds
+                # from the engine's own construction config, sharing
+                # its counters
+                old.stop()
+                fresh = old.respawn()
+            except Exception as e:  # noqa: BLE001 - policy bounds retries
+                logger.exception("engine respawn failed")
+                self.events.record("engine_restart_failed", error=str(e))
+                continue  # decide again: next attempt or exhaustion
+            entry["engine"] = fresh
+            entry["dead"] = False
+            fresh.counters.inc("engine_restarts")
+            if server is not None:
+                server.attach_engine(fresh)
+            self.events.record("engine_restarted",
+                               restarts=entry["restarts"], reason=reason)
+            logger.warning("decode engine restarted (restart %d): %s",
+                           entry["restarts"], reason)
+            return
 
     # -- remote abort ----------------------------------------------------
 

@@ -21,9 +21,11 @@ code; its own plumbing is unobservable. Here the framework exposes:
   ``fed_frac_of_device`` — the remaining feed loss is attributed to a
   stage instead of unexplained.
 - :class:`Counters` — named monotonic counters + gauges for scheduler
-  loops: serving.DecodeEngine exports queue depth, slot occupancy, and
-  tokens-per-step through one of these, and bench.py / scripts/
-  profile_serving.py read the snapshots.
+  loops: serving.DecodeEngine exports queue depth, slot occupancy,
+  tokens-per-step, and the request-lifecycle tallies (``shed`` /
+  ``cancelled`` / ``deadline_exceeded`` / ``engine_restarts``) through
+  one of these; bench.py / scripts/profile_serving.py read the
+  snapshots and ModelServer's /healthz serves them live.
 - :class:`EventLog` — timestamped named events for the supervision plane
   (supervisor.py): failure detected, attempt torn down, cluster
   reformed, checkpoint restored, first post-restore step. The MTTR

@@ -129,7 +129,10 @@ def test_save_existing_step_is_noop(jax, tmp_path):
     resnet example's --ckpt_dir resume path)."""
     from tensorflowonspark_tpu import checkpoint
 
-    state = {"w": np.ones((4,), np.float32), "step": np.int32(2)}
+    # 0-d ndarray, not np.int32(2): current orbax's standard handler
+    # rejects numpy SCALAR leaves outright (same env drift
+    # tests/test_recovery.py's _np_state already works around)
+    state = {"w": np.ones((4,), np.float32), "step": np.array(2, np.int32)}
     ckpt = checkpoint.Checkpointer(str(tmp_path / "ckpt"), chief=True)
     assert ckpt.save(2, state) is True
     ckpt.wait()

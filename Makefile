@@ -8,7 +8,8 @@ PYTHON ?= python
 # bash for pipefail in the onchip recipe (dash lacks it)
 SHELL := /bin/bash
 
-.PHONY: test test-fast bench smoke install lint native clean chaos
+.PHONY: test test-fast bench smoke install lint native clean chaos \
+  metrics-lint
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -18,9 +19,16 @@ native: tensorflowonspark_tpu/_libshmring.so
 tensorflowonspark_tpu/_libshmring.so: native/shm_ring.cpp
 	g++ -O2 -std=c++17 -shared -fPIC -o $@ $< -lrt -pthread
 
+# metric-catalog drift gate: every family tracing.METRIC_FAMILIES
+# exports must have a docs/observability.md catalog row and vice versa
+# (scripts/metrics_lint.py) — a prerequisite of the merge gate, so the
+# catalog cannot drift from the code
+metrics-lint:
+	$(PYTHON) scripts/metrics_lint.py
+
 # per-suite wall clock cap via coreutils timeout (pytest-timeout is not a
 # hard dependency); a wedged multi-process test fails CI instead of hanging
-test:
+test: metrics-lint
 	timeout $(SUITE_TIMEOUT) $(PYTHON) -m pytest tests/ -q
 
 # example-surface smokes (tests/test_examples.py) add ~12 min of

@@ -41,8 +41,13 @@ Prints ONE JSON line. Fields:
                          facing fresh traffic — the regime where the
                          batcher's one-program-per-signature compile
                          cost is real and unbounded); ``*_warm`` fields
-                         are the steady-state rerun. p50/p99 are
-                         per-request submit->complete latencies.
+                         are the steady-state rerun. p50/p95/p99 are
+                         per-request submit->complete latencies read
+                         from the engine's own MetricsRegistry
+                         histograms (PR 5) — the same distributions
+                         ``GET /metrics`` exposes — plus per-histogram
+                         TTFT / per-token / decode-step / queue-wait
+                         quantiles under ``engine.hist``.
 - ``recovery``         — the supervision plane (PR 3): MTTR of an
                          injected mid-job trainer SIGKILL under
                          ``cluster.run(..., supervise=...)``, with the
@@ -278,7 +283,8 @@ def _bench_model(on_tpu):
 
 
 def _median(values):
-    return sorted(values)[len(values) // 2]
+    from tensorflowonspark_tpu import metrics_report
+    return metrics_report.median(values)
 
 
 def _device_only(on_tpu, batch, image, steps, warmup):
@@ -362,16 +368,17 @@ def _batcher_leg(dec, params, reqs):
     whole-generation program. Modeled in-process with the batcher's own
     policies (perfect same-signature coalescing, rows padded to a
     power-of-two bucket) — generous to the baseline: a real window
-    would add wait time and miss some coalesces. Returns
-    (tokens/sec, latencies, n_calls)."""
+    would add wait time and miss some coalesces. Latencies land in a
+    ``tracing.Histogram`` (no private percentile math — same read path
+    as the engine leg). Returns (tokens/sec, quantile dict, n_calls)."""
     import jax.numpy as jnp
     import numpy as np
-    from tensorflowonspark_tpu import generation
+    from tensorflowonspark_tpu import generation, metrics_report, tracing
 
     groups = {}
     for i, (prompt, max_new) in enumerate(reqs):
         groups.setdefault((len(prompt), max_new), []).append(i)
-    latencies = [0.0] * len(reqs)
+    hist = tracing.Histogram()
     tokens = 0
     t0 = time.monotonic()
     for (p_len, max_new), members in groups.items():
@@ -387,19 +394,24 @@ def _batcher_leg(dec, params, reqs):
         out.block_until_ready()
         done = time.monotonic() - t0
         tokens += max_new * len(members)
-        for i in members:
-            latencies[i] = done
+        for _ in members:
+            hist.observe(done)
     wall = time.monotonic() - t0
-    return tokens / wall, latencies, len(groups)
+    return tokens / wall, metrics_report.quantiles_ms(hist), len(groups)
 
 
 def _engine_leg(dec, params, reqs, slots):
     """The NEW serving shape: continuous batching through
-    serving.DecodeEngine. Returns (tokens/sec, latencies, stats) — THE
-    engine-measurement harness; scripts/profile_serving.py imports it so
-    bench numbers and profile attributions describe the same run
-    shape."""
-    from tensorflowonspark_tpu import serving
+    serving.DecodeEngine. Returns (tokens/sec, latency quantiles,
+    stats) — THE engine-measurement harness; scripts/profile_serving.py
+    imports it so bench numbers and profile attributions describe the
+    same run shape.
+
+    All percentiles are read from the engine's OWN MetricsRegistry
+    histograms (PR 5) — the exact objects ``GET /metrics`` renders —
+    so the published p50/p95/p99 and a scraped series are two views of
+    one distribution, never parallel sample lists."""
+    from tensorflowonspark_tpu import metrics_report, serving
 
     eng = serving.DecodeEngine(dec, params, slots=slots)
     try:
@@ -409,6 +421,7 @@ def _engine_leg(dec, params, reqs, slots):
             h.result(1800)
         wall = time.monotonic() - t0
         counts = eng.counters.snapshot()["counts"]
+        quantiles = metrics_report.serving_quantiles(eng.metrics)
         stats = {"compile": eng.compile_stats(),
                  "tokens": counts.get("tokens", 0),
                  "wall_s": round(wall, 3),
@@ -422,11 +435,15 @@ def _engine_leg(dec, params, reqs, slots):
                  "lifecycle": {k: counts.get(k, 0) for k in
                                ("shed", "cancelled", "deadline_exceeded",
                                 "engine_restarts")},
-                 "stage_ms": eng.timers.per_ms(),
-                 "stage_s_total": {k: round(v, 3) for k, v in
-                                   eng.timers.snapshot().items()}}
-        latencies = [h.latency for h in handles]
-        return counts.get("tokens", 0) / wall, latencies, stats
+                 # per-histogram latency quantiles (ttft / per-token /
+                 # decode-step / queue-wait) from the same registry
+                 "hist": {k: v for k, v in quantiles.items()
+                          if k != "latency"},
+                 "stage_ms": metrics_report.stage_ms(eng.timers),
+                 "stage_s_total": metrics_report.stage_totals_s(
+                     eng.timers)}
+        return (counts.get("tokens", 0) / wall, quantiles["latency"],
+                stats)
     finally:
         eng.stop()
 
@@ -452,10 +469,9 @@ def _serving_decode_bench(on_tpu):
         warm = fn()
         return cold, warm
 
-    def _pcts(latencies):
-        return {"p50_ms": round(float(np.percentile(latencies, 50)) * 1e3),
-                "p99_ms": round(float(np.percentile(latencies, 99)) * 1e3)}
-
+    # latency quantiles come back from the legs already read out of
+    # histograms (the engine's own registry / the batcher's standalone
+    # tracing.Histogram) — no private percentile math here
     (b_cold_tps, b_cold_lat, n_calls), (b_warm_tps, b_warm_lat, _) = _leg(
         lambda: _batcher_leg(dec, params, reqs))
     (e_cold_tps, e_cold_lat, e_stats), (e_warm_tps, e_warm_lat, _) = _leg(
@@ -467,13 +483,13 @@ def _serving_decode_bench(on_tpu):
                      "total_tokens": sum(mn for _, mn in reqs),
                      "signatures": n_calls},
         "engine": dict(tokens_per_sec=round(e_cold_tps, 1),
-                       **_pcts(e_cold_lat), **e_stats),
+                       **dict(e_cold_lat, **e_stats)),
         "batcher": dict(tokens_per_sec=round(b_cold_tps, 1),
-                        **_pcts(b_cold_lat), model_calls=n_calls),
+                        model_calls=n_calls, **b_cold_lat),
         "engine_warm": dict(tokens_per_sec=round(e_warm_tps, 1),
-                            **_pcts(e_warm_lat)),
+                            **e_warm_lat),
         "batcher_warm": dict(tokens_per_sec=round(b_warm_tps, 1),
-                             **_pcts(b_warm_lat)),
+                             **b_warm_lat),
         "speedup": round(e_cold_tps / b_cold_tps, 2) if b_cold_tps else None,
         "speedup_warm": round(e_warm_tps / b_warm_tps, 2)
         if b_warm_tps else None,

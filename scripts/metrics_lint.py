@@ -1,0 +1,84 @@
+"""Metric-catalog drift gate (``make metrics-lint``).
+
+The observability contract has three legs that must agree:
+
+1. ``tracing.METRIC_FAMILIES`` — the in-code canonical catalog every
+   exposition renders from;
+2. the metric-name table in docs/observability.md — what operators
+   read when they build dashboards;
+3. what a live scrape actually emits — pinned by
+   tests/test_observability.py against (1).
+
+This script pins (1) == (2): every family in METRIC_FAMILIES must have
+a catalog row in docs/observability.md and vice versa, with matching
+types. A metric added in code without documentation — or a documented
+series the code no longer emits — fails the build instead of drifting.
+
+Doc format it parses: markdown table rows whose first cell is a
+backticked family name and second cell its type, e.g.
+
+    | `tfos_serving_ttft_seconds` | histogram | ... | ... |
+
+Exit 0 on agreement; 1 with a diff otherwise. Pure python (no jax), so
+it is safe as a default-test-target prerequisite.
+"""
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DOC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "docs", "observability.md")
+
+_ROW = re.compile(r"^\|\s*`(tfos_[a-z0-9_]+)`\s*\|\s*([a-z]+)\s*\|")
+
+
+def doc_catalog(path=DOC):
+    """{family: type} parsed from the docs table rows."""
+    catalog = {}
+    with open(path) as f:
+        for line in f:
+            m = _ROW.match(line)
+            if m:
+                catalog[m.group(1)] = m.group(2)
+    return catalog
+
+
+def main(argv=None):
+    from tensorflowonspark_tpu import tracing
+
+    code = {name: meta[0]
+            for name, meta in tracing.METRIC_FAMILIES.items()}
+    try:
+        docs = doc_catalog()
+    except OSError as e:
+        print("metrics-lint: cannot read {}: {}".format(DOC, e),
+              file=sys.stderr)
+        return 1
+    problems = []
+    for name in sorted(set(code) - set(docs)):
+        problems.append("in code (tracing.METRIC_FAMILIES) but missing "
+                        "from docs/observability.md: {}".format(name))
+    for name in sorted(set(docs) - set(code)):
+        problems.append("documented in docs/observability.md but not in "
+                        "tracing.METRIC_FAMILIES: {}".format(name))
+    for name in sorted(set(code) & set(docs)):
+        if code[name] != docs[name]:
+            problems.append("type drift for {}: code says {!r}, docs "
+                            "say {!r}".format(name, code[name],
+                                              docs[name]))
+    if problems:
+        print("metrics-lint FAILED ({} problem(s)):".format(
+            len(problems)), file=sys.stderr)
+        for p in problems:
+            print("  - " + p, file=sys.stderr)
+        return 1
+    print("metrics-lint: {} families, code and docs agree".format(
+        len(code)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

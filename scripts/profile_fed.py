@@ -132,10 +132,11 @@ def run_transport_only(transport, args):
     if ring is not None:
         ring.unlink()
         ring.close()
+    from tensorflowonspark_tpu import metrics_report
     print("[%s/transport-only] %.0f img/s consumer side (%.2fs, "
           "feedwait=%.3fs)  feed stages/sample(ms): %s"
           % (transport, images / dt, dt, feed.stats()["wait_s"],
-             feed.timers.per_ms()), flush=True)
+             metrics_report.format_stage_ms(feed.timers)), flush=True)
     return images / dt
 
 
@@ -226,13 +227,15 @@ def run_mode(transport, mode, args):
             ring.unlink()
             ring.close()
 
+    from tensorflowonspark_tpu import metrics_report
     rate = images / dt if images else 0.0
     print("[%s/%s] %.0f img/s  (%.2fs total)  stages/step(ms): %s  "
           "feedwait=%.3fs  feed stages/sample(ms): %s"
           % (transport, mode, rate, dt,
              {k: round(v / max(args.steps, 1) * 1000, 1)
               for k, v in T.items()},
-             feed.stats()["wait_s"], feed.timers.per_ms()), flush=True)
+             feed.stats()["wait_s"],
+             metrics_report.format_stage_ms(feed.timers)), flush=True)
     return rate
 
 

@@ -42,8 +42,10 @@ STAGES = ("detect_s", "reform_s", "restore_s", "first_step_s")
 
 
 def _median(values):
-    values = sorted(values)
-    return values[len(values) // 2]
+    # shared reducer (tensorflowonspark_tpu.metrics_report): one median
+    # implementation across bench.py and every profile script
+    from tensorflowonspark_tpu.metrics_report import median
+    return median(values)
 
 
 def main(argv=None):

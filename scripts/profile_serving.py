@@ -34,18 +34,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _run(dec, params, reqs, slots, label, out):
-    import numpy as np
-
     # bench.py's harness — ONE engine-measurement implementation, so
-    # the profiler's stage attribution describes the benched run shape
+    # the profiler's stage attribution describes the benched run shape.
+    # Latency quantiles arrive already read from the engine's
+    # MetricsRegistry histograms (tensorflowonspark_tpu.metrics_report)
+    # — the same distributions GET /metrics exposes.
     from bench import _engine_leg
 
     tps, lat, stats = _engine_leg(dec, params, reqs, slots)
-    out[label] = dict(
-        tokens_per_sec=round(tps, 1),
-        p50_ms=round(float(np.percentile(lat, 50)) * 1e3),
-        p99_ms=round(float(np.percentile(lat, 99)) * 1e3),
-        **stats)
+    out[label] = dict(tokens_per_sec=round(tps, 1), **dict(lat, **stats))
 
 
 def main(argv=None):
@@ -107,6 +104,9 @@ def main(argv=None):
                       r["prefills"]))
         print("  stages (mean ms/call): {}".format(r["stage_ms"]))
         print("  stages (total s):      {}".format(r["stage_s_total"]))
+        print("  histograms (registry quantiles, ms):")
+        for key in ("ttft", "per_token", "decode_step", "queue_wait"):
+            print("    {:<12} {}".format(key, r["hist"][key]))
         print("  compile: {}".format(r["compile"]))
         print("  lifecycle: {}".format(r["lifecycle"]))
 

@@ -196,6 +196,29 @@ class TFCluster(object):
                 return "http://{}:{}".format(n["host"], n["tb_port"])
         return None
 
+    # -- observability ----------------------------------------------------
+
+    def metrics(self):
+        """Cluster-wide observability rollup from the BEAT-piggybacked
+        registry snapshots: ``{"executors": {eid: {metrics, train_step,
+        feed_hb, state, age}}, "cluster": {executors, train_step,
+        merged}}`` where ``merged`` sums every executor's feed-stage
+        timers and counters (``tracing.merge_snapshots``). The same
+        view the driver's stats endpoint serves over HTTP — see
+        :meth:`metrics_url` and docs/observability.md."""
+        from tensorflowonspark_tpu import tracing
+        return tracing.cluster_rollup(self.server.metrics_snapshot())
+
+    def metrics_url(self):
+        """URL of the driver-side OpenMetrics exposition (the
+        reservation server's stats HTTP port), or None if it failed to
+        bind. ``GET /metrics`` there renders every executor's series
+        under an ``executor`` label — one scrape target for the whole
+        cluster."""
+        if self.server.stats_addr is None:
+            return None
+        return "http://{}:{}/metrics".format(*self.server.stats_addr)
+
 
 def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
         input_mode=InputMode.SPARK, log_dir=None, driver_ps_nodes=False,

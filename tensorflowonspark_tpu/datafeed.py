@@ -174,8 +174,29 @@ class DataFeed(object):
         # the per-stage breakdown (ring wait / decode / gather; the
         # prefetcher adds device_put into the same instance).
         self.timers = tracing.StageTimers()
-        self._stats = {"records": 0, "chunks": 0, "wait_s": 0.0,
-                       "staging_alloc": 0, "staging_reuse": 0}
+        self._wait_s = 0.0  # cumulative blocked-on-transport seconds
+        # Observability plane (PR 5): the feed counters (records /
+        # chunks / batches / staging) and stage timers live in ONE
+        # MetricsRegistry — stats() reads the same Counters the
+        # registry renders, so user-visible stats and scraped series
+        # can never disagree — and its compact snapshot rides the
+        # progress heartbeat into the broker kv, where node.py's beat
+        # thread piggybacks it on the BEAT lease: the driver's
+        # cluster.metrics() / the reservation server's /metrics
+        # endpoint see every executor's feed-stage breakdown without a
+        # new channel.
+        self._counts = tracing.Counters()
+        self.metrics = tracing.MetricsRegistry()
+        self.metrics.add_counters("tfos_feed", self._counts)
+        self.metrics.add_timers("tfos_feed_stage", self.timers)
+        try:
+            # publish the (empty) snapshot immediately: an executor
+            # whose feed never serves a batch still beats a metrics
+            # key, so the driver's rollup distinguishes "idle feed"
+            # from "no feed plane at all"
+            self.mgr.set("metrics", self.metrics.snapshot())
+        except Exception:  # noqa: BLE001 - kv store may be gone
+            pass
         # Progress heartbeat: a throttled batches-served counter in the
         # broker kv. node.shutdown() re-arms its termination grace while
         # this advances, so a trainer legitimately stepping through a deep
@@ -188,8 +209,8 @@ class DataFeed(object):
         # queue traffic; and post-end-of-feed empty batches count as no
         # progress at all.
         self._hb_at = None       # monotonic of the last heartbeat publish
-        self._hb_batches = 0
         self._last_progress = None  # monotonic of the last non-empty batch
+        self._metrics_flushed = False  # final end-of-feed flush, once
 
     def next_batch(self, batch_size):
         """Next batch of up to ``batch_size`` records.
@@ -238,7 +259,7 @@ class DataFeed(object):
                 _unpin_segments(segs)
             t0 = time.monotonic()
             item = self._next_item()
-            self._stats["wait_s"] += time.monotonic() - t0
+            self._wait_s += time.monotonic() - t0
             if isinstance(item, Marker):
                 self._item_done()
                 if isinstance(item, EndFeed):
@@ -253,8 +274,8 @@ class DataFeed(object):
             else:
                 seg = item if isinstance(item, list) else [item]
             self._pending.append(seg)
-            self._stats["records"] += _seg_len(seg)
-            self._stats["chunks"] += 1
+            self._counts.inc("records", _seg_len(seg))
+            self._counts.inc("chunks")
             self._item_done()
         # A trailing partition marker that traveled WITH the final chunk
         # (tail coalescing) is consumed in-call: the feeder's queue join
@@ -273,25 +294,42 @@ class DataFeed(object):
             # not progress, and must not re-arm the shutdown grace (a
             # buggy map_fun spinning on empty next_batch calls would
             # otherwise hold off termination forever).
-            self._hb_batches += 1
+            self._counts.inc("batches")
             self._last_progress = time.monotonic()
             self._heartbeat()
             # deterministic fault injection (chaos.py): kill/stall sites
             # keyed on batches served — a no-op O(1) check when unarmed
-            chaos.on_batch(self, self._hb_batches)
+            chaos.on_batch(self, self._counts.get("batches"))
+        if self.done_feeding and not self._metrics_flushed:
+            # final flush at end-of-feed: the 2s heartbeat throttle
+            # otherwise leaves everything since the last publish — on a
+            # short job, most of the run — out of the driver's
+            # harvested rollup
+            self._metrics_flushed = True
+            self._publish_metrics()
         return self._combine(segs)
 
     def _heartbeat(self):
-        """Publish batches-served progress to the kv, at most every 2s
-        (one small RPC — negligible against a chunk's payload)."""
+        """Publish batches-served progress — and the compact metrics
+        snapshot the BEAT lease piggybacks — to the kv, at most every
+        2s (two small RPCs — negligible against a chunk's payload)."""
         now = time.monotonic()
         if self._hb_at is not None and now - self._hb_at < 2.0:
             return
-        if chaos.on_heartbeat():  # injected heartbeat outage (chaos.py)
-            return
+        if chaos.on_heartbeat():  # injected heartbeat outage: do NOT
+            return                # advance the throttle — retry next batch
         self._hb_at = now
+        self._publish_metrics()
+
+    def _publish_metrics(self):
+        """Best-effort publish of progress + the registry snapshot to
+        the broker kv (the beat thread piggybacks both on the BEAT
+        lease). Respects an injected heartbeat outage (chaos.py)."""
+        if chaos.on_heartbeat():
+            return
         try:
-            self.mgr.set("feed_hb", self._hb_batches)
+            self.mgr.set("feed_hb", self._counts.get("batches"))
+            self.mgr.set("metrics", self.metrics.snapshot())
         except Exception:  # noqa: BLE001 - kv store may be gone at teardown
             pass
 
@@ -370,12 +408,12 @@ class DataFeed(object):
         if (buf is not None and buf.dtype == like.dtype
                 and buf.shape[1:] == like.shape[1:]
                 and buf.shape[0] >= rows):
-            self._stats["staging_reuse"] += 1
+            self._counts.inc("staging_reuse")
             return buf
         buf = np.empty((rows,) + like.shape[1:], like.dtype)
         if self._staging_reuse:
             self._staging[name] = buf
-        self._stats["staging_alloc"] += 1
+        self._counts.inc("staging_alloc")
         return buf
 
     def _next_item(self):
@@ -547,9 +585,14 @@ class DataFeed(object):
         tests/test_datafeed.py::test_stats_schema.
         """
         now = time.monotonic()
-        out = dict(self._stats)
+        counts = self._counts.snapshot()["counts"]
+        out = {"records": counts.get("records", 0),
+               "chunks": counts.get("chunks", 0),
+               "wait_s": self._wait_s,
+               "staging_alloc": counts.get("staging_alloc", 0),
+               "staging_reuse": counts.get("staging_reuse", 0)}
         out["stages"] = self.timers.snapshot()
-        out["batches"] = self._hb_batches
+        out["batches"] = counts.get("batches", 0)
         out["heartbeat_age_s"] = None if self._hb_at is None \
             else now - self._hb_at
         out["last_progress_age_s"] = None if self._last_progress is None \
@@ -580,6 +623,11 @@ class DataFeed(object):
         logger.info("DataFeed terminating: draining input feed")
         self.mgr.set("state", "terminating")
         self.done_feeding = True
+        if not self._metrics_flushed:
+            # a terminated feed never reaches the end-of-feed flush in
+            # next_batch — publish what it measured before draining
+            self._metrics_flushed = True
+            self._publish_metrics()
         # Free any zero-copy slots first: draining reads the ring at the
         # tail, which the held slots pin — and a terminated feed will
         # never gather them out.

@@ -1,0 +1,74 @@
+"""Shared metric-reading and report-formatting helpers.
+
+One home for the percentile and stage-printing code that was
+copy-pasted across ``bench.py``, ``scripts/profile_serving.py``,
+``scripts/profile_recovery.py``, and ``scripts/profile_fed.py``
+(each kept a private sample list and its own ``np.percentile`` /
+median / stage-table variant). Everything here READS the
+observability plane (``tracing.MetricsRegistry`` / ``Histogram`` /
+``StageTimers``) — the same objects ``GET /metrics`` exposes — so a
+published bench number and a scraped series can never drift: they are
+two views of one histogram.
+
+Import discipline: pure python, no jax/numpy — safe in driver
+processes that must not initialize a device backend.
+"""
+
+
+def median(values):
+    """Middle element of ``values`` (upper median for even counts) —
+    the bench's standard multi-rep reducer."""
+    values = sorted(values)
+    return values[len(values) // 2]
+
+
+def quantiles_ms(hist, pcts=(50, 95, 99)):
+    """{"p50_ms": ..., "p95_ms": ..., "p99_ms": ...} read from a
+    ``tracing.Histogram`` (milliseconds, rounded; Nones when the
+    histogram is empty)."""
+    out = {}
+    for p in pcts:
+        q = hist.quantile(p / 100.0) if hist is not None else None
+        out["p{:g}_ms".format(p)] = None if q is None \
+            else round(q * 1e3, 3)
+    return out
+
+
+#: the serving histograms every latency report reads, in report order:
+#: {report key: registry family}
+SERVING_HISTOGRAMS = (
+    ("latency", "tfos_serving_request_seconds"),
+    ("ttft", "tfos_serving_ttft_seconds"),
+    ("per_token", "tfos_serving_token_latency_seconds"),
+    ("decode_step", "tfos_serving_decode_step_seconds"),
+    ("queue_wait", "tfos_serving_queue_wait_seconds"),
+)
+
+
+def serving_quantiles(registry, pcts=(50, 95, 99)):
+    """Per-histogram latency quantiles from a serving engine's
+    registry: {latency, ttft, per_token, decode_step, queue_wait} ->
+    quantile dicts. The block ``bench.py serving_decode`` publishes and
+    ``scripts/profile_serving.py`` prints — read from the SAME
+    histograms ``GET /metrics`` renders."""
+    return {key: quantiles_ms(registry.get_histogram(family), pcts)
+            for key, family in SERVING_HISTOGRAMS}
+
+
+def stage_ms(timers):
+    """{stage: mean ms per sample} from a ``tracing.StageTimers`` —
+    the human-readable per-stage attribution every profile prints."""
+    return timers.per_ms()
+
+
+def stage_totals_s(timers):
+    """{stage: total seconds, rounded} from a ``StageTimers``."""
+    return {k: round(v, 3) for k, v in timers.snapshot().items()}
+
+
+def format_stage_ms(timers):
+    """One-line ``stage=ms`` rendering of :func:`stage_ms`, sorted by
+    cost — the compact form the fed profiles log per run."""
+    per = stage_ms(timers)
+    return "  ".join("{}={}".format(k, per[k])
+                     for k in sorted(per, key=per.get, reverse=True))

@@ -398,6 +398,13 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         # the beat's very arrival (executor liveness). Always on: the
         # beat is one tiny JSON message per interval and the lease
         # table is what makes an unsupervised cluster debuggable too.
+        # Seed the metrics kv with an empty registry snapshot BEFORE
+        # the first beat: the driver's rollup then distinguishes "node
+        # up, feed idle" (empty snapshot) from "no observability plane"
+        # (None) even while the trainer process is still importing —
+        # the trainer's DataFeed overwrites it with real numbers.
+        from tensorflowonspark_tpu import tracing as tracing_mod
+        mgr.set("metrics", tracing_mod.MetricsRegistry().snapshot())
         _start_beat_thread(cluster_meta, mgr, executor_id)
 
         if background:
@@ -492,6 +499,13 @@ def _beat_payload(mgr, executor_id):
             "train_step": _kv("train_step"),
             "restored_step": _kv("restored_step"),
             "feed_transport": _kv("feed_transport"),
+            # compact MetricsRegistry snapshot the trainer's DataFeed
+            # publishes alongside feed_hb (tracing.py PR 5): the lease
+            # carries each executor's feed-stage breakdown to the
+            # driver, where cluster.metrics() merges the fleet's view
+            # and a failure's incident evidence quotes the stalled
+            # executor's stages
+            "metrics": _kv("metrics"),
             "trainer_alive": None if proc is None else proc.is_alive(),
             "trainer_exit": None if proc is None else proc.exitcode,
             "executor_id": executor_id, "pid": os.getpid()}

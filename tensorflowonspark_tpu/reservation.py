@@ -34,6 +34,8 @@ import struct
 import threading
 import time
 
+from tensorflowonspark_tpu import tracing
+
 logger = logging.getLogger(__name__)
 
 #: Default seconds to wait for all nodes to register (reference default 600).
@@ -147,6 +149,10 @@ class Server(object):
         self.reservations = Reservations(count)
         self._sock = None
         self._thread = None
+        self._stats_httpd = None
+        #: (host, port) of the driver-side stats HTTP endpoint
+        #: (/metrics + /stats), set by start(); None if it failed
+        self.stats_addr = None
         self.done = threading.Event()
         # supervision plane: heartbeat leases + consumed-partition acks
         # (read by supervisor.Supervisor, which runs in this process)
@@ -167,6 +173,24 @@ class Server(object):
         with self._sup_lock:
             return set(self._acked)
 
+    def metrics_snapshot(self):
+        """{executor_id: per-executor observability view} from the
+        latest BEAT payloads: the beat-piggybacked MetricsRegistry
+        snapshot (feed stages + counters), the train-step and
+        feed-progress gauges, node state, and lease age. The raw
+        material for ``cluster.metrics()`` (merged via
+        ``tracing.cluster_rollup``) and the driver-side ``/metrics``
+        exposition."""
+        out = {}
+        for eid, lease in self.lease_snapshot().items():
+            payload = lease["payload"]
+            out[eid] = {"metrics": payload.get("metrics"),
+                        "train_step": payload.get("train_step"),
+                        "feed_hb": payload.get("feed_hb"),
+                        "state": payload.get("state"),
+                        "age": round(lease["age"], 3)}
+        return out
+
     def start(self, host=None):
         """Bind and serve in the background; returns (host, port)."""
         if host is None:
@@ -183,8 +207,58 @@ class Server(object):
         self._thread = threading.Thread(target=self._serve, name="reservation-server",
                                         daemon=True)
         self._thread.start()
-        logger.info("reservation server listening at %s", self.addr)
+        self._start_stats_http()
+        logger.info("reservation server listening at %s (stats http %s)",
+                    self.addr, self.stats_addr)
         return self.addr
+
+    def _start_stats_http(self):
+        """Tiny driver-side observability endpoint next to the TCP
+        rendezvous port: ``GET /metrics`` renders the cluster's
+        beat-piggybacked metrics in OpenMetrics text (per-executor
+        ``executor``-labeled series — scrape the driver and the whole
+        fleet is visible), ``GET /stats`` the same view as JSON.
+        Best-effort: a bind failure logs and leaves ``stats_addr``
+        None rather than failing cluster formation."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/metrics":
+                    code, ctype = 200, tracing.OPENMETRICS_CONTENT_TYPE
+                    body = tracing.render_cluster(
+                        server.metrics_snapshot()).encode("utf-8")
+                elif self.path == "/stats":
+                    code, ctype = 200, "application/json"
+                    body = json.dumps(tracing.cluster_rollup(
+                        server.metrics_snapshot())).encode("utf-8")
+                else:
+                    code, ctype = 404, "application/json"
+                    body = json.dumps(
+                        {"error": "not found: %s" % self.path}) \
+                        .encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # quiet by default
+                logger.debug("stats http: " + fmt, *args)
+
+        try:
+            self._stats_httpd = ThreadingHTTPServer(("", 0), Handler)
+            self.stats_addr = (self.addr[0],
+                               self._stats_httpd.server_address[1])
+            threading.Thread(target=self._stats_httpd.serve_forever,
+                             name="reservation-stats-http",
+                             daemon=True).start()
+        except OSError as e:
+            logger.warning("driver stats endpoint failed to start: %s", e)
+            self._stats_httpd = None
+            self.stats_addr = None
 
     def _serve(self):
         while not self.done.is_set():
@@ -264,6 +338,10 @@ class Server(object):
     def stop(self):
         self.done.set()
         self._close_listener()
+        if self._stats_httpd is not None:
+            self._stats_httpd.shutdown()
+            self._stats_httpd.server_close()
+            self._stats_httpd = None
         if self._thread is not None:
             self._thread.join(timeout=5)
 

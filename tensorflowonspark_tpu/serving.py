@@ -11,6 +11,12 @@ shops call from Scala (plain HTTP + JSON, no Python on the client):
     POST /v1/models/<name>:predict    -> {"instances": [...]} row format
                                          or {"inputs": {...}} columnar
 
+plus the operational surface (docs/observability.md): GET /healthz
+(liveness + gauges), GET /metrics (OpenMetrics exposition of the
+engine's MetricsRegistry — latency histograms, counters, stage
+timers), and GET /debug/trace (per-request span timeline as
+Perfetto-loadable Chrome trace JSON).
+
 Backed by the framework's export format (export.py): the exported
 ``apply_fn`` + variables serve every request; one process owns the
 accelerator and requests serialize through it (the TPU single-owner
@@ -52,8 +58,13 @@ import time
 import numpy as np
 
 from tensorflowonspark_tpu import chaos
+from tensorflowonspark_tpu import tracing
 
 logger = logging.getLogger(__name__)
+
+#: content type a /metrics response declares (OpenMetrics exposition;
+#: one shared contract with the driver-side stats endpoint)
+OPENMETRICS_CONTENT_TYPE = tracing.OPENMETRICS_CONTENT_TYPE
 
 _STREAM_DONE = object()
 
@@ -132,11 +143,17 @@ class GenerationHandle(object):
         self.deadline = deadline  # absolute monotonic, or None
         self.submitted = time.monotonic()
         self.completed = None
+        #: request trace id: every span this request's lifecycle emits
+        #: into the FlightRecorder lands on this timeline row
+        self.trace = tracing.next_trace_id()
         self._tokens = []
         self._q = queue_mod.Queue()
         self._done = threading.Event()
         self._error = None
         self._cancel_requested = False
+        # observability cursors (scheduler thread writes)
+        self._last_emit_at = None   # monotonic of the last emitted token
+        self._decode_t0 = None      # monotonic of prefill completion
 
     # -- scheduler side --------------------------------------------------
 
@@ -299,10 +316,10 @@ class DecodeEngine(object):
     def __init__(self, model, params, slots=8, total_len=None,
                  buckets=None, temperature=0.0, top_k=None, top_p=None,
                  eos_token=None, rng=None, counters=None, timers=None,
-                 max_queue=1024):
+                 max_queue=1024, metrics=None, flight=None):
         import jax
 
-        from tensorflowonspark_tpu import generation, tracing
+        from tensorflowonspark_tpu import generation
 
         # construction config, verbatim, so respawn() can rebuild an
         # identical engine after a scheduler death (supervisor.py's
@@ -339,6 +356,34 @@ class DecodeEngine(object):
         self.counters = counters if counters is not None \
             else tracing.Counters()
         self.timers = timers if timers is not None else tracing.StageTimers()
+        #: the engine's observability plane (PR 5): one MetricsRegistry
+        #: carrying its counters, stage timers, and latency histograms
+        #: — ModelServer's GET /metrics renders it, bench.py and
+        #: scripts/profile_serving.py read p50/p95/p99 from it.
+        #: Registration is idempotent, so a respawned engine re-adds
+        #: the same shared objects under the same family names.
+        self.metrics = metrics if metrics is not None \
+            else tracing.MetricsRegistry()
+        self.metrics.add_counters("tfos_serving", self.counters)
+        self.metrics.add_timers("tfos_serving_stage", self.timers)
+        self._hist_ttft = self.metrics.histogram(
+            "tfos_serving_ttft_seconds")
+        self._hist_token = self.metrics.histogram(
+            "tfos_serving_token_latency_seconds")
+        self._hist_step = self.metrics.histogram(
+            "tfos_serving_decode_step_seconds")
+        self._hist_qwait = self.metrics.histogram(
+            "tfos_serving_queue_wait_seconds")
+        self._hist_request = self.metrics.histogram(
+            "tfos_serving_request_seconds")
+        self._hist_drain = self.metrics.histogram(
+            "tfos_serving_drain_seconds")
+        #: request trace timeline (PR 5): span events for every request
+        #: (admit -> queue -> prefill -> decode -> finish/evict/shed)
+        #: land in this bounded ring; GET /debug/trace and
+        #: scripts/trace_dump.py render it as Chrome trace JSON
+        self.flight = flight if flight is not None \
+            else tracing.flight_recorder()
         self._temperature = float(temperature)
         self._prefill_fn, self._decode_fn = generation.slot_step_fns(
             model, self._temperature,
@@ -504,6 +549,11 @@ class DecodeEngine(object):
                     need = est["queue_wait_s"] + est["service_s"]
                     if need > deadline_s:
                         self.counters.inc("shed", len(vetted))
+                        self.flight.instant(
+                            "shed", requests=len(vetted),
+                            deadline_s=deadline_s,
+                            queue_wait_s=round(est["queue_wait_s"], 3),
+                            service_s=round(est["service_s"], 3))
                         raise Shed(
                             "deadline {:.2f}s infeasible: estimated "
                             "queue wait {:.2f}s + service {:.2f}s"
@@ -518,8 +568,14 @@ class DecodeEngine(object):
             for prompt, max_new in vetted:
                 handle = GenerationHandle(prompt, max_new,
                                           deadline=deadline)
+                self.flight.instant("admit", trace=handle.trace,
+                                    prompt_len=len(prompt),
+                                    max_new=max_new,
+                                    deadline_s=deadline_s)
                 if max_new == 0:
                     handle._finish()
+                    self._trace_finish(handle, "finish",
+                                       record_latency=False)
                 else:
                     self._queue.append(handle)
                 handles.append(handle)
@@ -569,6 +625,7 @@ class DecodeEngine(object):
         those requests were FAILED, not finished (the emptied queue is
         a loss ledger, not a clean one).
         """
+        t_drain0 = time.monotonic()
         with self._cv:
             if self._stopping:
                 return self.outstanding() == 0 \
@@ -595,6 +652,8 @@ class DecodeEngine(object):
                 break
             time.sleep(0.02)
         self.stop()
+        self._hist_drain.observe(time.monotonic() - t_drain0)
+        self.flight.instant("drain", outstanding=left)
         # a loop death mid-drain fails-and-clears outstanding work, so
         # left==0 alone would misreport lost requests as a clean drain
         return left == 0 and self._failed_requests == failed_before
@@ -602,11 +661,14 @@ class DecodeEngine(object):
     def respawn(self):
         """A fresh engine built from this engine's construction config
         (original model/params/slots/sampling/queue bound), SHARING its
-        counters and timers so lifecycle counts — ``engine_restarts``,
-        tokens, shed/cancel tallies — continue across the restart. The
-        supervisor's RestartEngine policy rebuilds through this after a
-        scheduler death; call :meth:`stop` on the dead engine first."""
+        counters, timers, metrics registry, and flight recorder so
+        lifecycle counts — ``engine_restarts``, tokens, shed/cancel
+        tallies — and latency histograms continue across the restart
+        (one /metrics series, not a reset). The supervisor's
+        RestartEngine policy rebuilds through this after a scheduler
+        death; call :meth:`stop` on the dead engine first."""
         return DecodeEngine(counters=self.counters, timers=self.timers,
+                            metrics=self.metrics, flight=self.flight,
                             **self._spawn_args)
 
     def compile_stats(self):
@@ -670,11 +732,40 @@ class DecodeEngine(object):
             else self._ewma_alpha * sample \
             + (1.0 - self._ewma_alpha) * prev
 
+    def _trace_finish(self, handle, outcome, error=None,
+                      record_latency=True):
+        """Close a request's span tree in the flight recorder: the
+        decode span (prefill end -> last activity) when it decoded at
+        all, the outer request span (admit -> done), and a terminal
+        instant named for the outcome. The request-latency histogram
+        observes NORMAL engine-served completions only — evictions
+        would poison the p99 the bench publishes with client-chosen
+        deadlines, and ``record_latency=False`` keeps inline max_new=0
+        finishes out too: they do no engine work (zero-latency samples
+        would skew the distribution) AND they complete on the CALLER's
+        thread, where an observe would break the histogram's
+        single-writer-scheduler contract. The flight recorder is
+        internally locked, so their spans still record."""
+        now = handle.completed if handle.completed is not None \
+            else time.monotonic()
+        if handle._decode_t0 is not None:
+            self.flight.span("decode", handle._decode_t0, now,
+                             trace=handle.trace,
+                             tokens=len(handle._tokens))
+        self.flight.span("request", handle.submitted, now,
+                         trace=handle.trace, outcome=outcome,
+                         tokens=len(handle._tokens),
+                         error=None if error is None else str(error))
+        self.flight.instant(outcome, trace=handle.trace)
+        if outcome == "finish" and record_latency:
+            self._hist_request.observe(now - handle.submitted)
+
     def _evict(self, handle, err):
         handle._finish(err)
         self.counters.inc("deadline_exceeded"
                           if isinstance(err, DeadlineExceeded)
                           else "cancelled")
+        self._trace_finish(handle, "evict", error=err)
         logger.info("evicted request after %d/%d tokens: %s",
                     len(handle._tokens), handle.max_new_tokens, err)
 
@@ -753,8 +844,13 @@ class DecodeEngine(object):
                         self.params, self._cache, jnp.asarray(self._last),
                         jnp.asarray(self._idx), self._next_key())
                     toks = np.asarray(toks)  # the per-step host sync
-                self._step_ewma = self._ewma(self._step_ewma,
-                                             time.monotonic() - t0)
+                t1 = time.monotonic()
+                self._step_ewma = self._ewma(self._step_ewma, t1 - t0)
+                self._hist_step.observe(t1 - t0)
+                # engine-row span (tid 0): the step every request's
+                # tokens in this round came from
+                self.flight.span("decode_step", t0, t1,
+                                 active=len(active), step=steps)
                 steps += 1
                 self.counters.inc("decode_steps")
                 with self.timers.timed("host_schedule"):
@@ -792,6 +888,8 @@ class DecodeEngine(object):
         self._queue.clear()
         for handle in failed:
             handle._finish(err)
+            self.flight.instant("failed", trace=handle.trace,
+                                error=str(err))
         # the loss ledger drain()'s verdict reads: these requests were
         # ADMITTED and did not finish — an emptied queue must not be
         # mistaken for "nothing was lost"
@@ -814,13 +912,19 @@ class DecodeEngine(object):
         # the loop's failure path finds the handle in _slot_req instead
         # of stranding its client on a timeout)
         t0 = time.monotonic()
+        self._hist_qwait.observe(t0 - handle.submitted)
+        self.flight.span("queue", handle.submitted, t0,
+                         trace=handle.trace, slot=slot)
         with self.timers.timed("prefill"):
             self._cache, first = self._prefill_fn(
                 self.params, self._cache, jnp.int32(slot),
                 jnp.asarray(toks), jnp.int32(n), self._next_key())
             first = int(first)
-        self._prefill_ewma = self._ewma(self._prefill_ewma,
-                                        time.monotonic() - t0)
+        t1 = time.monotonic()
+        self._prefill_ewma = self._ewma(self._prefill_ewma, t1 - t0)
+        self.flight.span("prefill", t0, t1, trace=handle.trace,
+                         bucket=bucket, prompt_len=n)
+        handle._decode_t0 = t1
         self.counters.inc("prefills")
         self._idx[slot] = n
         self._last[slot] = first
@@ -835,6 +939,12 @@ class DecodeEngine(object):
         are already in the cache)."""
         handle = self._slot_req[slot]
         handle._emit(token)
+        now = time.monotonic()
+        if handle._last_emit_at is None:
+            self._hist_ttft.observe(now - handle.submitted)
+        else:
+            self._hist_token.observe(now - handle._last_emit_at)
+        handle._last_emit_at = now
         self._last[slot] = token
         done = (self.eos_token is not None and token == self.eos_token) \
             or len(handle._tokens) >= handle.max_new_tokens
@@ -842,6 +952,7 @@ class DecodeEngine(object):
             handle._finish()
             self._slot_req[slot] = None
             self.counters.inc("requests_completed")
+            self._trace_finish(handle, "finish")
         elif chaos.on_token(len(handle._tokens)):
             # chaos disconnect_client_at_token: the client vanished
             # mid-stream; eviction happens at the next step boundary,
@@ -1355,6 +1466,32 @@ class ModelServer(object):
             "version": "1", "state": "AVAILABLE",
             "status": {"error_code": "OK", "error_message": ""}}]}
 
+    # -- observability (GET /metrics, GET /debug/trace) --------------------
+
+    def metrics_text(self):
+        """OpenMetrics exposition of the mounted engine's registry —
+        the body ``GET /metrics`` serves (scrapeable by Prometheus; see
+        docs/observability.md for the metric catalog). An engine-less
+        predict server exposes an empty-but-valid document, so a scrape
+        job can target every replica uniformly."""
+        engine = self.engine
+        registry = getattr(engine, "metrics", None)
+        if registry is None:
+            return tracing.MetricsRegistry().render()
+        return registry.render()
+
+    def debug_trace(self):
+        """Chrome trace-event JSON of the request trace timeline — the
+        body ``GET /debug/trace`` serves (loads directly in Perfetto /
+        chrome://tracing; scripts/trace_dump.py is the file-writing
+        CLI). Uses the mounted engine's FlightRecorder, falling back to
+        the process-global one so supervision instants are dumpable
+        even without an engine."""
+        flight = getattr(self.engine, "flight", None)
+        if flight is None:
+            flight = tracing.flight_recorder()
+        return flight.chrome_trace()
+
     # -- graceful drain ----------------------------------------------------
 
     def drain(self, timeout=None):
@@ -1458,6 +1595,14 @@ class ModelServer(object):
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_text(self, code, text, content_type):
+                body = text.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def _client_gone(self):
                 """True once the client closed its connection: the
                 request socket is readable with EOF (nothing more was
@@ -1478,6 +1623,11 @@ class ModelServer(object):
             def do_GET(self):
                 if self.path == "/healthz":
                     return self._send(*server.healthz())
+                if self.path == "/metrics":
+                    return self._send_text(200, server.metrics_text(),
+                                           OPENMETRICS_CONTENT_TYPE)
+                if self.path == "/debug/trace":
+                    return self._send(200, server.debug_trace())
                 base = "/v1/models/%s" % server.name
                 if self.path == base:
                     return self._send(200, server.status())

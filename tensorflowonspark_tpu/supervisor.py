@@ -79,7 +79,17 @@ KINDS = ("trainer_crash", "feeder_stall", "ring_wedge", "executor_lost")
 
 
 class FailureEvent(object):
-    """One classified failure: what died, where, and the evidence."""
+    """One classified failure: what died, where, and the evidence.
+
+    ``payload`` is the classifying heartbeat lease's payload (plus
+    whatever the reporter attached); :meth:`as_dict` surfaces the two
+    observability exhibits every incident should travel with —
+    the failing executor's beat-carried metrics snapshot (its
+    feed-stage breakdown at the moment of classification: a
+    ``feeder_stall`` arrives with the stalled executor's stages
+    attached) and the flight recorder's recent tail (the black-box
+    timeline of what the process was doing; see
+    ``tracing.FlightRecorder``)."""
 
     __slots__ = ("kind", "executor_id", "detail", "payload", "t", "wall")
 
@@ -93,7 +103,9 @@ class FailureEvent(object):
 
     def as_dict(self):
         return {"kind": self.kind, "executor_id": self.executor_id,
-                "detail": self.detail, "wall": self.wall}
+                "detail": self.detail, "wall": self.wall,
+                "evidence": {"metrics": self.payload.get("metrics"),
+                             "flight": self.payload.get("flight")}}
 
     def __str__(self):
         where = "" if self.executor_id is None \
@@ -416,6 +428,14 @@ class Supervisor(object):
         self.events.record("failure_detected", attempt=self.attempt,
                            kind=event.kind, executor=event.executor_id,
                            detail=event.detail)
+        # black-box postmortem (PR 5): every classified failure carries
+        # the flight recorder's recent tail — for a chaos run that is
+        # the last thing each plane did before the incident, dumped
+        # automatically instead of reconstructed from logs. Taken AFTER
+        # the failure_detected record above, so the incident's own
+        # classification instant is part of its dump.
+        if "flight" not in event.payload:
+            event.payload["flight"] = tracing.flight_recorder().tail(64)
         logger.error("supervisor detected failure: %s", event)
         self._failure_evt.set()
 
@@ -487,7 +507,13 @@ class Supervisor(object):
                 ("stopped" if health.get("stopping")
                  else "scheduler thread exited"))
             self.events.record("engine_dead", reason=reason)
-            self._report(FailureEvent("engine_dead", None, reason))
+            # evidence: the ENGINE's flight recorder tail — the spans
+            # of the very requests in flight when the scheduler died
+            flight = getattr(entry["engine"], "flight", None)
+            self._report(FailureEvent(
+                "engine_dead", None, reason,
+                payload=None if flight is None
+                else {"flight": flight.tail(64)}))
             if entry["restart"] is not None \
                     and not health.get("stopping") \
                     and not health.get("draining") \
@@ -744,6 +770,7 @@ class SupervisedCluster(object):
         self.attempts = []          # one dict per FAILED attempt
         self.formations = 0
         self._acked = set()
+        self._last_metrics = None   # rollup harvested before teardown
         self._tfc = None
         self._supervisor = None
         self._done = False
@@ -758,6 +785,25 @@ class SupervisedCluster(object):
 
     def tensorboard_url(self):
         return self._tfc.tensorboard_url() if self._tfc is not None else None
+
+    def metrics(self):
+        """Cluster-wide observability rollup (``TFCluster.metrics``
+        shape): per-executor beat-carried feed-stage + step-rate series
+        plus the merged cluster view. Live while an attempt is running;
+        after shutdown (or between attempts) the view harvested from
+        the last live cluster is returned, so a completed supervised
+        job can still report what its executors measured. Safe against
+        a concurrent teardown (the recovery loop nulls ``_tfc``): a
+        harvest that loses that race just returns the previous view."""
+        self._harvest_metrics()
+        return self._last_metrics
+
+    def metrics_url(self):
+        """The live attempt's driver-side OpenMetrics URL
+        (``TFCluster.metrics_url``), or None between attempts / after
+        shutdown (each reformation binds a fresh stats port)."""
+        tfc = self._tfc
+        return tfc.metrics_url() if tfc is not None else None
 
     def train(self, dataRDD, num_epochs=0, feed_timeout=600, qname="input"):
         """Supervised feed: like ``TFCluster.train`` but partitions are
@@ -917,9 +963,24 @@ class SupervisedCluster(object):
         while not result.done() and time.monotonic() < deadline:
             time.sleep(0.1)
 
+    def _harvest_metrics(self):
+        """Snapshot the live cluster's metrics rollup before a teardown
+        discards it — a completed (or failed) supervised job must still
+        be able to report what its executors measured. Reads ``_tfc``
+        ONCE (a concurrent teardown may null it between check and use)
+        and treats any failure as best-effort."""
+        tfc = self._tfc
+        if tfc is None:
+            return
+        try:
+            self._last_metrics = tfc.metrics()
+        except Exception:  # noqa: BLE001 - observability is best-effort
+            logger.debug("metrics harvest failed", exc_info=True)
+
     def _final_shutdown(self, grace_secs=0):
         """Shut the live cluster down cleanly; None on success, else the
         failure it surfaced (monitor-attributed when possible)."""
+        self._harvest_metrics()
         tfc, sup = self._tfc, self._supervisor
         try:
             tfc.shutdown(grace_secs=grace_secs,
@@ -950,6 +1011,7 @@ class SupervisedCluster(object):
     def _teardown_attempt(self, attempt_no, failure):
         self.events.record("attempt_teardown", attempt=attempt_no,
                            kind=failure.kind)
+        self._harvest_metrics()
         self._stop_monitor()
         tfc, self._tfc = self._tfc, None
         if tfc is None:

@@ -30,10 +30,42 @@ code; its own plumbing is unobservable. Here the framework exposes:
   (supervisor.py): failure detected, attempt torn down, cluster
   reformed, checkpoint restored, first post-restore step. The MTTR
   numbers ``bench.py recovery`` and scripts/profile_recovery.py publish
-  are spans over one of these logs.
+  are spans over one of these logs. Bounded: a ring of ``capacity``
+  events (default 4096) plus a ``dropped`` counter, so a long
+  supervised run cannot grow it without limit.
+
+The unified observability plane (PR 5) lives here too:
+
+- :class:`Histogram` — fixed log-bucket latency distribution with
+  ``quantile(q)``: the serving engine records TTFT / per-token /
+  decode-step / queue-wait / request / drain times into these, and
+  bench.py + the profile scripts read p50/p95/p99 from them instead of
+  keeping private sample lists.
+- :class:`MetricsRegistry` — one named home for Counters, StageTimers,
+  and Histograms, with :meth:`MetricsRegistry.render` producing
+  OpenMetrics text (``GET /metrics`` on ModelServer and the
+  reservation server's driver-side stats endpoint) and
+  :meth:`MetricsRegistry.snapshot` producing the compact JSON-able
+  form that piggybacks on BEAT heartbeat leases for cluster-wide
+  aggregation (:func:`merge_snapshots`, ``cluster.metrics()``).
+- :data:`METRIC_FAMILIES` — the canonical catalog of every exported
+  metric family. scripts/metrics_lint.py asserts this table and
+  docs/observability.md's catalog agree, and
+  tests/test_observability.py asserts a live scrape renders only
+  cataloged families — name drift is caught at both ends.
+- :class:`FlightRecorder` — bounded ring of request-scoped span events
+  (admit -> queue -> prefill -> decode -> finish/evict/shed, one trace
+  id per serving request), dumpable as Chrome trace-event JSON that
+  loads in Perfetto (``GET /debug/trace``, scripts/trace_dump.py). The
+  process-global recorder (:func:`flight_recorder`) doubles as the
+  black box the Supervisor dumps into incident evidence.
 """
 
+import collections
+import itertools
 import logging
+import math
+import os
 import threading
 import time
 
@@ -106,6 +138,12 @@ class Counters(object):
         """Set instantaneous gauge ``name`` (e.g. queue depth)."""
         self._gauges[name] = value
 
+    def get(self, name):
+        """Current value of counter ``name`` (0 when absent) — so the
+        owning loop can branch on its own tallies without keeping a
+        parallel ledger."""
+        return self._counts.get(name, 0)
+
     def snapshot(self):
         """{"counts": {...}, "gauges": {...}} — stable copies."""
         return {"counts": dict(self._counts), "gauges": dict(self._gauges)}
@@ -119,17 +157,27 @@ class Counters(object):
 
 
 class EventLog(object):
-    """Append-only timestamped event record for supervision timelines.
+    """Bounded timestamped event record for supervision timelines.
 
     Each event carries both clocks: ``t`` (monotonic — span math) and
     ``wall`` (epoch — correlating with out-of-process evidence like a
     chaos fuse file's fire time). Thread-safe: the supervisor's monitor
     thread and the supervised-run driver loop both append.
+
+    ``capacity`` bounds the log to a ring of the most recent events
+    (default 4096 — a supervised run that beats forever must not grow
+    driver memory without limit); overflow evicts the OLDEST event and
+    increments :attr:`dropped`. Span extraction (``span``,
+    ``supervisor.recovery_stages``) therefore describes the retained
+    window — at the default capacity that is far more history than any
+    MTTR computation needs.
     """
 
-    def __init__(self):
-        self._events = []
+    def __init__(self, capacity=4096):
+        self._events = collections.deque(maxlen=int(capacity))
         self._lock = threading.Lock()
+        #: events evicted by the ring bound (monotonic counter)
+        self.dropped = 0
 
     def record(self, name, **detail):
         """Append one event; returns its dict (already stamped)."""
@@ -137,7 +185,13 @@ class EventLog(object):
         if detail:
             event.update(detail)
         with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
             self._events.append(event)
+        # mirror into the process-global flight recorder: supervision
+        # milestones land in the same black box serving spans do, so an
+        # incident dump reads as one interleaved timeline
+        flight_recorder().instant(name, **detail)
         logger.debug("event %s %s", name, detail)
         return event
 
@@ -171,6 +225,585 @@ class EventLog(object):
         return None
 
 
+#: content type every /metrics response declares (OpenMetrics
+#: exposition) — shared by ModelServer and the reservation server's
+#: driver-side stats endpoint so scrapers see ONE contract
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+#: canonical catalog of every exported OpenMetrics family:
+#: {family: (type, labels, meaning)}. The family name is what appears in
+#: the ``# TYPE`` line; counters expose ``<family>_total`` samples and
+#: histograms expose ``_bucket``/``_sum``/``_count``. scripts/
+#: metrics_lint.py asserts this table and docs/observability.md's
+#: catalog agree (``make metrics-lint``), and tests assert a live
+#: ``/metrics`` scrape renders ONLY cataloged families — so a metric
+#: added in code without a catalog row (or vice versa) fails loudly.
+METRIC_FAMILIES = {
+    # -- serving plane (DecodeEngine registry; ModelServer /metrics) --
+    "tfos_serving_ttft_seconds":
+        ("histogram", "", "submit -> first emitted token"),
+    "tfos_serving_token_latency_seconds":
+        ("histogram", "", "gap between consecutive emitted tokens"),
+    "tfos_serving_decode_step_seconds":
+        ("histogram", "", "one fixed-shape decode step, wall clock"),
+    "tfos_serving_queue_wait_seconds":
+        ("histogram", "", "submit -> prefill start (admission queue)"),
+    "tfos_serving_request_seconds":
+        ("histogram", "", "submit -> completion, whole request"),
+    "tfos_serving_drain_seconds":
+        ("histogram", "", "DecodeEngine.drain wall clock"),
+    "tfos_serving_tokens":
+        ("counter", "", "tokens emitted (prefill firsts included)"),
+    "tfos_serving_decode_tokens":
+        ("counter", "", "tokens emitted by decode steps only"),
+    "tfos_serving_decode_steps":
+        ("counter", "", "fixed-shape decode steps run"),
+    "tfos_serving_prefills":
+        ("counter", "", "prompt prefills (one per admission)"),
+    "tfos_serving_requests_completed":
+        ("counter", "", "requests finished normally (EOS/length)"),
+    "tfos_serving_shed":
+        ("counter", "", "requests refused at admission (infeasible "
+                        "deadline)"),
+    "tfos_serving_cancelled":
+        ("counter", "", "requests evicted by cancel/disconnect"),
+    "tfos_serving_deadline_exceeded":
+        ("counter", "", "requests evicted past their deadline"),
+    "tfos_serving_engine_restarts":
+        ("counter", "", "RestartEngine rebuilds of a dead scheduler"),
+    "tfos_serving_queue_depth":
+        ("gauge", "", "requests waiting for a slot"),
+    "tfos_serving_slot_occupancy":
+        ("gauge", "", "slots holding an in-flight sequence"),
+    "tfos_serving_stage_seconds":
+        ("counter", "stage", "scheduler wall seconds per stage "
+                             "(prefill / decode_step / host_schedule)"),
+    "tfos_serving_stage_samples":
+        ("counter", "stage", "samples behind tfos_serving_stage_seconds"),
+    # -- feed plane (DataFeed registry; BEAT-piggybacked to the driver) --
+    "tfos_feed_stage_seconds":
+        ("counter", "stage", "host-side feed wall seconds per stage "
+                             "(ring_wait / queue_wait / decode / gather "
+                             "/ device_put)"),
+    "tfos_feed_stage_samples":
+        ("counter", "stage", "samples behind tfos_feed_stage_seconds"),
+    "tfos_feed_records":
+        ("counter", "", "records consumed off the feed transport"),
+    "tfos_feed_chunks":
+        ("counter", "", "chunks consumed off the feed transport"),
+    "tfos_feed_batches":
+        ("counter", "", "non-empty batches served to the trainer"),
+    "tfos_feed_staging_alloc":
+        ("counter", "", "staging-buffer allocations (gather path)"),
+    "tfos_feed_staging_reuse":
+        ("counter", "", "staging-buffer reuses (gather path)"),
+    # -- cluster rollup (reservation server's driver-side /metrics) --
+    "tfos_cluster_executors":
+        ("gauge", "", "executors with a live heartbeat lease"),
+    "tfos_cluster_train_step":
+        ("gauge", "executor", "last training step each executor beat"),
+    "tfos_cluster_feed_hb_batches":
+        ("gauge", "executor", "DataFeed batches-served progress counter"),
+    "tfos_cluster_lease_age_seconds":
+        ("gauge", "executor", "seconds since each executor's last beat"),
+}
+
+
+class Histogram(object):
+    """Fixed log-bucket latency histogram with ``quantile(q)``.
+
+    Buckets are geometric: bounds ``lo * growth**i`` for ``i`` in
+    ``range(n)`` plus a +Inf overflow, so relative quantile error is
+    bounded by ``growth`` (the bucket resolution) across the whole
+    range — the property that lets one fixed layout serve microsecond
+    decode steps and minute-long drains alike. Defaults: 100us .. ~1h
+    at sqrt(2) growth = 52 buckets of int, a few hundred bytes.
+
+    Single-writer convention like :class:`Counters`: the owning
+    scheduler thread observes; readers take snapshots / quantiles, and
+    the unlocked int adds are benign under the GIL. Observations
+    outside the range clamp into the edge buckets; exact ``min``/
+    ``max`` are tracked so clamped tails still report honestly.
+    """
+
+    __slots__ = ("lo", "growth", "_bounds", "_counts", "_sum", "_n",
+                 "_min", "_max")
+
+    def __init__(self, lo=1e-4, hi=3600.0, growth=math.sqrt(2.0)):
+        self.lo = float(lo)
+        self.growth = float(growth)
+        n = int(math.ceil(math.log(float(hi) / self.lo)
+                          / math.log(self.growth))) + 1
+        self._bounds = [self.lo * self.growth ** i for i in range(n)]
+        self._counts = [0] * (n + 1)  # +1: the +Inf overflow bucket
+        self._sum = 0.0
+        self._n = 0
+        self._min = None
+        self._max = None
+
+    def observe(self, value):
+        """Record one sample (seconds)."""
+        value = float(value)
+        self._sum += value
+        self._n += 1
+        if self._min is None or value < self._min:
+            self._min = value
+        if self._max is None or value > self._max:
+            self._max = value
+        if value <= self._bounds[0]:
+            self._counts[0] += 1
+            return
+        if value > self._bounds[-1]:
+            self._counts[-1] += 1
+            return
+        # log-position, then the forward scan only to absorb float edge
+        # error: O(1) in practice
+        i = int(math.log(value / self.lo) / math.log(self.growth))
+        i = max(0, min(i, len(self._bounds) - 1))
+        while self._bounds[i] < value:
+            i += 1
+        self._counts[i] += 1
+
+    @property
+    def count(self):
+        return self._n
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def quantile(self, q):
+        """Approximate q-quantile (seconds); None when empty. Error is
+        bounded by one bucket (a factor of ``growth``): the returned
+        value log-interpolates within the quantile's bucket and clamps
+        to the observed min/max, so degenerate single-value
+        distributions come back exact."""
+        if not self._n:
+            return None
+        q = float(q)
+        if q <= 0.0:
+            return self._min
+        if q >= 1.0:
+            return self._max
+        rank = max(1, int(math.ceil(q * self._n)))
+        cum = 0
+        for i, c in enumerate(self._counts):
+            if not c:
+                continue
+            if cum + c >= rank:
+                if i == len(self._bounds):  # overflow bucket
+                    value = self._max
+                else:
+                    upper = self._bounds[i]
+                    lower = upper / self.growth
+                    frac = (rank - cum) / float(c)
+                    value = lower * self.growth ** frac
+                return min(max(value, self._min), self._max)
+            cum += c
+        return self._max
+
+    def snapshot(self):
+        """Compact JSON-able state (mergeable via
+        :func:`merge_snapshots` when the layouts match)."""
+        return {"lo": self.lo, "growth": self.growth,
+                "counts": list(self._counts),
+                "sum": self._sum, "n": self._n,
+                "min": self._min, "max": self._max}
+
+
+def _fmt(value):
+    """OpenMetrics sample value: ints verbatim, floats shortest-round."""
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, int):
+        return str(value)
+    return repr(float(value))
+
+
+def _labels(pairs):
+    if not pairs:
+        return ""
+    return "{" + ",".join('{}="{}"'.format(k, v) for k, v in pairs) + "}"
+
+
+class MetricsRegistry(object):
+    """Named home for one plane's Counters / StageTimers / Histograms.
+
+    Three jobs:
+
+    - :meth:`render` — OpenMetrics text exposition (``GET /metrics``):
+      every registered metric under a stable, cataloged family name
+      (see :data:`METRIC_FAMILIES`), terminated with ``# EOF``.
+    - :meth:`snapshot` — the compact JSON-able form executors piggyback
+      on BEAT heartbeat leases; :func:`merge_snapshots` folds many into
+      a cluster rollup.
+    - lookup — ``histogram(name)`` creates-or-returns, so bench.py and
+      the profile scripts read p50/p95/p99 from the same instances the
+      engine writes (no private sample lists).
+
+    Registration is idempotent by name (a respawned engine re-adds the
+    same shared objects).
+    """
+
+    def __init__(self):
+        self._counters = {}   # prefix -> Counters
+        self._timers = {}     # family stem -> StageTimers
+        self._hists = {}      # family -> Histogram
+
+    # -- registration / lookup -------------------------------------------
+
+    def add_counters(self, prefix, counters):
+        """Expose ``counters`` as ``<prefix>_<key>`` families: counts
+        render as ``<prefix>_<key>_total`` counters, gauges as plain
+        ``<prefix>_<key>`` gauges."""
+        self._counters[prefix] = counters
+        return counters
+
+    def add_timers(self, stem, timers):
+        """Expose ``timers`` as two stage-labeled counter families:
+        ``<stem>_seconds_total{stage=...}`` and
+        ``<stem>_samples_total{stage=...}``."""
+        self._timers[stem] = timers
+        return timers
+
+    def histogram(self, family, **kwargs):
+        """Create-or-return the histogram registered as ``family``."""
+        hist = self._hists.get(family)
+        if hist is None:
+            hist = self._hists[family] = Histogram(**kwargs)
+        return hist
+
+    def get_histogram(self, family):
+        return self._hists.get(family)
+
+    # -- exposition -------------------------------------------------------
+
+    def render(self, extra_labels=()):
+        """OpenMetrics text of everything registered (ends ``# EOF``).
+
+        ``extra_labels``: (key, value) pairs stamped on every sample —
+        how the driver's cluster endpoint renders per-executor series
+        from beat-carried snapshots under one family name."""
+        return render_snapshot(self.snapshot(),
+                               extra_labels=extra_labels)
+
+    def snapshot(self):
+        """Compact JSON-able state: {"counters": {prefix: ...},
+        "timers": {stem: {"t": ..., "n": ...}}, "hists": {family: ...}}.
+        Safe to ship over the JSON reservation wire (BEAT payloads)."""
+        return {
+            "counters": {p: c.snapshot()
+                         for p, c in self._counters.items()},
+            "timers": {s: {"t": t.snapshot(), "n": t.counts()}
+                       for s, t in self._timers.items()},
+            "hists": {f: h.snapshot() for f, h in self._hists.items()},
+        }
+
+
+def render_snapshot(snapshot, extra_labels=()):
+    """OpenMetrics text from a :meth:`MetricsRegistry.snapshot` dict.
+
+    Shared by live registries (``MetricsRegistry.render``) and the
+    driver-side cluster endpoint, which renders snapshots that crossed
+    the BEAT wire. Families render in sorted order; output ends with
+    the OpenMetrics ``# EOF`` terminator.
+    """
+    return _render([(tuple(extra_labels), snapshot)])
+
+
+def _render(labeled_snapshots):
+    """OpenMetrics text for many (labels, snapshot) pairs: each family
+    appears ONCE (the grammar's rule), carrying one labeled sample set
+    per snapshot — how N executors' beat-carried snapshots expose as N
+    ``executor``-labeled series under shared family names."""
+    lines = []
+
+    def _family(name, ftype):
+        meta = METRIC_FAMILIES.get(name)
+        lines.append("# TYPE {} {}".format(name, ftype))
+        if meta and meta[2]:
+            lines.append("# HELP {} {}".format(name, meta[2]))
+
+    def _union(section, *path):
+        keys = set()
+        for _, snapshot in labeled_snapshots:
+            node = snapshot.get(section) or {}
+            for p in path:
+                node = node.get(p, {}) if isinstance(node, dict) else {}
+            keys |= set(node)
+        return sorted(keys)
+
+    for prefix in _union("counters"):
+        for key in _union("counters", prefix, "counts"):
+            name = "{}_{}".format(prefix, key)
+            _family(name, "counter")
+            for extra, snapshot in labeled_snapshots:
+                counts = (snapshot.get("counters", {}).get(prefix) or
+                          {}).get("counts") or {}
+                if key in counts:
+                    lines.append("{}_total{} {}".format(
+                        name, _labels(extra), _fmt(counts[key])))
+        for key in _union("counters", prefix, "gauges"):
+            name = "{}_{}".format(prefix, key)
+            _family(name, "gauge")
+            for extra, snapshot in labeled_snapshots:
+                gauges = (snapshot.get("counters", {}).get(prefix) or
+                          {}).get("gauges") or {}
+                if key in gauges:
+                    lines.append("{}{} {}".format(
+                        name, _labels(extra), _fmt(gauges[key])))
+    for stem in _union("timers"):
+        for suffix, part in (("seconds", "t"), ("samples", "n")):
+            name = "{}_{}".format(stem, suffix)
+            _family(name, "counter")
+            for extra, snapshot in labeled_snapshots:
+                values = (snapshot.get("timers", {}).get(stem) or
+                          {}).get(part) or {}
+                for stage in sorted(values):
+                    lines.append("{}_total{} {}".format(
+                        name, _labels((("stage", stage),) + extra),
+                        _fmt(values[stage])))
+    for family in _union("hists"):
+        _family(family, "histogram")
+        for extra, snapshot in labeled_snapshots:
+            snap = (snapshot.get("hists") or {}).get(family)
+            if snap is None:
+                continue
+            bounds = [snap["lo"] * snap["growth"] ** i
+                      for i in range(len(snap["counts"]) - 1)]
+            cum = 0
+            for bound, count in zip(bounds, snap["counts"]):
+                cum += count
+                lines.append("{}_bucket{} {}".format(
+                    family,
+                    _labels((("le", "{:.6g}".format(bound)),) + extra),
+                    cum))
+            lines.append("{}_bucket{} {}".format(
+                family, _labels((("le", "+Inf"),) + extra),
+                cum + snap["counts"][-1]))
+            lines.append("{}_sum{} {}".format(
+                family, _labels(extra), _fmt(snap["sum"])))
+            lines.append("{}_count{} {}".format(
+                family, _labels(extra), _fmt(snap["n"])))
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def merge_snapshots(snapshots):
+    """Fold many :meth:`MetricsRegistry.snapshot` dicts into one rollup.
+
+    Counts, gauges, timer totals, and histogram buckets SUM (a gauge
+    sum is the cluster-wide total — queue depth across replicas, slots
+    occupied across engines); histogram layouts must match to merge
+    (mismatched layouts keep the first and log). The cluster view
+    ``cluster.metrics()`` returns is built from this.
+    """
+    out = {"counters": {}, "timers": {}, "hists": {}}
+    for snap in snapshots:
+        if not snap:
+            continue
+        for prefix, c in (snap.get("counters") or {}).items():
+            dst = out["counters"].setdefault(
+                prefix, {"counts": {}, "gauges": {}})
+            for k, v in (c.get("counts") or {}).items():
+                dst["counts"][k] = dst["counts"].get(k, 0) + v
+            for k, v in (c.get("gauges") or {}).items():
+                dst["gauges"][k] = dst["gauges"].get(k, 0) + v
+        for stem, t in (snap.get("timers") or {}).items():
+            dst = out["timers"].setdefault(stem, {"t": {}, "n": {}})
+            for k, v in (t.get("t") or {}).items():
+                dst["t"][k] = dst["t"].get(k, 0.0) + v
+            for k, v in (t.get("n") or {}).items():
+                dst["n"][k] = dst["n"].get(k, 0) + v
+        for family, h in (snap.get("hists") or {}).items():
+            dst = out["hists"].get(family)
+            if dst is None:
+                out["hists"][family] = {
+                    k: (list(v) if isinstance(v, list) else v)
+                    for k, v in h.items()}
+                continue
+            if (dst["lo"], dst["growth"], len(dst["counts"])) != \
+                    (h["lo"], h["growth"], len(h["counts"])):
+                logger.warning("histogram %s layouts differ; keeping "
+                               "the first snapshot's", family)
+                continue
+            dst["counts"] = [a + b for a, b in zip(dst["counts"],
+                                                   h["counts"])]
+            dst["sum"] += h["sum"]
+            dst["n"] += h["n"]
+            for k, pick in (("min", min), ("max", max)):
+                if h.get(k) is not None:
+                    dst[k] = h[k] if dst.get(k) is None \
+                        else pick(dst[k], h[k])
+    return out
+
+
+def cluster_rollup(per_executor):
+    """{eid: lease-ish view} -> the ``cluster.metrics()`` shape:
+    ``{"executors": per_executor, "cluster": {executors, train_step,
+    merged}}`` where ``merged`` sums every executor's beat-carried
+    registry snapshot (:func:`merge_snapshots`)."""
+    return {
+        "executors": per_executor,
+        "cluster": {
+            "executors": len(per_executor),
+            "train_step": {eid: view.get("train_step")
+                           for eid, view in per_executor.items()},
+            "merged": merge_snapshots(
+                [view.get("metrics") for view in per_executor.values()]),
+        },
+    }
+
+
+def render_cluster(per_executor):
+    """OpenMetrics text for the driver-side cluster endpoint: the
+    cluster gauges plus every executor's snapshot re-rendered under an
+    ``executor`` label (one family, N labeled series — the shape a
+    Prometheus scrape aggregates itself)."""
+    lines = ["# TYPE tfos_cluster_executors gauge",
+             "tfos_cluster_executors {}".format(len(per_executor))]
+    for name, key in (("tfos_cluster_train_step", "train_step"),
+                      ("tfos_cluster_feed_hb_batches", "feed_hb"),
+                      ("tfos_cluster_lease_age_seconds", "age")):
+        samples = [(eid, view.get(key))
+                   for eid, view in sorted(per_executor.items())
+                   if view.get(key) is not None]
+        if not samples:
+            continue
+        lines.append("# TYPE {} gauge".format(name))
+        for eid, value in samples:
+            lines.append("{}{} {}".format(
+                name, _labels((("executor", eid),)), _fmt(value)))
+    body = "\n".join(lines) + "\n"
+    labeled = [((("executor", eid),), view["metrics"])
+               for eid, view in sorted(per_executor.items())
+               if view.get("metrics")]
+    if labeled:
+        body += _render(labeled).replace("# EOF\n", "")
+    return body + "# EOF\n"
+
+
+#: process-wide monotonic trace-id source (serving request timelines)
+_TRACE_IDS = itertools.count(1)
+
+
+def next_trace_id():
+    """Fresh per-process trace id (int) for one request's span tree."""
+    return next(_TRACE_IDS)
+
+
+class FlightRecorder(object):
+    """Bounded ring of span events — the serving plane's black box.
+
+    Every serving request gets a trace id at admission; the engine
+    lands its span events (admit -> queue -> prefill -> decode ->
+    finish/evict/shed) here, and :meth:`chrome_trace` renders the ring
+    as Chrome trace-event JSON that loads directly in Perfetto /
+    chrome://tracing (``GET /debug/trace``, scripts/trace_dump.py).
+    Supervision milestones mirror in as instant events (EventLog), so
+    the tail a Supervisor dumps into incident evidence reads as one
+    interleaved timeline.
+
+    Ring semantics: ``capacity`` most recent events are kept (default
+    4096); overflow evicts oldest and counts into :attr:`dropped` —
+    recording is always O(1) and memory is bounded no matter how long
+    the process serves. Thread-safe appends (scheduler thread, HTTP
+    handlers, and the supervisor all write).
+    """
+
+    def __init__(self, capacity=4096):
+        self._events = collections.deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self.dropped = 0
+        #: trace epoch: ts fields are microseconds since this instant
+        self.epoch = time.monotonic()
+
+    def _append(self, event):
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self.dropped += 1
+            self._events.append(event)
+
+    def _ts(self, t):
+        return int((t - self.epoch) * 1e6)
+
+    @staticmethod
+    def _clean(args):
+        """Chrome-trace args must be JSON-able; coerce anything exotic
+        (an exception object in an evict arg, say) to str."""
+        out = {}
+        for k, v in args.items():
+            if isinstance(v, (str, int, float, bool, type(None))):
+                out[k] = v
+            elif isinstance(v, (list, tuple)):
+                out[k] = [x if isinstance(x, (str, int, float, bool,
+                                              type(None))) else str(x)
+                          for x in v]
+            else:
+                out[k] = str(v)
+        return out
+
+    def span(self, name, t0, t1, trace=0, **args):
+        """One complete ('X') span: [t0, t1] monotonic seconds, on the
+        row of request ``trace`` (tid). Returns the event dict."""
+        event = {"name": name, "ph": "X", "ts": self._ts(t0),
+                 "dur": max(self._ts(t1) - self._ts(t0), 0),
+                 "pid": os.getpid(), "tid": int(trace),
+                 "args": self._clean(args)}
+        self._append(event)
+        return event
+
+    def instant(self, name, trace=0, **args):
+        """One instant ('i') event at now, on ``trace``'s row."""
+        event = {"name": name, "ph": "i", "s": "t",
+                 "ts": self._ts(time.monotonic()),
+                 "pid": os.getpid(), "tid": int(trace),
+                 "args": self._clean(args)}
+        self._append(event)
+        return event
+
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    def tail(self, n=64):
+        """Most recent ``n`` events, oldest first — the incident dump
+        the Supervisor attaches to failure evidence."""
+        with self._lock:
+            events = list(self._events)
+        return events[-int(n):]
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+    def chrome_trace(self, events=None):
+        """{"traceEvents": [...]} — the Chrome trace-event JSON object
+        Perfetto loads. Adds thread_name metadata so each request's
+        trace id renders as a labeled row."""
+        events = self.events() if events is None else list(events)
+        pid = os.getpid()
+        meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                 "ts": 0, "args": {"name": "tfos"}}]
+        for tid in sorted({e["tid"] for e in events}):
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid, "ts": 0,
+                         "args": {"name": "engine" if tid == 0
+                                  else "request {}".format(tid)}})
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+_FLIGHT = FlightRecorder()
+
+
+def flight_recorder():
+    """The process-global :class:`FlightRecorder` — the default black
+    box every plane shares unless handed its own instance."""
+    return _FLIGHT
+
+
 class _StageSpan(object):
     __slots__ = ("_timers", "_stage", "_t0")
 
@@ -186,13 +819,32 @@ class _StageSpan(object):
         self._timers.add(self._stage, time.monotonic() - self._t0)
 
 
+#: port of this process's already-started jax profiler server, if any
+_PROFILER_PORT = None
+
+
 def start_profiler_server(port=9012):
-    """Start the jax profiler gRPC server on this host (idempotent-ish)."""
+    """Start the jax profiler gRPC server on this host (idempotent).
+
+    jax allows exactly one profiler server per process; a second
+    ``start_server`` raises. Rather than leaning on that error path,
+    the started port is remembered per-process and returned on
+    re-call — so framework layers and user code can both call this
+    without coordinating (the caller gets the LIVE port either way,
+    even if it asked for a different one). Returns None only when the
+    first start genuinely fails."""
+    global _PROFILER_PORT
+    if _PROFILER_PORT is not None:
+        if _PROFILER_PORT != port:
+            logger.info("profiler server already on port %d; ignoring "
+                        "request for %d", _PROFILER_PORT, port)
+        return _PROFILER_PORT
     import jax
 
     try:
         jax.profiler.start_server(port)
         logger.info("jax profiler server on port %d", port)
+        _PROFILER_PORT = port
         return port
     except Exception as e:  # noqa: BLE001 - profiling is best-effort
         logger.warning("profiler server failed to start: %s", e)

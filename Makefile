@@ -43,7 +43,10 @@ test-fast:
 # Fault-injection suite (PR 3: chaos.py + the supervision plane e2e;
 # PR 4 adds the serving leg — scheduler-kill auto-restart, decode
 # stall, injected client disconnect from test_serving_lifecycle.py —
-# collected by the same `chaos` marker).
+# and PR 6 the fleet leg from test_fleet.py: kill one replica of a
+# 3-replica fleet mid-stream, zero client-visible failures, supervised
+# restart + router readmit, MTTR recorded — all collected by the same
+# `chaos` marker).
 # These SIGKILL real trainer/executor processes and reform real
 # clusters, so they run SERIALLY — one pytest process per test, which
 # both isolates each kill's process tree and gives every test a hard

@@ -48,6 +48,20 @@ Prints ONE JSON line. Fields:
                          ``GET /metrics`` exposes — plus per-histogram
                          TTFT / per-token / decode-step / queue-wait
                          quantiles under ``engine.hist``.
+- ``serving_fleet``    — the fleet plane (PR 6): the SAME mixed-length
+                         workload pushed over HTTP through the
+                         least-loaded ``fleet.FleetRouter`` at 1 vs 2
+                         vs 4 DecodeEngine replicas — aggregate
+                         tokens/sec, router-observed p50/p99, failover
+                         count (0 on a clean run), and the routing
+                         overhead (request wall minus upstream wall,
+                         from the router's own histograms).
+                         ``scaling_2x``/``scaling_4x`` are the
+                         aggregate-throughput ratios vs 1 replica; on
+                         the 1-core CPU box the replicas share one
+                         core, so scaling there measures the router's
+                         overhead floor, not capacity (chip runs are
+                         the capacity claim).
 - ``recovery``         — the supervision plane (PR 3): MTTR of an
                          injected mid-job trainer SIGKILL under
                          ``cluster.run(..., supervise=...)``, with the
@@ -497,6 +511,108 @@ def _serving_decode_bench(on_tpu):
     return block
 
 
+def _fleet_leg(dec, params, reqs, n_replicas, slots=8, concurrency=None):
+    """Push ``reqs`` over HTTP through a FleetRouter fronting
+    ``n_replicas`` in-process DecodeEngines; returns (aggregate
+    tokens/sec, router-observed latency quantiles, stats). THE
+    fleet-measurement harness — scripts/profile_fleet.py imports it so
+    bench numbers and routing-overhead attributions describe the same
+    run shape. All percentiles and the overhead split are read from
+    the router's OWN MetricsRegistry histograms (the objects its
+    ``GET /metrics`` renders), same discipline as ``_engine_leg``."""
+    import concurrent.futures
+    import json as json_mod
+    import urllib.request
+
+    from tensorflowonspark_tpu import fleet, metrics_report
+
+    with fleet.ServingFleet(dec, params, replicas=n_replicas,
+                            engine_kw={"slots": slots}) as f:
+        url = f.url("/v1/models/model:generate")
+
+        def one(req):
+            prompt, max_new = req
+            body = json_mod.dumps({"prompt": prompt,
+                                   "max_new_tokens": max_new}).encode()
+            http_req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(http_req, timeout=1800) as r:
+                out = json_mod.loads(r.read())
+            return len(out["tokens"]) - len(prompt)
+
+        workers = concurrency or min(16, 4 * n_replicas)
+        t0 = time.monotonic()
+        with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+            tokens = sum(pool.map(one, reqs))
+        wall = time.monotonic() - t0
+        counts = f.router.counters.snapshot()["counts"]
+        registry = f.router.metrics
+        quantiles = metrics_report.quantiles_ms(
+            registry.get_histogram("tfos_fleet_request_seconds"))
+        stats = {
+            "replicas": n_replicas, "slots_per_replica": slots,
+            "concurrency": workers,
+            "tokens": int(tokens), "wall_s": round(wall, 3),
+            "failovers": counts.get("failovers", 0),
+            "no_replica": counts.get("no_replica", 0),
+            "upstream": metrics_report.quantiles_ms(
+                registry.get_histogram("tfos_fleet_upstream_seconds")),
+            "route_overhead": metrics_report.quantiles_ms(
+                registry.get_histogram(
+                    "tfos_fleet_route_overhead_seconds")),
+            "stage_ms": metrics_report.stage_ms(f.router.timers),
+        }
+        return tokens / wall, quantiles, stats
+
+
+def _serving_fleet_bench(on_tpu, replica_counts=(1, 2, 4)):
+    """Aggregate serving throughput at 1 vs 2 vs 4 router-fronted
+    replicas on the shared mixed-length workload. Returns the
+    ``serving_fleet`` JSON block.
+
+    Every leg runs WARM: the slot-step programs are shared per (model,
+    sampling-config) across all engines, so without a prewarm the
+    1-replica leg would pay every compile and the scaling ratios would
+    flatter the bigger fleets with someone else's compile time.
+    Cold-compile economics are ``serving_decode``'s story; this block's
+    claim is CAPACITY scaling."""
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu import serving
+
+    train, dec = _serving_model(on_tpu)
+    params = train.init(jax.random.PRNGKey(0),
+                        np.zeros((1, dec.max_len), np.int32))["params"]
+    reqs = _serving_workload(24, dec.max_len, dec.vocab, seed=1)
+    # prewarm: one throwaway engine touches the decode program and every
+    # prefill bucket the workload will hit (max_new=1 requests)
+    with serving.DecodeEngine(dec, params, slots=8) as warm_eng:
+        warm_lens = sorted({len(p) for p, _ in reqs})
+        for handle in [warm_eng.submit(list(range(1, n + 1)), 1)
+                       for n in warm_lens]:
+            handle.result(600)
+    legs = []
+    for n in replica_counts:
+        tps, quantiles, stats = _fleet_leg(dec, params, reqs, n)
+        legs.append(dict(tokens_per_sec=round(tps, 1), **quantiles,
+                         **stats))
+    by_replicas = {leg["replicas"]: leg["tokens_per_sec"]
+                   for leg in legs}
+    base = by_replicas.get(1)
+    block = {
+        "workload": {"requests": len(reqs),
+                     "total_tokens": sum(mn for _, mn in reqs)},
+        "legs": legs,
+    }
+    for n in replica_counts:
+        if n > 1 and base and by_replicas.get(n):
+            block["scaling_{}x".format(n)] = round(
+                by_replicas[n] / base, 2)
+    return block
+
+
 def _recovery_map_fun(args, ctx):
     """Supervision-aware trainer for the recovery bench: restore ->
     attach -> one checkpointed step per batch -> publish. The chaos
@@ -836,6 +952,19 @@ def main():
             print("serving_decode failed: {}".format(e), file=sys.stderr)
             serving_decode = {"error": str(e)}
 
+    # Fleet plane (PR 6): the same workload through the least-loaded
+    # router at 1 vs 2 vs 4 replicas — aggregate tokens/sec scaling +
+    # routing overhead. Shares the serving gate; TFOS_BENCH_FLEET=0
+    # skips just this leg.
+    serving_fleet = None
+    if os.environ.get("TFOS_BENCH_SERVING", "1") == "1" \
+            and os.environ.get("TFOS_BENCH_FLEET", "1") == "1":
+        try:
+            serving_fleet = _serving_fleet_bench(on_tpu)
+        except Exception as e:  # noqa: BLE001 - report, not die
+            print("serving_fleet failed: {}".format(e), file=sys.stderr)
+            serving_fleet = {"error": str(e)}
+
     metric_name = ("resnet50_cluster_fed_images_per_sec_per_chip"
                    if fed_enabled else
                    "resnet50_device_only_images_per_sec_per_chip") if on_tpu \
@@ -891,6 +1020,9 @@ def main():
         # continuous-batching decode engine vs run-to-completion window
         # batcher on mixed-length traffic (PR 2; BENCH_r06+ tracks this)
         "serving_decode": serving_decode,
+        # fleet plane (PR 6): aggregate tokens/sec + p99 through the
+        # least-loaded router at 1 vs 2 vs 4 replicas
+        "serving_fleet": serving_fleet,
         # supervision plane MTTR: injected trainer SIGKILL -> detect ->
         # reform -> restore -> first step (PR 3; docs/fault_tolerance.md)
         "recovery": recovery,

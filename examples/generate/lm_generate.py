@@ -42,6 +42,11 @@ def main(argv=None):
                     help="also serve N mixed-length prompts through the "
                          "continuous-batching DecodeEngine and report "
                          "tokens/sec + solo-parity")
+    ap.add_argument("--fleet", type=int, default=0, metavar="R",
+                    help="also serve the same prompts over HTTP through "
+                         "an R-replica serving fleet (fleet.ServingFleet "
+                         "router), with the shared serving.retry_call "
+                         "client retry policy, and report solo-parity")
     ap.add_argument("--out", default=None,
                     help="write {loss, prompt, generated} JSON here")
     args = ap.parse_args(argv)
@@ -137,13 +142,80 @@ def main(argv=None):
             raise SystemExit(
                 "continuous-batching outputs diverged from solo generate")
 
+    fleet_stats = None
+    if args.fleet:
+        import time
+        import urllib.error
+        import urllib.request
+
+        from tensorflowonspark_tpu import cluster, serving
+
+        rs = np.random.RandomState(2)
+        reqs = []
+        for _ in range(max(args.serve, 4)):
+            n = int(rs.randint(3, args.seq_len))
+            start = int(rs.randint(0, args.period))
+            reqs.append(([(start + i) % args.period for i in range(n)],
+                         int(rs.randint(2, args.seq_len))))
+        fl = cluster.serving_fleet(dec, params, replicas=args.fleet,
+                                   name="lm", engine_kw={"slots": 4})
+        try:
+            url = fl.url("/v1/models/lm:generate")
+
+            def post(payload):
+                # the SHARED client retry policy (serving.retry_call):
+                # transient 429/503s — a shedding or draining replica,
+                # an engine mid-restart — retry with bounded backoff +
+                # full jitter, honoring the router's Retry-After;
+                # anything else propagates
+                def attempt():
+                    req = urllib.request.Request(
+                        url, data=json.dumps(payload).encode(),
+                        headers={"Content-Type": "application/json"})
+                    try:
+                        with urllib.request.urlopen(req, timeout=300) as r:
+                            return json.loads(r.read())
+                    except urllib.error.HTTPError as e:
+                        retriable = serving.http_retriable(
+                            e.code, e.headers.get("Retry-After"))
+                        if retriable is not None:
+                            raise retriable
+                        raise
+                return serving.retry_call(attempt)
+
+            t0 = time.monotonic()
+            outs = [post({"prompt": p, "max_new_tokens": mn})["tokens"]
+                    for p, mn in reqs]
+            wall = time.monotonic() - t0
+            mismatches = 0
+            for (p, mn), got in zip(reqs, outs):
+                solo = generation.generate_jit(
+                    dec, params, jnp.asarray([p], jnp.int32), mn)
+                if got != np.asarray(solo)[0].tolist():
+                    mismatches += 1
+            tokens = sum(len(got) - len(p)
+                         for (p, _), got in zip(reqs, outs))
+            counts = fl.router.counters.snapshot()["counts"]
+            fleet_stats = {"replicas": args.fleet,
+                           "requests": len(reqs), "tokens": tokens,
+                           "tokens_per_sec": round(tokens / wall, 1),
+                           "failovers": counts.get("failovers", 0),
+                           "solo_mismatches": mismatches}
+            print("fleet    ", fleet_stats)
+        finally:
+            fl.stop()
+        if fleet_stats["solo_mismatches"]:
+            raise SystemExit(
+                "fleet-served outputs diverged from solo generate")
+
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
         with open(args.out, "w") as f:
             json.dump({"loss": None if loss is None else float(loss),
                        "prompt": np.asarray(prompt[0]).tolist(),
                        "generated": generated,
-                       "serve": serve_stats}, f)
+                       "serve": serve_stats,
+                       "fleet": fleet_stats}, f)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,104 @@
+"""Routing-overhead breakdown of the serving fleet (PR 6).
+
+What does putting a ``fleet.FleetRouter`` in front of the decode
+engines COST per request? Runs the shared mixed-length workload over
+HTTP through an in-process N-replica fleet and prints the attribution
+the router's own observability plane collects:
+
+- ``pick``     — dispatch-policy time (lease snapshot -> view build ->
+                 least-loaded ordering), per attempt
+- ``upstream`` — the proxied POST against the chosen replica (this is
+                 the request actually being served; everything else is
+                 routing overhead)
+
+plus the three router histograms (request wall / upstream wall / their
+difference = route overhead), failover tallies (zero on a clean run),
+and the per-replica dispatch spread. Everything is read through the
+shared ``metrics_report`` helpers from the SAME ``MetricsRegistry``
+the router's ``GET /metrics`` renders — published numbers and scraped
+series are two views of one histogram. The run harness itself is
+``bench._fleet_leg``, so the attribution describes exactly the run
+shape ``bench.py serving_fleet`` publishes.
+
+Usage (CPU, hermetic):
+
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python scripts/profile_fleet.py [--replicas 2] [--requests 16] \
+        [--slots 8] [--total-len 256] [--hidden 64] [--layers 2] [--json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--total-len", type=int, default=256)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--json", action="store_true",
+                    help="print one JSON blob instead of the table")
+    args = ap.parse_args(argv)
+    if args.total_len < 16:
+        ap.error("--total-len must be >= 16 (the mixed workload draws "
+                 "prompts from range(8, total_len//2 + 1, 8))")
+
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.models.decoder import DecoderLM
+
+    # bench.py's harness + workload — ONE fleet-measurement
+    # implementation, shared so this attribution describes the benched
+    # run shape (same discipline as scripts/profile_serving.py)
+    from bench import _fleet_leg, _serving_workload
+
+    train = DecoderLM(vocab=args.vocab, hidden=args.hidden, num_heads=4,
+                      num_layers=args.layers, max_len=args.total_len,
+                      decode=False)
+    dec = DecoderLM(vocab=args.vocab, hidden=args.hidden, num_heads=4,
+                    num_layers=args.layers, max_len=args.total_len,
+                    decode=True)
+    params = train.init(jax.random.PRNGKey(0),
+                        np.zeros((1, args.total_len), np.int32))["params"]
+    reqs = _serving_workload(args.requests, args.total_len, args.vocab,
+                             seed=args.seed)
+
+    tps, quantiles, stats = _fleet_leg(dec, params, reqs, args.replicas,
+                                       slots=args.slots)
+    out = {"config": {"replicas": args.replicas,
+                      "requests": args.requests, "slots": args.slots,
+                      "total_len": args.total_len,
+                      "total_new_tokens": sum(mn for _, mn in reqs)},
+           "tokens_per_sec": round(tps, 1),
+           "request": quantiles, **stats}
+
+    if args.json:
+        print(json.dumps(out))
+        return
+    print("config: {}".format(out["config"]))
+    print("\n{} tokens in {}s through {} replica(s) -> {} tok/s"
+          .format(out["tokens"], out["wall_s"], args.replicas,
+                  out["tokens_per_sec"]))
+    print("  request (router-observed, ms):   {}".format(quantiles))
+    print("  upstream attempt (ms):           {}".format(
+        out["upstream"]))
+    print("  route overhead (request-upstream, ms): {}".format(
+        out["route_overhead"]))
+    print("  router stages (mean ms/call):    {}".format(
+        out["stage_ms"]))
+    print("  failovers: {}  no_replica: {}".format(
+        out["failovers"], out["no_replica"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,10 @@ the executor and fork/spawn inherits it. Spec grammar::
 - ``only=EID`` restricts the injection to the process whose
   ``TFOS_TRAINER_EXECUTOR_ID`` matches (set by node.py's trainer entry)
   — how a 2-executor blacklist test kills executor 1's trainer only.
+  Non-numeric values scope by the SITE's caller-supplied identity
+  instead: ``only=replica-1`` on a serving point targets one replica's
+  engine of an in-process fleet (the engines pass their ``replica_id``
+  to :func:`on_decode_step`).
 - ``fuse=PATH`` makes the injection single-shot ACROSS process
   incarnations: firing creates the fuse file (content: wall-clock fire
   time), and an existing fuse disarms. A restarted trainer inherits the
@@ -114,15 +118,23 @@ class Injection(object):
         self.fired = False
         self.started = None  # for duration-window points
 
-    def ready(self):
-        """Armed, not yet fired, fuse intact, and scoped to this process."""
+    def ready(self, ident=None):
+        """Armed, not yet fired, fuse intact, and scoped to this process
+        (or, for multi-replica sites sharing one process, to the
+        caller-supplied ``ident`` — how a fleet test kills ONE replica's
+        scheduler when every replica's engine runs in the same
+        process)."""
         if self.fired:
             return False
         if self.fuse and os.path.exists(self.fuse):
             return False
         if self.only is not None:
+            if ident is not None and str(ident) == str(self.only):
+                return True
             eid = os.environ.get("TFOS_TRAINER_EXECUTOR_ID")
-            if eid is None or int(eid) != self.only:
+            try:
+                return eid is not None and int(eid) == int(self.only)
+            except (TypeError, ValueError):
                 return False
         return True
 
@@ -168,7 +180,13 @@ def parse_spec(spec):
             k, v = field.split("=", 1)
             k = k.strip()
             if k == "only":
-                only = int(v)
+                # numeric executor ids stay ints (the TFOS_TRAINER_
+                # EXECUTOR_ID scoping); anything else is a replica
+                # ident matched against the site's caller-supplied id
+                try:
+                    only = int(v)
+                except ValueError:
+                    only = v.strip()
             elif k == "fuse":
                 fuse = v
             else:
@@ -206,12 +224,14 @@ def _current():
         return _injections
 
 
-def armed(point):
-    """The ready :class:`Injection` for ``point``, else None."""
+def armed(point, ident=None):
+    """The ready :class:`Injection` for ``point``, else None.
+    ``ident`` scopes multi-replica sites: an ``only=<ident>`` injection
+    fires only when the calling site passes a matching identity."""
     if point == "stall_ring_slot":
         point = "stall_consumer_for"
     inj = _current().get(point)
-    return inj if inj is not None and inj.ready() else None
+    return inj if inj is not None and inj.ready(ident) else None
 
 
 def _kill_self(inj, why):
@@ -274,23 +294,25 @@ def on_batch(feed, batches_served):
         time.sleep(inj.value)
 
 
-def on_decode_step(steps_done):
+def on_decode_step(steps_done, ident=None):
     """Decode-scheduler site (serving.DecodeEngine._loop), called at
     each step boundary with the number of COMPLETED decode steps.
     ``stall_decode_for`` sleeps here (once); ``kill_scheduler_at_step``
-    raises :class:`SchedulerKilled` once ``steps_done`` reaches N."""
-    inj = armed("stall_decode_for")
+    raises :class:`SchedulerKilled` once ``steps_done`` reaches N.
+    ``ident`` is the engine's replica id: an ``only=<replica_id>``
+    injection targets ONE replica of an in-process fleet."""
+    inj = armed("stall_decode_for", ident)
     if inj is not None:
         inj.mark_fired()
         logger.warning("CHAOS stalling decode scheduler for %gs",
                        inj.value)
         time.sleep(inj.value)
-    inj = armed("kill_scheduler_at_step")
+    inj = armed("kill_scheduler_at_step", ident)
     if inj is not None and steps_done >= inj.value:
         inj.mark_fired()
         logger.error("CHAOS firing kill_scheduler_at_step "
-                     "(step %d >= %g): killing the decode scheduler",
-                     steps_done, inj.value)
+                     "(step %d >= %g, replica %s): killing the decode "
+                     "scheduler", steps_done, inj.value, ident)
         raise SchedulerKilled(
             "chaos: decode scheduler killed at step {}".format(steps_done))
 

@@ -389,3 +389,34 @@ def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
     return TFCluster(sc, cluster_info, cluster_meta, input_mode, server,
                      async_result, tuple(queues), num_executors,
                      executor_ids=executor_ids, exclude=exclude)
+
+
+def serving_fleet(model, params, replicas=2, name="model",
+                  supervise=False, restart=None, **fleet_kw):
+    """Construct and START an in-process serving fleet (PR 6): N
+    continuous-batching ``DecodeEngine`` replicas behind their own
+    ``ModelServer``s, registered with a fresh reservation server via
+    BEAT leases, fronted by a least-loaded ``fleet.FleetRouter`` —
+    the serving-plane analog of :func:`run`'s one-call cluster
+    formation. ``supervise=True`` additionally arms the recovery loop
+    (``Supervisor.watch_fleet``: dead replica -> router quiesced ->
+    RestartEngine respawn -> readmit; ``restart`` overrides the
+    policy). Returns the started ``fleet.ServingFleet`` (a context
+    manager — ``with`` it, or call ``stop()``)::
+
+        f = cluster.serving_fleet(dec_model, params, replicas=3,
+                                  supervise=True)
+        # POST http://%s:%d/v1/models/model:generate % f.router_addr
+        f.rolling_drain()   # zero-loss weight upgrade
+        f.stop()
+
+    Extra ``fleet_kw`` (``engine_kw``, ``beat_interval``,
+    ``router_kw``, ...) pass through to ``fleet.ServingFleet``."""
+    from tensorflowonspark_tpu import fleet as fleet_mod
+
+    f = fleet_mod.ServingFleet(model, params, replicas=replicas,
+                               name=name, **fleet_kw)
+    f.start()
+    if supervise:
+        f.supervise(restart=restart)
+    return f

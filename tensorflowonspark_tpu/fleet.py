@@ -1,0 +1,1106 @@
+"""Serving fleet: replica registry + metrics-driven router (PR 6).
+
+One ``DecodeEngine`` + ``ModelServer`` per process was the serving
+ceiling; the north star is heavy traffic, and the reference design
+(SURVEY: ``TFCluster.run()`` fan-out) points the same way — many
+identical workers behind one dispatch point. This module is that
+dispatch point, stitched through the planes the earlier PRs built:
+
+- **Registry** — N replicas (in-process, or anywhere that can reach
+  the driver) ride the reservation server's BEAT leases
+  (reservation.py): each :class:`Replica` beats a ``role: "serving"``
+  payload carrying its HTTP address and the engine's live load gauges
+  (``DecodeEngine.load_stats``: queue depth, slot occupancy,
+  queue-wait EWMA, alive/draining) plus its metrics-registry snapshot.
+  ``Server.serving_snapshot()`` is the router's one view of the fleet.
+- **Router** — :class:`FleetRouter`, a standalone HTTP front end
+  (``POST :generate``, ``GET /healthz``, ``GET /metrics`` with
+  per-replica labels) doing least-loaded dispatch from those live
+  gauges. Failover rides the serving error taxonomy PR 4 classified:
+  ``Shed`` / ``Draining`` / ``EngineFailed`` / connection failures are
+  retriable, so the router re-dispatches to the next-best replica
+  through ``serving.retry_call`` (bounded backoff + full jitter,
+  honoring ``Retry-After``); only ``EngineFailed``-shaped failures
+  count against a replica's health.
+- **Health** — :class:`ReplicaHealth`: repeated failures (or a dead
+  lease) stop routing to a replica; after a cooldown it goes HALF-OPEN
+  and the router's probe loop verifies ``/healthz`` before readmitting
+  — a flapping replica backs off geometrically instead of absorbing
+  live traffic.
+- **Rolling drain** — :meth:`FleetRouter.rolling_drain`: one replica
+  at a time, quiesce (router stops routing) → ``engine.drain()``
+  (admitted work finishes, zero loss) → build the successor engine
+  (``respawn()`` by default; pass ``upgrade=`` for a weight swap) →
+  ``attach_engine`` → wait for ``/healthz`` recovery over the wire →
+  readmit. The fleet serves throughout; the cycle aborts rather than
+  drain a second replica while one is still down.
+
+The dispatch policy itself (:func:`route_order`) and the health state
+machine are PURE — time injected, no sockets — so the tests pin them
+table-driven. ``Supervisor.watch_fleet`` closes the recovery loop:
+dead replica scheduler → router quiesced FIRST, engine respawned
+(RestartEngine policy), router readmits.
+
+In-process quickstart (the shape ``cluster.serving_fleet`` wraps)::
+
+    with ServingFleet(model, params, replicas=3, name="lm") as f:
+        f.supervise()                      # auto-restart dead replicas
+        url = "http://%s:%d" % f.router_addr
+        # POST {url}/v1/models/lm:generate   -> routed + failover
+        f.rolling_drain()                  # zero-loss weight upgrade
+"""
+
+import http.client
+import json
+import logging
+import socket
+import threading
+import time
+
+from tensorflowonspark_tpu import reservation, serving, tracing
+
+logger = logging.getLogger(__name__)
+
+#: lease age (seconds) past which a replica's gauges are too stale to
+#: route on — the router's default; a beat interval fits ~8x inside it
+DEFAULT_STALE_AFTER = 2.0
+
+
+class NoReplicaAvailable(serving.Retriable):
+    """The router found no routable replica (all stale, down, draining,
+    or dead). Retriable — replicas recover, leases refresh."""
+
+    def __init__(self, msg, retry_after=0.5):
+        super(NoReplicaAvailable, self).__init__(msg)
+        self.retry_after = float(retry_after)
+
+
+class ReplicaUnavailable(serving.Retriable):
+    """One upstream attempt failed for a transient reason; the next
+    attempt should go to the next-best replica. ``retry_after=0`` when
+    other candidates remain (immediate failover — waiting would only
+    add latency), the upstream's Retry-After once the fleet is
+    exhausted for this pass."""
+
+    def __init__(self, msg, retry_after=0.0):
+        super(ReplicaUnavailable, self).__init__(msg)
+        self.retry_after = float(retry_after)
+
+
+# -- dispatch policy (pure: no sockets, time injected) ---------------------
+
+def load_score(view):
+    """Order key for least-loaded dispatch: primary = work the replica
+    holds (queued + occupied slots + requests this router already has
+    open against it — the router's own in-flight count covers the beat
+    staleness window, when a burst it just dispatched is not yet in
+    any gauge); secondary = the replica's queue-wait EWMA (two equally
+    backlogged replicas differ in how fast they drain); final =
+    replica_id, so ties break deterministically."""
+    return (int(view.get("queue_depth") or 0)
+            + int(view.get("slot_occupancy") or 0)
+            + int(view.get("inflight") or 0),
+            float(view.get("queue_wait_ewma_s") or 0.0),
+            str(view.get("replica_id")))
+
+
+def route_order(views, stale_after=DEFAULT_STALE_AFTER):
+    """Pure dispatch policy: replica view dicts -> replica ids to try,
+    best first. Excluded entirely: stale leases (``age`` missing or >
+    ``stale_after`` — gauges that old describe a replica that may no
+    longer exist), dead engines (``alive`` False), draining replicas,
+    and DOWN health states. HEALTHY candidates come first, least
+    loaded to most (:func:`load_score`); PROBE candidates (half-open:
+    cooldown expired, recovery unverified) rank after every healthy
+    one — they get traffic only as a last resort; the probe loop's
+    out-of-band /healthz check is the normal readmission path."""
+    healthy, probing = [], []
+    for view in views:
+        age = view.get("age")
+        if age is None or age > stale_after:
+            continue
+        if view.get("alive") is False:
+            continue
+        if view.get("draining"):
+            continue
+        state = view.get("state", ReplicaHealth.UP)
+        if state == ReplicaHealth.DOWN:
+            continue
+        bucket = probing if state == ReplicaHealth.PROBE else healthy
+        bucket.append((load_score(view), str(view.get("replica_id"))))
+    healthy.sort()
+    probing.sort()
+    return [rid for _, rid in healthy] + [rid for _, rid in probing]
+
+
+class ReplicaHealth(object):
+    """Per-replica failure tracking with half-open recovery. Pure state
+    machine (``now`` injected everywhere) so the transition table is
+    unit-testable without sockets; thread-safe because the dispatch
+    threads and the probe loop both write.
+
+    States: UP (routable) -> DOWN after ``fail_threshold`` consecutive
+    failures, for a cooldown that doubles per consecutive down period
+    (capped at ``max_cooldown``) -> PROBE once the cooldown expires
+    (half-open: eligible for ONE verification — the router's probe
+    loop GETs /healthz) -> UP on success, DOWN again (longer) on
+    failure. :meth:`quiesce` is the administrative override (rolling
+    drain, supervisor restart window): DOWN with no probe path until
+    :meth:`readmit` — the operator knows when the replica is back, the
+    router must not guess."""
+
+    UP, DOWN, PROBE = "up", "down", "probe"
+
+    def __init__(self, fail_threshold=2, cooldown=1.0,
+                 cooldown_factor=2.0, max_cooldown=30.0):
+        self.fail_threshold = max(1, int(fail_threshold))
+        self.cooldown = float(cooldown)
+        self.cooldown_factor = float(cooldown_factor)
+        self.max_cooldown = float(max_cooldown)
+        self._lock = threading.Lock()
+        self._r = {}  # rid -> {fails, downs, down_until, quiesced}
+
+    def _rec(self, rid):
+        return self._r.setdefault(str(rid), {
+            "fails": 0, "downs": 0, "down_until": None, "quiesced": {}})
+
+    def state(self, rid, now):
+        with self._lock:
+            rec = self._r.get(str(rid))
+            if rec is None:
+                return self.UP
+            if rec["quiesced"]:
+                return self.DOWN
+            if rec["down_until"] is None:
+                return self.UP
+            return self.DOWN if now < rec["down_until"] else self.PROBE
+
+    def note_success(self, rid):
+        """A request (or probe) against ``rid`` succeeded: full reset —
+        consecutive-failure count, down state, AND the cooldown
+        escalation (a replica that proved itself healthy starts its
+        next incident from the base cooldown)."""
+        with self._lock:
+            rec = self._r.get(str(rid))
+            if rec is not None and not rec["quiesced"]:
+                rec.update(fails=0, downs=0, down_until=None)
+
+    def note_failure(self, rid, now, reason=""):
+        """A request (or probe) against ``rid`` failed for a
+        health-relevant reason (engine death, connection failure —
+        NOT shed/backpressure). A failure while half-open re-downs
+        immediately with an escalated cooldown; otherwise failures
+        count toward ``fail_threshold``."""
+        with self._lock:
+            rec = self._rec(rid)
+            half_open = rec["down_until"] is not None \
+                and now >= rec["down_until"]
+            rec["fails"] += 1
+            if half_open or rec["fails"] >= self.fail_threshold:
+                rec["fails"] = 0
+                rec["downs"] += 1
+                hold = min(
+                    self.cooldown
+                    * self.cooldown_factor ** (rec["downs"] - 1),
+                    self.max_cooldown)
+                rec["down_until"] = now + hold
+                logger.warning(
+                    "replica %s marked down for %.1fs (down #%d)%s",
+                    rid, hold, rec["downs"],
+                    ": " + reason if reason else "")
+
+    def quiesce(self, rid, reason="", owner="operator"):
+        """Administrative hold: excluded from routing, no half-open
+        path, until :meth:`readmit`. Holds are OWNER-SCOPED (one per
+        owner string): rolling drain and the supervisor place
+        independent holds on the same replica, and each clears only
+        its own — a supervisor racing a rolling drain must not be able
+        to readmit a replica the drain is still holding back pending
+        its wire-verified /healthz."""
+        with self._lock:
+            self._rec(rid)["quiesced"][str(owner)] = reason or "quiesced"
+        logger.info("replica %s quiesced by %s%s", rid, owner,
+                    ": " + reason if reason else "")
+
+    def readmit(self, rid, owner="operator"):
+        """Release ``owner``'s hold on ``rid``; failure state (counts,
+        cooldown escalation) resets only once the LAST hold clears —
+        the caller that verified the replica is back. ``owner=None``
+        force-clears every hold (an operator override)."""
+        with self._lock:
+            rec = self._r.get(str(rid))
+            if rec is None:
+                return
+            if owner is None:
+                rec["quiesced"].clear()
+            else:
+                rec["quiesced"].pop(str(owner), None)
+            if not rec["quiesced"]:
+                rec.update(fails=0, downs=0, down_until=None)
+        logger.info("replica %s hold released by %s", rid, owner)
+
+    def known(self):
+        with self._lock:
+            return list(self._r)
+
+
+# -- replica-side agent ----------------------------------------------------
+
+class Replica(object):
+    """One serving replica's fleet agent: starts its :class:`serving.
+    ModelServer`, then beats the reservation server with the serving
+    lease payload — identity, HTTP address, live load gauges, and the
+    engine's metrics-registry snapshot — every ``beat_interval``
+    seconds. The beat keeps flowing through engine death and restart
+    (a dead engine beats ``alive: False``, which is exactly what the
+    router needs to know), and reads the engine through the SERVER so
+    an ``attach_engine`` swap (supervisor restart, rolling drain) is
+    picked up on the next beat."""
+
+    def __init__(self, server, reservation_addr, beat_interval=0.25):
+        self.server = server
+        self.reservation_addr = tuple(reservation_addr)
+        self.beat_interval = float(beat_interval)
+        self.replica_id = server.replica_id
+        if self.replica_id is None:
+            raise ValueError(
+                "fleet replicas need a replica identity: mount an "
+                "engine (its replica_id is the default) or pass "
+                "ModelServer(replica_id=...)")
+        self.addr = None
+        self._client = None
+        self._stop = threading.Event()
+        self._thread = None
+
+    @property
+    def engine(self):
+        """The CURRENT engine behind this replica's server (attach_
+        engine swaps it; a stopped server has none)."""
+        return self.server.engine
+
+    def start(self):
+        self.addr = self.server.start()
+        self._thread = threading.Thread(
+            target=self._beat_loop, daemon=True,
+            name="tfos-fleet-beat-{}".format(self.replica_id))
+        self._thread.start()
+        return self.addr
+
+    def _payload(self):
+        engine = self.server.engine
+        payload = {"role": "serving", "replica_id": self.replica_id,
+                   "addr": list(self.addr), "model": self.server.name,
+                   "state": "serving"}
+        if engine is not None:
+            payload["serving"] = engine.load_stats()
+            payload["metrics"] = engine.metrics.snapshot()
+        else:
+            # stopped server / restart gap: the lease must say so, not
+            # vanish (a vanished lease reads as replica loss)
+            payload["serving"] = {"replica_id": self.replica_id,
+                                  "alive": False, "draining": False,
+                                  "queue_depth": 0, "slot_occupancy": 0,
+                                  "queue_wait_ewma_s": 0.0}
+        return payload
+
+    def _beat_loop(self):
+        while not self._stop.is_set():
+            try:
+                if self._client is None:
+                    self._client = reservation.Client(
+                        self.reservation_addr)
+                self._client.beat(self.replica_id, self._payload())
+            except Exception as e:  # noqa: BLE001 - beats must survive
+                logger.warning("replica %s beat failed: %s",
+                               self.replica_id, e)
+                if self._client is not None:
+                    try:
+                        self._client.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._client = None
+            self._stop.wait(self.beat_interval)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._client = None
+        self.server.stop()
+
+
+# -- router ----------------------------------------------------------------
+
+class _ClientGone(RuntimeError):
+    """The router's OWN client disconnected mid-dispatch. The upstream
+    connection is torn down so the replica's socket-EOF cancellation
+    (the PR-4 disconnect path) fires there too — the router must not
+    turn a vanished client back into a slot decoding to max_new."""
+
+
+def _http_request(addr, method, path, body=None, timeout=600.0,
+                  abort=None):
+    """One plain HTTP exchange -> (status, raw body bytes, headers).
+
+    ``abort`` (zero-arg callable): polled while the exchange runs;
+    when it turns True the upstream connection is CLOSED — the replica
+    sees socket EOF and cancels the in-flight body exactly as it would
+    for a directly-connected client — and :class:`_ClientGone` is
+    raised. Without ``abort`` the exchange is a plain blocking call."""
+    conn = http.client.HTTPConnection(addr[0], int(addr[1]),
+                                      timeout=timeout)
+    headers = {"Content-Type": "application/json"} if body else {}
+    if abort is None:
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            return resp.status, resp.read(), dict(resp.getheaders())
+        finally:
+            conn.close()
+    done = threading.Event()
+    box = {}
+
+    def _exchange():
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            box["out"] = (resp.status, resp.read(),
+                          dict(resp.getheaders()))
+        except BaseException as e:  # noqa: BLE001 - delivered below
+            box["err"] = e
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=_exchange, daemon=True,
+                              name="tfos-fleet-upstream")
+    worker.start()
+    try:
+        while not done.wait(0.05):
+            if abort():
+                # shutdown() BEFORE close(): the worker thread is
+                # blocked in recv on this socket, and close() alone
+                # neither wakes it nor sends FIN while the in-flight
+                # syscall pins the file description — the replica
+                # would never see the EOF its disconnect-cancel polls
+                # for (same Linux pitfall as the reservation
+                # listener's accept)
+                try:
+                    if conn.sock is not None:
+                        conn.sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                conn.close()
+                done.wait(5.0)
+                raise _ClientGone("client disconnected mid-dispatch")
+        if "err" in box:
+            raise box["err"]
+        return box["out"]
+    finally:
+        conn.close()
+
+
+class FleetRouter(object):
+    """Metrics-driven HTTP front end over a fleet of serving replicas.
+
+    Routes ``POST /v1/models/<name>:generate`` to the least-loaded
+    replica (live BEAT gauges via ``reservation.Server.
+    serving_snapshot``; policy in :func:`route_order`), failing over
+    on retriable upstream errors. ``GET /healthz`` reports the
+    router's own fitness (503 once NO replica is routable) plus the
+    per-replica view; ``GET /metrics`` exposes the router's registry
+    and every replica's beat-carried engine snapshot as
+    ``replica``-labeled series in one OpenMetrics document.
+
+    Health discipline: an ``EngineFailed``-shaped 503, a connection
+    failure, or an upstream timeout counts against the replica
+    (:class:`ReplicaHealth` — repeated failures stop routing, with
+    half-open /healthz probing for recovery); a ``Shed`` or 429 is
+    LOAD, not unhealthiness — fail over, don't penalize; a
+    ``Draining`` replica excludes itself via its own beat payload.
+
+    ``replicas``: the in-process :class:`Replica` objects (when the
+    fleet is local) — required only by :meth:`rolling_drain`, which
+    needs engine/server access; routing itself is address-based and
+    replica-location-agnostic.
+    """
+
+    def __init__(self, reservation_server, name="model",
+                 host="127.0.0.1", port=0, replicas=None,
+                 stale_after=DEFAULT_STALE_AFTER, attempts=4,
+                 fail_threshold=2, cooldown=1.0, max_cooldown=30.0,
+                 probe_interval=0.25, upstream_timeout=600.0,
+                 base_delay=0.05, max_delay=2.0):
+        self.reservation = reservation_server
+        self.name = name
+        self.replicas = list(replicas or [])
+        self.stale_after = float(stale_after)
+        self.attempts = int(attempts)
+        self.upstream_timeout = float(upstream_timeout)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.probe_interval = float(probe_interval)
+        self.health = ReplicaHealth(fail_threshold=fail_threshold,
+                                    cooldown=cooldown,
+                                    max_cooldown=max_cooldown)
+        self.counters = tracing.Counters()
+        self.timers = tracing.StageTimers()
+        self.metrics = tracing.MetricsRegistry()
+        self.metrics.add_counters("tfos_fleet", self.counters)
+        self.metrics.add_timers("tfos_fleet_stage", self.timers)
+        self._hist_request = self.metrics.histogram(
+            "tfos_fleet_request_seconds")
+        self._hist_upstream = self.metrics.histogram(
+            "tfos_fleet_upstream_seconds")
+        self._hist_overhead = self.metrics.histogram(
+            "tfos_fleet_route_overhead_seconds")
+        self._inflight = {}
+        self._inflight_lock = threading.Lock()
+        # every histogram/timer/counter write goes through this lock:
+        # dispatch runs on a ThreadingHTTPServer thread PER REQUEST,
+        # and tracing's unlocked read-modify-writes are single-writer
+        # by convention — concurrent observes would silently lose
+        # samples in the very numbers the fleet bench publishes
+        self._obs_lock = threading.Lock()
+        self._host, self._port = host, int(port)
+        self._httpd = None
+        self._thread = None
+        self._probe_stop = threading.Event()
+        self._probe_thread = None
+
+    # -- fleet view --------------------------------------------------------
+
+    def _snapshot(self):
+        return self.reservation.serving_snapshot()
+
+    def replica_views(self, now=None, snapshot=None):
+        """The view dicts :func:`route_order` prices, one per live
+        serving lease: beat gauges + this router's own in-flight count
+        and health state."""
+        now = now if now is not None else time.monotonic()
+        snapshot = snapshot if snapshot is not None else self._snapshot()
+        views = []
+        with self._inflight_lock:
+            inflight = dict(self._inflight)
+        for rid, info in sorted(snapshot.items()):
+            gauges = info.get("serving") or {}
+            views.append({
+                "replica_id": rid,
+                "age": info.get("age"),
+                "addr": info.get("addr"),
+                "alive": gauges.get("alive", True),
+                "draining": bool(gauges.get("draining")),
+                "queue_depth": gauges.get("queue_depth", 0),
+                "slot_occupancy": gauges.get("slot_occupancy", 0),
+                "queue_wait_ewma_s": gauges.get("queue_wait_ewma_s", 0.0),
+                "inflight": inflight.get(rid, 0),
+                "state": self.health.state(rid, now),
+            })
+        return views
+
+    def _note_inflight(self, rid, delta):
+        with self._inflight_lock:
+            self._inflight[rid] = max(
+                0, self._inflight.get(rid, 0) + delta)
+
+    # -- health controls (supervisor / rolling drain hooks) ----------------
+
+    def quiesce(self, replica_id, reason="", owner="operator"):
+        """Stop routing to ``replica_id`` until the same ``owner``
+        readmits — the supervisor calls this BEFORE restarting a dead
+        replica's engine, and rolling drain before draining one; each
+        holds and releases independently (see
+        :meth:`ReplicaHealth.quiesce`)."""
+        self.health.quiesce(replica_id, reason, owner=owner)
+
+    def readmit(self, replica_id, owner="operator"):
+        self.health.readmit(replica_id, owner=owner)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def dispatch(self, raw_body, client_gone=None):
+        """Route one ``:generate`` body; returns ``(status, body_bytes,
+        retry_after_or_None)`` — the upstream's response verbatim on
+        success or a non-retriable status, a final 503 once every
+        failover attempt is spent. ``client_gone`` (zero-arg callable
+        from the HTTP layer) is polled while the upstream call runs: a
+        disconnected end client tears down the upstream connection, so
+        the replica's own socket-EOF cancellation fires and the slot
+        frees — the router must not insulate replicas from the PR-4
+        disconnect contract (:class:`_ClientGone` propagates)."""
+        t0 = time.monotonic()
+        upstream_spent = [0.0]
+        tried = set()
+        try:
+            try:
+                status, body, headers = serving.retry_call(
+                    lambda: self._attempt(raw_body, tried,
+                                          upstream_spent, client_gone),
+                    attempts=self.attempts, base_delay=self.base_delay,
+                    max_delay=self.max_delay)
+                retry_after = None
+            except serving.Retriable as e:
+                status = 503
+                body = json.dumps(
+                    {"error": str(e),
+                     "kind": type(e).__name__}).encode()
+                retry_after = max(
+                    1, int(getattr(e, "retry_after", 1.0) or 1))
+        finally:
+            # in a finally so a _ClientGone (499) dispatch still
+            # counts: tfos_fleet_requests is "requests the router
+            # answered (ANY status)" and the latency/overhead
+            # histograms must not silently exclude disconnects
+            wall = time.monotonic() - t0
+            with self._obs_lock:
+                self.counters.inc("requests")
+                self._hist_request.observe(wall)
+                self._hist_overhead.observe(
+                    max(wall - upstream_spent[0], 0.0))
+        return status, body, retry_after
+
+    def _attempt(self, raw_body, tried, upstream_spent,
+                 client_gone=None):
+        """One dispatch attempt: pick the best untried replica, POST,
+        classify the outcome. Raises Retriable to make retry_call fail
+        over; anything else returns verbatim for the client."""
+        if client_gone is not None and client_gone():
+            # vanished before we even picked: don't burn a slot
+            with self._obs_lock:
+                self.counters.inc("client_disconnects")
+            raise _ClientGone("client disconnected before dispatch")
+        now = time.monotonic()
+        t_pick = time.monotonic()
+        snapshot = self._snapshot()
+        views = self.replica_views(now, snapshot)
+        order = [rid for rid in route_order(views, self.stale_after)
+                 if rid not in tried]
+        if not order and tried:
+            # every routable replica was tried this request: clear
+            # the per-request exclusions so backoff + a fresh pick
+            # can retry one (it may have recovered — bounded by
+            # retry_call's attempt budget either way)
+            tried.clear()
+            order = route_order(views, self.stale_after)
+        with self._obs_lock:
+            self.timers.add("pick", time.monotonic() - t_pick)
+        if not order:
+            with self._obs_lock:
+                self.counters.inc("no_replica")
+            raise NoReplicaAvailable(
+                "no routable replica ({} known)".format(len(views)))
+        rid = order[0]
+        tried.add(rid)
+        addr = (snapshot.get(rid) or {}).get("addr")
+        if not addr:
+            raise ReplicaUnavailable(
+                "replica {} has no advertised address".format(rid))
+        more = len(order) > 1
+        path = "/v1/models/{}:generate".format(self.name)
+        self._note_inflight(rid, +1)
+        t_up = time.monotonic()
+        try:
+            status, body, headers = _http_request(
+                addr, "POST", path, body=raw_body,
+                timeout=self.upstream_timeout, abort=client_gone)
+        except _ClientGone:
+            # OUR client hung up; the upstream teardown already told
+            # the replica (socket EOF -> its disconnect cancel). Not a
+            # replica failure, not retriable — there is nobody left to
+            # answer
+            with self._obs_lock:
+                self.counters.inc("client_disconnects")
+            raise
+        except (OSError, http.client.HTTPException) as e:
+            self.health.note_failure(rid, time.monotonic(),
+                                     reason=str(e))
+            with self._obs_lock:
+                self.counters.inc("failovers")
+            raise ReplicaUnavailable(
+                "replica {} unreachable: {}".format(rid, e),
+                retry_after=0.0 if more else 0.5)
+        finally:
+            dt = time.monotonic() - t_up
+            with self._obs_lock:
+                self.timers.add("upstream", dt)
+                self._hist_upstream.observe(dt)
+            upstream_spent[0] += dt
+            self._note_inflight(rid, -1)
+        if status in serving.RETRIABLE_HTTP_STATUS:
+            kind = self._retriable_kind(status, body)
+            if kind == "EngineFailed":
+                # the one transient that is replica UNHEALTHINESS;
+                # Shed/QueueFull are load, Draining self-excludes via
+                # its beat — penalizing those would eject replicas for
+                # doing admission control correctly
+                self.health.note_failure(rid, time.monotonic(),
+                                         reason=kind)
+            with self._obs_lock:
+                self.counters.inc("failovers")
+            retry_after = headers.get("Retry-After")
+            try:
+                retry_after = float(retry_after)
+            except (TypeError, ValueError):
+                retry_after = 1.0
+            raise ReplicaUnavailable(
+                "replica {} answered {} ({})".format(rid, status, kind),
+                retry_after=0.0 if more else retry_after)
+        self.health.note_success(rid)
+        return status, body, headers
+
+    @staticmethod
+    def _retriable_kind(status, body):
+        if status == 429:
+            return "QueueFull"
+        try:
+            parsed = json.loads(body)
+            kind = parsed.get("kind") \
+                or ("Draining" if parsed.get("status") == "draining"
+                    else None)
+            return kind or "Retriable"
+        except (ValueError, AttributeError):
+            return "Retriable"
+
+    # -- half-open probing -------------------------------------------------
+
+    def _probe_loop(self):
+        while not self._probe_stop.is_set():
+            try:
+                self._probe_once()
+            except Exception:  # noqa: BLE001 - probing must survive
+                logger.exception("fleet probe pass failed")
+            self._probe_stop.wait(self.probe_interval)
+
+    def _probe_once(self, now=None):
+        """Verify every half-open replica out-of-band: GET /healthz;
+        200 readmits (note_success), anything else re-downs with an
+        escalated cooldown. Recovery never risks a live request."""
+        now = now if now is not None else time.monotonic()
+        snapshot = self._snapshot()
+        for rid in self.health.known():
+            if self.health.state(rid, now) != ReplicaHealth.PROBE:
+                continue
+            addr = (snapshot.get(rid) or {}).get("addr")
+            if not addr:
+                continue
+            with self._obs_lock:
+                self.counters.inc("probes")
+            try:
+                status, _, _ = _http_request(addr, "GET", "/healthz",
+                                             timeout=5.0)
+            except (OSError, http.client.HTTPException) as e:
+                status, e_str = None, str(e)
+            if status == 200:
+                self.health.note_success(rid)
+                logger.info("replica %s probe OK: readmitted", rid)
+            else:
+                self.health.note_failure(
+                    rid, time.monotonic(),
+                    reason="probe answered {}".format(status)
+                    if status is not None else "probe failed: " + e_str)
+
+    # -- operational surface ----------------------------------------------
+
+    def healthz(self):
+        """(status_code, body): 200 while at least one replica is
+        routable, 503 otherwise; the body carries the per-replica view
+        (state / lease age / gauges / in-flight) an operator or LB
+        reads to tell WHICH replica is the problem."""
+        now = time.monotonic()
+        views = self.replica_views(now)
+        order = route_order(views, self.stale_after)
+        body = {"status": "ok" if order else "unavailable",
+                "model": self.name,
+                "routable": len(order),
+                "replicas": {v["replica_id"]: {
+                    "state": v["state"], "age": v["age"],
+                    "alive": v["alive"], "draining": v["draining"],
+                    "queue_depth": v["queue_depth"],
+                    "slot_occupancy": v["slot_occupancy"],
+                    "inflight": v["inflight"]} for v in views}}
+        return (200 if order else 503), body
+
+    def metrics_text(self):
+        """One OpenMetrics document: the router's own registry
+        (unlabeled) + every replica's beat-carried engine snapshot as
+        ``replica``-labeled series + hand-rendered per-replica routing
+        gauges — rendered through the one grammar-correct
+        multi-snapshot core, so each family appears once."""
+        now = time.monotonic()
+        snapshot = self._snapshot()
+        views = self.replica_views(now, snapshot)
+        order = set(route_order(views, self.stale_after))
+        with self._obs_lock:
+            self.counters.gauge("replicas", len(views))
+            self.counters.gauge("replicas_routable", len(order))
+        lines = []
+        for family, key in (
+                ("tfos_fleet_replica_up",
+                 lambda v: 1 if v["replica_id"] in order else 0),
+                ("tfos_fleet_replica_lease_age_seconds",
+                 lambda v: v["age"]),
+                ("tfos_fleet_replica_inflight",
+                 lambda v: v["inflight"])):
+            if not views:
+                continue
+            lines.append("# TYPE {} gauge".format(family))
+            for v in views:
+                lines.append('{}{{replica="{}"}} {}'.format(
+                    family, v["replica_id"], tracing._fmt(key(v))))
+        labeled = [((), self.metrics.snapshot())]
+        for rid in sorted(snapshot):
+            m = snapshot[rid].get("metrics")
+            if m:
+                labeled.append(((("replica", rid),), m))
+        body = tracing.render_labeled(labeled)
+        if lines:
+            body = "\n".join(lines) + "\n" + body
+        return body
+
+    # -- rolling drain -----------------------------------------------------
+
+    def rolling_drain(self, upgrade=None, drain_timeout=None,
+                      healthz_timeout=30.0):
+        """Zero-downtime engine upgrade across the in-process fleet,
+        one replica at a time: quiesce (this router stops routing new
+        work to it) -> ``engine.drain()`` (every admitted request
+        finishes — the PR 4 zero-loss contract) -> build the successor
+        (``upgrade(old_engine)`` -> new engine, e.g. same config with
+        fresh weights; default ``old.respawn()``) -> ``server.
+        attach_engine`` -> wait for ``GET /healthz`` to answer 200
+        over the wire -> readmit. Traffic keeps flowing through the
+        remaining replicas for the whole cycle.
+
+        Returns a report dict: per-replica ``{replica_id,
+        drained_clean, recovered, wall_s}`` plus ``zero_loss`` (every
+        drain finished all admitted work) and ``completed`` (every
+        replica recovered; the cycle ABORTS — replica left quiesced —
+        rather than drain a second replica while one is down, so a
+        failed upgrade degrades capacity by exactly one replica)."""
+        if not self.replicas:
+            raise RuntimeError(
+                "rolling_drain needs in-process Replica objects "
+                "(router constructed with replicas=[...])")
+        report = {"replicas": [], "zero_loss": True, "completed": True}
+        for replica in self.replicas:
+            rid = replica.replica_id
+            t0 = time.monotonic()
+            self.quiesce(rid, "rolling drain", owner="rolling-drain")
+            old = replica.engine
+            if old is None:
+                # stopped server mid-cycle: nothing to drain OR rebuild
+                # from — abort rather than guess at a successor
+                report["replicas"].append(
+                    {"replica_id": rid, "drained_clean": False,
+                     "recovered": False,
+                     "wall_s": round(time.monotonic() - t0, 3)})
+                report["zero_loss"] = False
+                report["completed"] = False
+                break
+            clean = old.drain(timeout=drain_timeout)
+            fresh = upgrade(old) if upgrade is not None \
+                else old.respawn()
+            replica.server.attach_engine(fresh)
+            recovered = self._await_healthz(replica.addr,
+                                            healthz_timeout)
+            if recovered:
+                self.readmit(rid, owner="rolling-drain")
+            wall = time.monotonic() - t0
+            report["replicas"].append(
+                {"replica_id": rid, "drained_clean": bool(clean),
+                 "recovered": recovered, "wall_s": round(wall, 3)})
+            report["zero_loss"] &= bool(clean)
+            if not recovered:
+                logger.error(
+                    "rolling drain ABORTED: replica %s did not answer "
+                    "a healthy /healthz within %.0fs (left quiesced)",
+                    rid, healthz_timeout)
+                report["completed"] = False
+                break
+        return report
+
+    @staticmethod
+    def _await_healthz(addr, timeout):
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            try:
+                status, _, _ = _http_request(addr, "GET", "/healthz",
+                                             timeout=5.0)
+                if status == 200:
+                    return True
+            except (OSError, http.client.HTTPException):
+                pass
+            time.sleep(0.05)
+        return False
+
+    # -- http plumbing -----------------------------------------------------
+
+    def start(self):
+        """Serve in a daemon thread; returns (host, port). Also starts
+        the half-open probe loop."""
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code, body_bytes, content_type, headers=None):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body_bytes)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
+                self.end_headers()
+                self.wfile.write(body_bytes)
+
+            def _send_json(self, code, obj, headers=None):
+                self._send(code, json.dumps(obj).encode("utf-8"),
+                           "application/json", headers)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    code, body = router.healthz()
+                    return self._send_json(code, body)
+                if self.path == "/metrics":
+                    return self._send(
+                        200, router.metrics_text().encode("utf-8"),
+                        serving.OPENMETRICS_CONTENT_TYPE)
+                return self._send_json(
+                    404, {"error": "not found: %s" % self.path})
+
+            def _client_gone(self):
+                """True once OUR client closed its connection (readable
+                with EOF — a live client waiting on its response sends
+                nothing). Polled during the upstream exchange so an
+                end-client disconnect propagates: upstream teardown ->
+                replica's socket-EOF cancel -> slot freed (the PR-4
+                contract, preserved through the router)."""
+                import select
+                try:
+                    readable, _, _ = select.select(
+                        [self.connection], [], [], 0)
+                    if not readable:
+                        return False
+                    return self.connection.recv(
+                        1, socket.MSG_PEEK) == b""
+                except (OSError, ValueError):
+                    return True
+
+            def do_POST(self):
+                if self.path != "/v1/models/%s:generate" % router.name:
+                    return self._send_json(
+                        404, {"error": "not found: %s" % self.path})
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    raw = self.rfile.read(n) or b"{}"
+                    status, body, retry_after = router.dispatch(
+                        raw, client_gone=self._client_gone)
+                    headers = {} if retry_after is None \
+                        else {"Retry-After": str(retry_after)}
+                    return self._send(status, body, "application/json",
+                                      headers)
+                except _ClientGone as e:
+                    # the socket is almost certainly gone; best-effort
+                    # 499 (client closed request), never a 500 dump
+                    try:
+                        return self._send_json(499, {"error": str(e)})
+                    except OSError:
+                        return
+                except Exception as e:  # noqa: BLE001 - surface as 500
+                    logger.exception("fleet dispatch failed")
+                    return self._send_json(500, {"error": str(e)})
+
+            def log_message(self, fmt, *args):  # quiet by default
+                logger.debug("fleet router: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port),
+                                          Handler)
+        self._port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="tfos-fleet-router",
+            daemon=True)
+        self._thread.start()
+        self._probe_stop.clear()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="tfos-fleet-probe",
+            daemon=True)
+        self._probe_thread.start()
+        logger.info("fleet router for %r on %s:%d", self.name,
+                    self._host, self._port)
+        return self._host, self._port
+
+    @property
+    def addr(self):
+        return (self._host, self._port)
+
+    def stop(self):
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5)
+            self._probe_thread = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._thread.join(timeout=10)
+            self._httpd = None
+
+    def __enter__(self):
+        if self._httpd is None:
+            self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+# -- in-process fleet ------------------------------------------------------
+
+class ServingFleet(object):
+    """N in-process serving replicas + reservation registry + router,
+    wired and lifecycle-managed as one object (the shape the fleet
+    bench, the chaos e2e, and ``cluster.serving_fleet`` use; a
+    multi-host fleet runs the same :class:`Replica` agents pointed at
+    the driver's reservation address and the same router on the
+    driver).
+
+    Each replica is a ``DecodeEngine`` (``replica-<i>`` identity,
+    shared ``model``/``params``, per-replica ``engine_kw``) behind its
+    own ``ModelServer`` on an ephemeral port. ``start()`` blocks until
+    every replica's first BEAT lease is live, so the router can route
+    the moment it returns."""
+
+    def __init__(self, model, params, replicas=2, name="model",
+                 engine_kw=None, host="127.0.0.1", beat_interval=0.25,
+                 reservation_server=None, router_kw=None):
+        if int(replicas) < 1:
+            raise ValueError("a fleet needs >= 1 replica")
+        self.model = model
+        self.params = params
+        self.n_replicas = int(replicas)
+        self.name = name
+        self.engine_kw = dict(engine_kw or {})
+        self.host = host
+        self.beat_interval = float(beat_interval)
+        self.router_kw = dict(router_kw or {})
+        self._own_reservation = reservation_server is None
+        self.reservation = reservation_server \
+            if reservation_server is not None else reservation.Server(0)
+        self.replicas = []
+        self.router = None
+        self.supervisor = None
+        self._started = False
+
+    def start(self, form_timeout=30.0):
+        if self._started:
+            return self
+        from tensorflowonspark_tpu.serving import DecodeEngine, \
+            ModelServer
+
+        try:
+            if self._own_reservation:
+                resv_addr = self.reservation.start(host=self.host)
+            else:
+                resv_addr = self.reservation.addr
+            for i in range(self.n_replicas):
+                engine = DecodeEngine(self.model, self.params,
+                                      replica_id="replica-{}".format(i),
+                                      **self.engine_kw)
+                try:
+                    server = ModelServer(None, engine=engine,
+                                         name=self.name,
+                                         host=self.host, port=0)
+                    replica = Replica(server, resv_addr,
+                                      beat_interval=self.beat_interval)
+                    # tracked BEFORE start(): a replica that fails to
+                    # start must be reachable by the cleanup below, or
+                    # its engine's scheduler thread leaks
+                    self.replicas.append(replica)
+                except BaseException:
+                    engine.stop()
+                    raise
+                replica.start()
+            # formation barrier: every replica's lease must be live
+            # before the router opens, or the first requests race the
+            # first beats
+            deadline = time.monotonic() + float(form_timeout)
+            want = {r.replica_id for r in self.replicas}
+            while time.monotonic() < deadline:
+                if want <= set(self.reservation.serving_snapshot()):
+                    break
+                time.sleep(0.02)
+            else:
+                raise TimeoutError(
+                    "fleet formation: not every replica's serving lease "
+                    "arrived within {}s".format(form_timeout))
+            self.router = FleetRouter(self.reservation, name=self.name,
+                                      host=self.host,
+                                      replicas=self.replicas,
+                                      **self.router_kw)
+            self.router.start()
+        except BaseException:
+            # a failed formation must not strand what it already
+            # started: the caller has no fleet reference yet, so N
+            # engine scheduler threads, HTTP servers, beat threads,
+            # and the owned reservation server would leak for the
+            # process lifetime. stop() handles partial state.
+            self.stop()
+            raise
+        self._started = True
+        return self
+
+    @property
+    def router_addr(self):
+        return self.router.addr
+
+    def url(self, path=""):
+        host, port = self.router.addr
+        return "http://{}:{}{}".format(host, port, path)
+
+    def supervise(self, restart=None, config=None):
+        """Arm the recovery loop: a Supervisor watching every replica
+        (dead scheduler -> router quiesced first -> RestartEngine
+        respawn -> router readmit). Returns the supervisor."""
+        from tensorflowonspark_tpu import supervisor as supervisor_mod
+
+        if self.supervisor is None:
+            self.supervisor = supervisor_mod.Supervisor(config=config)
+            self.supervisor.watch_fleet(self, restart=restart)
+        return self.supervisor
+
+    def rolling_drain(self, upgrade=None, drain_timeout=None,
+                      healthz_timeout=30.0):
+        return self.router.rolling_drain(
+            upgrade=upgrade, drain_timeout=drain_timeout,
+            healthz_timeout=healthz_timeout)
+
+    def stop(self):
+        if self.supervisor is not None:
+            self.supervisor.stop()
+            self.supervisor = None
+        if self.router is not None:
+            self.router.stop()
+            self.router = None
+        for replica in self.replicas:
+            replica.stop()
+        # start() is re-callable (it re-forms the fleet): the stopped
+        # corpses must not linger in the registry, or a restart would
+        # route/drain/watch over duplicate replica_ids with dead
+        # engines
+        self.replicas = []
+        if self._own_reservation:
+            self.reservation.stop()
+            # a stopped Server cannot serve again (its done latch stays
+            # set); give a potential re-start() a fresh one
+            self.reservation = reservation.Server(0)
+        self._started = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

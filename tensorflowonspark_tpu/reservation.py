@@ -22,7 +22,11 @@ TPU-native differences from the reference's design:
   heartbeat leases (liveness + a small status payload the driver-side
   Supervisor classifies), and ACK messages record fed partitions as
   consumed so a restart-from-checkpoint recovery replays only the
-  unacknowledged ones. The reference's server spoke only
+  unacknowledged ones. The same BEAT leases carry the *fleet plane*
+  (fleet.py): serving replicas beat with ``role: "serving"`` payloads
+  (HTTP address + live load gauges + engine metrics snapshot), which
+  :meth:`Server.serving_snapshot` exposes to the FleetRouter's
+  least-loaded dispatch and the ``/stats`` replica view. The reference's server spoke only
   REG/QUERY/QINFO/STOP and went idle after formation (SURVEY.md §5: no
   failure detection beyond Spark task retry).
 """
@@ -173,6 +177,31 @@ class Server(object):
         with self._sup_lock:
             return set(self._acked)
 
+    def serving_snapshot(self):
+        """{replica_id: serving-replica view} from leases whose BEAT
+        payload declares ``role: "serving"`` — the fleet plane
+        (fleet.py): each view carries the lease age, the replica's
+        advertised HTTP address, its model name, the live load gauges
+        (``serving``: queue depth / slot occupancy / queue-wait EWMA /
+        alive / draining, see ``DecodeEngine.load_stats``), and the
+        beat-piggybacked engine registry snapshot (``metrics``). The
+        FleetRouter's least-loaded dispatch and per-replica /metrics
+        labels both read this; ``GET /stats`` exposes it as the
+        ``serving`` key."""
+        out = {}
+        for eid, lease in self.lease_snapshot().items():
+            payload = lease["payload"]
+            if payload.get("role") != "serving":
+                continue
+            out[str(eid)] = {
+                "age": round(lease["age"], 3),
+                "addr": payload.get("addr"),
+                "model": payload.get("model"),
+                "serving": payload.get("serving") or {},
+                "metrics": payload.get("metrics"),
+            }
+        return out
+
     def metrics_snapshot(self):
         """{executor_id: per-executor observability view} from the
         latest BEAT payloads: the beat-piggybacked MetricsRegistry
@@ -232,8 +261,19 @@ class Server(object):
                         server.metrics_snapshot()).encode("utf-8")
                 elif self.path == "/stats":
                     code, ctype = 200, "application/json"
-                    body = json.dumps(tracing.cluster_rollup(
-                        server.metrics_snapshot())).encode("utf-8")
+                    stats = tracing.cluster_rollup(
+                        server.metrics_snapshot())
+                    # fleet plane: per-replica serving view (lease age,
+                    # addr, load gauges) keyed by replica_id — the
+                    # operator's "what is the router seeing" endpoint.
+                    # The registry snapshot is dropped from this JSON
+                    # view (it is /metrics' job, rendered per-replica)
+                    stats["serving"] = {
+                        rid: {k: v for k, v in view.items()
+                              if k != "metrics"}
+                        for rid, view in
+                        server.serving_snapshot().items()}
+                    body = json.dumps(stats).encode("utf-8")
                 else:
                     code, ctype = 404, "application/json"
                     body = json.dumps(
@@ -330,6 +370,16 @@ class Server(object):
 
     def _close_listener(self):
         if self._sock is not None:
+            # shutdown() BEFORE close(): on Linux, close() alone does
+            # not wake a thread blocked in accept() — the serve thread
+            # would sit there until stop()'s 5s join timeout expired,
+            # a teardown tax every cluster/fleet spin paid. shutdown()
+            # on a listening socket raises ENOTCONN on some platforms
+            # (harmless) but reliably unblocks accept() here.
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._sock.close()
             except OSError:

@@ -47,10 +47,13 @@ Two batching planes live here, serving different traffic shapes:
 """
 
 import collections
+import itertools
 import json
 import logging
 import math
+import os
 import queue as queue_mod
+import random
 import socket
 import threading
 import time
@@ -67,6 +70,9 @@ logger = logging.getLogger(__name__)
 OPENMETRICS_CONTENT_TYPE = tracing.OPENMETRICS_CONTENT_TYPE
 
 _STREAM_DONE = object()
+
+#: default replica-identity source (see DecodeEngine.replica_id)
+_ENGINE_IDS = itertools.count()
 
 
 class Retriable(RuntimeError):
@@ -103,6 +109,74 @@ class EngineFailed(Retriable):
     """The decode scheduler died. Outstanding handles fail with this so
     clients retry (against this replica once the supervisor's
     RestartEngine policy rebuilds the engine, or against another)."""
+
+
+#: HTTP statuses a serving surface answers for TRANSIENT conditions —
+#: 429 (QueueFull backpressure) and 503 (Shed / Draining / EngineFailed)
+RETRIABLE_HTTP_STATUS = (429, 503)
+
+
+def http_retriable(status, retry_after=None):
+    """Map an upstream HTTP status to the matching client-side
+    :class:`Retriable` (None when the status is not transient) — the
+    one place the wire's 429/503 + ``Retry-After`` contract turns back
+    into the exception :func:`retry_call` retries. ``retry_after`` is
+    the response header value (seconds), if any."""
+    if status not in RETRIABLE_HTTP_STATUS:
+        return None
+    err = Retriable("upstream answered {}".format(status))
+    try:
+        err.retry_after = max(0.0, float(retry_after))
+    except (TypeError, ValueError):
+        err.retry_after = 1.0 if status == 503 else 0.5
+    return err
+
+
+def retry_call(fn, attempts=4, base_delay=0.1, max_delay=5.0,
+               sleep=time.sleep, rng=None):
+    """Call ``fn()``, retrying ONLY :class:`Retriable` failures with
+    bounded exponential backoff and full jitter.
+
+    The one client-side retry loop (the fleet router and
+    ``examples/generate``'s HTTP client both use it instead of ad-hoc
+    loops): non-retriable errors — bad requests, real server faults,
+    cancellations — propagate on the first raise; a retriable one is
+    retried up to ``attempts`` total calls, sleeping
+    ``uniform(0, min(max_delay, base_delay * 2**attempt))`` between
+    tries (full jitter — N clients retrying a shed replica must not
+    re-arrive in lockstep). ``exc.retry_after`` refines the delay: a
+    POSITIVE value (the wire's ``Retry-After``) floors it, capped at
+    ``max_delay`` — the server said when a retry is worth attempting,
+    and coming back sooner just buys another refusal; an EXPLICIT
+    ``retry_after == 0`` skips the sleep entirely — the router's
+    failover shape, where the next attempt goes to a DIFFERENT
+    replica and any wait is pure added latency; absent/None means
+    plain jittered backoff. ``sleep``/``rng`` are injectable for
+    deterministic tests; the final attempt's exception propagates
+    unchanged."""
+    rng = rng if rng is not None else random.random
+    attempts = max(1, int(attempts))
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Retriable as e:
+            attempt += 1
+            if attempt >= attempts:
+                raise
+            try:
+                retry_after = float(getattr(e, "retry_after", None))
+            except (TypeError, ValueError):
+                retry_after = None
+            if retry_after is not None and retry_after <= 0.0:
+                continue  # explicit immediate failover: no sleep
+            delay = min(float(max_delay),
+                        float(base_delay) * (2.0 ** (attempt - 1)))
+            delay *= rng()
+            if retry_after is not None:
+                delay = max(delay, min(retry_after, float(max_delay)))
+            if delay > 0.0:
+                sleep(delay)
 
 
 class Cancelled(RuntimeError):
@@ -316,11 +390,19 @@ class DecodeEngine(object):
     def __init__(self, model, params, slots=8, total_len=None,
                  buckets=None, temperature=0.0, top_k=None, top_p=None,
                  eos_token=None, rng=None, counters=None, timers=None,
-                 max_queue=1024, metrics=None, flight=None):
+                 max_queue=1024, metrics=None, flight=None,
+                 replica_id=None):
         import jax
 
         from tensorflowonspark_tpu import generation
 
+        #: stable serving identity (fleet plane): survives respawn() —
+        #: the join key between scraped metric series, /healthz bodies,
+        #: reservation-server serving leases, and router decisions. A
+        #: fresh engine gets a process-unique default; a respawned one
+        #: inherits its predecessor's verbatim.
+        self.replica_id = str(replica_id) if replica_id is not None \
+            else "engine-{}-{}".format(os.getpid(), next(_ENGINE_IDS))
         # construction config, verbatim, so respawn() can rebuild an
         # identical engine after a scheduler death (supervisor.py's
         # RestartEngine policy) — deliberately the ORIGINAL params
@@ -329,7 +411,7 @@ class DecodeEngine(object):
             model=model, params=params, slots=slots, total_len=total_len,
             buckets=buckets, temperature=temperature, top_k=top_k,
             top_p=top_p, eos_token=eos_token, rng=rng,
-            max_queue=max_queue)
+            max_queue=max_queue, replica_id=self.replica_id)
         self._generation = generation
         total_len = int(total_len or model.max_len)
         if total_len > model.max_len:
@@ -402,6 +484,10 @@ class DecodeEngine(object):
         # a cold engine never sheds (no evidence, no refusal).
         self._step_ewma = None
         self._prefill_ewma = None
+        # queue-wait EWMA rides the fleet BEAT lease: the router's
+        # least-loaded policy wants "how long does work wait HERE",
+        # which gauges alone (depth, occupancy) don't price
+        self._qwait_ewma = None
         self._ewma_alpha = 0.3
         self._slot_req = [None] * self.slots
         self._idx = np.zeros(self.slots, np.int32)
@@ -606,6 +692,27 @@ class DecodeEngine(object):
                 "stopping": stopping,
                 "draining": draining,
                 "broken": str(broken) if broken is not None else None}
+
+    def load_stats(self):
+        """Live load + liveness gauges for the fleet plane — the small
+        dict each serving replica's BEAT lease carries and the router's
+        least-loaded dispatch prices: queue depth, slot occupancy,
+        queue-wait EWMA (seconds a request recently waited for a slot),
+        slot count, and the alive/draining verdicts. Cheap (no device
+        work) and safe from any thread."""
+        with self._cv:
+            queue_depth = len(self._queue)
+            occupancy = len(self._active_slots())
+            qwait = self._qwait_ewma
+        health = self.healthy()
+        return {"replica_id": self.replica_id,
+                "queue_depth": queue_depth,
+                "slot_occupancy": occupancy,
+                "slots": self.slots,
+                "queue_wait_ewma_s": round(qwait, 6)
+                if qwait is not None else 0.0,
+                "alive": health["alive"],
+                "draining": health["draining"]}
 
     def outstanding(self):
         """Queued + in-flight request count (the number drain waits on)."""
@@ -836,8 +943,10 @@ class DecodeEngine(object):
                     continue
                 # serving chaos sites: stall_decode_for / a scheduler
                 # kill lands here, between steps — the same boundary
-                # every other scheduling decision uses
-                chaos.on_decode_step(steps)
+                # every other scheduling decision uses (replica_id
+                # scopes an only=<replica> injection to THIS engine of
+                # an in-process fleet)
+                chaos.on_decode_step(steps, self.replica_id)
                 t0 = time.monotonic()
                 with self.timers.timed("decode_step"):
                     self._cache, toks = self._decode_fn(
@@ -922,6 +1031,8 @@ class DecodeEngine(object):
             first = int(first)
         t1 = time.monotonic()
         self._prefill_ewma = self._ewma(self._prefill_ewma, t1 - t0)
+        self._qwait_ewma = self._ewma(self._qwait_ewma,
+                                      t0 - handle.submitted)
         self.flight.span("prefill", t0, t1, trace=handle.trace,
                          bucket=bucket, prompt_len=n)
         handle._decode_t0 = t1
@@ -1238,7 +1349,7 @@ class ModelServer(object):
     """
 
     def __init__(self, model_dir, name="model", host="127.0.0.1", port=8501,
-                 batch_window_ms=0, engine=None):
+                 batch_window_ms=0, engine=None, replica_id=None):
         from tensorflowonspark_tpu import export as export_lib
 
         if model_dir is not None:
@@ -1260,6 +1371,10 @@ class ModelServer(object):
         #: batching LM path; concurrent HTTP requests just submit() and
         #: the engine's scheduler interleaves them at step granularity
         self.engine = engine
+        #: stable serving identity for the fleet plane; defaults to the
+        #: mounted engine's (which survives respawn), so /healthz and
+        #: /metrics series join to router decisions per replica
+        self._replica_id = None if replica_id is None else str(replica_id)
         self._httpd = None
         self._thread = None
         self._host, self._port = host, port
@@ -1394,6 +1509,16 @@ class ModelServer(object):
 
     # -- health (supervision plane) ---------------------------------------
 
+    @property
+    def replica_id(self):
+        """The server's stable serving identity: an explicit
+        construction-time id, else the mounted engine's (stable across
+        ``respawn()``), else None (a bare predict server has no fleet
+        identity)."""
+        if self._replica_id is not None:
+            return self._replica_id
+        return getattr(self.engine, "replica_id", None)
+
     def attach_engine(self, engine):
         """(Re-)arm the :generate path with ``engine`` and clear any
         unhealthy mark — the supervisor's RestartEngine policy calls
@@ -1427,6 +1552,11 @@ class ModelServer(object):
         tracing.Counters — the numbers an operator needs to tell
         "dead" from "saturated" from "retiring"."""
         body = {"status": "ok", "model": self.name}
+        rid = self.replica_id
+        if rid is not None:
+            # pinned schema (fleet plane): the id a scrape or router
+            # joins this replica's series and decisions on
+            body["replica_id"] = rid
         engine = self.engine
         if engine is not None:
             health = engine.healthy()
@@ -1476,9 +1606,19 @@ class ModelServer(object):
         job can target every replica uniformly."""
         engine = self.engine
         registry = getattr(engine, "metrics", None)
-        if registry is None:
-            return tracing.MetricsRegistry().render()
-        return registry.render()
+        text = tracing.MetricsRegistry().render() if registry is None \
+            else registry.render()
+        rid = self.replica_id
+        if rid is not None:
+            # info-pattern gauge: a constant-1 sample whose label IS the
+            # payload, so every scraped tfos_serving_* series from this
+            # replica joins to its stable identity (group_left in
+            # PromQL) without re-labeling the whole exposition
+            info = ('# TYPE tfos_serving_replica_info gauge\n'
+                    'tfos_serving_replica_info{{replica_id="{}"}} 1\n'
+                    .format(rid))
+            text = text.replace("# EOF\n", info + "# EOF\n")
+        return text
 
     def debug_trace(self):
         """Chrome trace-event JSON of the request trace timeline — the
@@ -1659,7 +1799,8 @@ class ModelServer(object):
                     # finish, fresh ones go to another replica
                     return self._send(
                         503, {"error": "server is draining",
-                              "status": "draining"},
+                              "status": "draining",
+                              "kind": "Draining"},
                         headers={"Retry-After": "5"})
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
@@ -1687,9 +1828,14 @@ class ModelServer(object):
                         return
                 except Retriable as e:
                     # shed / draining / engine mid-restart: transient
-                    # by definition, so tell the client WHEN to retry
+                    # by definition, so tell the client WHEN to retry.
+                    # ``kind`` names WHICH transient condition: the
+                    # fleet router treats an EngineFailed as replica
+                    # unhealthiness but a Shed as mere load — both are
+                    # 503 on the wire
                     return self._send(
-                        503, {"error": str(e)},
+                        503, {"error": str(e),
+                              "kind": type(e).__name__},
                         headers={"Retry-After":
                                  str(int(math.ceil(e.retry_after)))})
                 except Exception as e:  # noqa: BLE001 - surface as 500

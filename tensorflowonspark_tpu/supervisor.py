@@ -474,7 +474,8 @@ class Supervisor(object):
 
     # -- serving-plane watch ---------------------------------------------
 
-    def watch(self, engine, server=None, restart=None):
+    def watch(self, engine, server=None, restart=None, router=None,
+              replica=None):
         """Watch a serving ``DecodeEngine``; when its scheduler thread
         dies (or the engine breaks), mark ``server`` (a ``ModelServer``)
         unhealthy so ``GET /healthz`` answers 503 — a dead scheduler
@@ -487,26 +488,92 @@ class Supervisor(object):
         unhealthy mark, /healthz returns to 200). Deliberate deaths —
         ``stop()`` / ``drain()`` flip ``stopping`` first — are never
         resurrected: an operator retiring a replica must not fight its
-        own supervisor."""
+        own supervisor.
+
+        Fleet plane (PR 6): ``router`` (a ``fleet.FleetRouter``) is
+        told to STOP ROUTING first — ``router.quiesce(replica_id)``
+        lands before any restart work, so no fresh request races into
+        the rebuild window — and readmitted only after a successful
+        re-arm. ``replica`` (a ``fleet.Replica``) keeps the watch
+        following the replica's CURRENT engine when something else
+        swaps it (a rolling-drain upgrade re-points the watch at the
+        successor instead of leaving it staring at a deliberately
+        drained corpse)."""
         self._watched.append({"engine": engine, "server": server,
-                              "restart": restart, "restarts": 0,
+                              "restart": restart, "router": router,
+                              "replica": replica, "restarts": 0,
                               "dead": False})
         self.start()
         return self
 
+    def watch_fleet(self, fleet, restart=None):
+        """Watch every replica of a ``fleet.ServingFleet``: a dead
+        replica scheduler quiesces that replica at the router FIRST,
+        then restarts through :class:`RestartEngine` (default policy;
+        pass your own to re-tune), then readmits. One entry per
+        replica, all driven by this supervisor's monitor thread."""
+        for replica in fleet.replicas:
+            self.watch(replica.engine, server=replica.server,
+                       restart=restart if restart is not None
+                       else RestartEngine(),
+                       router=fleet.router, replica=replica)
+        return self
+
     def _check_watched(self):
         for entry in self._watched:
+            replica = entry.get("replica")
+            if replica is not None and replica.engine is not None \
+                    and replica.engine is not entry["engine"]:
+                # the replica's engine was swapped out from under the
+                # watch (rolling-drain upgrade / manual attach_engine):
+                # follow the successor — the old corpse is retired by
+                # design and must not trip a death report. HEAL only
+                # the marks THIS WATCH applied (gated on "marked", and
+                # the router hold is owner-scoped): a poll that read
+                # the dying engine could have quiesced the router /
+                # marked the server unhealthy AFTER the swapper's own
+                # attach+readmit, which would otherwise strand a
+                # healthy replica administratively DOWN forever. A
+                # rolling drain's OWN hold is untouched — it releases
+                # only after its wire-verified /healthz, so the heal
+                # can never readmit an unverified successor on the
+                # drain's behalf.
+                entry["engine"] = replica.engine
+                entry["dead"] = False
+                if entry.pop("marked", False):
+                    rid = getattr(entry["engine"], "replica_id", None)
+                    if entry.get("router") is not None \
+                            and rid is not None:
+                        entry["router"].readmit(rid, owner="supervisor")
+                    if entry.get("server") is not None:
+                        entry["server"].attach_engine(entry["engine"])
             if entry["dead"]:
                 continue
             health = entry["engine"].healthy()
             if health.get("alive"):
+                continue
+            if replica is not None and replica.engine is not None \
+                    and replica.engine is not entry["engine"]:
+                # the engine was swapped between the health read and
+                # now (rolling drain racing this poll): do nothing —
+                # the next poll's swap branch follows and heals
                 continue
             entry["dead"] = True
             reason = "decode engine scheduler dead: {}".format(
                 health.get("broken") or
                 ("stopped" if health.get("stopping")
                  else "scheduler thread exited"))
-            self.events.record("engine_dead", reason=reason)
+            rid = getattr(entry["engine"], "replica_id", None)
+            if entry.get("router") is not None and rid is not None:
+                # fleet ordering contract: the router stops routing to
+                # this replica BEFORE any recovery work, so the rebuild
+                # window never absorbs fresh traffic. "marked" records
+                # that this watch placed marks, so the swap-heal branch
+                # above knows they are its own to clear
+                entry["router"].quiesce(rid, reason, owner="supervisor")
+                entry["marked"] = True
+            self.events.record("engine_dead", reason=reason,
+                               replica=rid)
             # evidence: the ENGINE's flight recorder tail — the spans
             # of the very requests in flight when the scheduler died
             flight = getattr(entry["engine"], "flight", None)
@@ -527,6 +594,7 @@ class Supervisor(object):
                 continue
             if entry["server"] is not None:
                 entry["server"].mark_unhealthy(reason)
+                entry["marked"] = True
 
     def _restart_engine(self, entry, reason):
         """Drive one RestartEngine recovery: decide -> backoff ->
@@ -548,6 +616,7 @@ class Supervisor(object):
                 if server is not None:
                     server.mark_unhealthy(
                         "{} ({})".format(reason, decision.reason))
+                    entry["marked"] = True
                 return
             if server is not None:
                 # 503 for the rebuild window: a restart takes real time
@@ -555,6 +624,7 @@ class Supervisor(object):
                 # route into it
                 server.mark_unhealthy(
                     "engine restarting: {}".format(reason))
+                entry["marked"] = True
             if decision.delay:
                 logger.info("engine restart backing off %.1fs",
                             decision.delay)
@@ -577,6 +647,13 @@ class Supervisor(object):
             fresh.counters.inc("engine_restarts")
             if server is not None:
                 server.attach_engine(fresh)
+            rid = getattr(fresh, "replica_id", None)
+            if entry.get("router") is not None and rid is not None:
+                # re-arm order: engine attached (healthz back to 200)
+                # BEFORE the router resumes routing to this replica;
+                # releases only the supervisor's own hold
+                entry["router"].readmit(rid, owner="supervisor")
+            entry["marked"] = False
             self.events.record("engine_restarted",
                                restarts=entry["restarts"], reason=reason)
             logger.warning("decode engine restarted (restart %d): %s",

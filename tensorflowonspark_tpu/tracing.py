@@ -281,6 +281,49 @@ METRIC_FAMILIES = {
                              "(prefill / decode_step / host_schedule)"),
     "tfos_serving_stage_samples":
         ("counter", "stage", "samples behind tfos_serving_stage_seconds"),
+    "tfos_serving_replica_info":
+        ("gauge", "replica_id", "constant 1 carrying the engine's stable "
+                                "replica identity (join key for scraped "
+                                "series and router decisions)"),
+    # -- fleet plane (FleetRouter registry; router /metrics) --
+    "tfos_fleet_requests":
+        ("counter", "", "requests the router answered (any status)"),
+    "tfos_fleet_failovers":
+        ("counter", "", "upstream attempts abandoned for another replica "
+                        "after a retriable failure"),
+    "tfos_fleet_no_replica":
+        ("counter", "", "dispatch attempts that found no routable replica"),
+    "tfos_fleet_probes":
+        ("counter", "", "half-open health probes sent to down replicas"),
+    "tfos_fleet_client_disconnects":
+        ("counter", "", "dispatches abandoned because the router's own "
+                        "client disconnected (upstream torn down so "
+                        "the replica's disconnect cancel fires)"),
+    "tfos_fleet_replicas":
+        ("gauge", "", "replicas with a live serving lease"),
+    "tfos_fleet_replicas_routable":
+        ("gauge", "", "replicas currently eligible for dispatch"),
+    "tfos_fleet_request_seconds":
+        ("histogram", "", "router-observed request wall clock "
+                          "(all dispatch attempts included)"),
+    "tfos_fleet_upstream_seconds":
+        ("histogram", "", "one upstream POST attempt, wall clock"),
+    "tfos_fleet_route_overhead_seconds":
+        ("histogram", "", "request wall clock minus its upstream "
+                          "attempts (pick + failover bookkeeping)"),
+    "tfos_fleet_stage_seconds":
+        ("counter", "stage", "router wall seconds per stage "
+                             "(pick / upstream)"),
+    "tfos_fleet_stage_samples":
+        ("counter", "stage", "samples behind tfos_fleet_stage_seconds"),
+    "tfos_fleet_replica_up":
+        ("gauge", "replica", "1 when the replica is routable, 0 when "
+                             "down / stale / draining / quiesced"),
+    "tfos_fleet_replica_lease_age_seconds":
+        ("gauge", "replica", "seconds since each replica's last BEAT"),
+    "tfos_fleet_replica_inflight":
+        ("gauge", "replica", "requests the router holds open against "
+                             "each replica"),
     # -- feed plane (DataFeed registry; BEAT-piggybacked to the driver) --
     "tfos_feed_stage_seconds":
         ("counter", "stage", "host-side feed wall seconds per stage "
@@ -588,6 +631,17 @@ def _render(labeled_snapshots):
                 family, _labels(extra), _fmt(snap["n"])))
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
+
+
+def render_labeled(labeled_snapshots):
+    """OpenMetrics text for many ``(label_pairs, snapshot)`` sets under
+    the one grammar-correct multi-snapshot core (each family appears
+    ONCE, carrying one labeled sample set per snapshot). How the fleet
+    router exposes its own registry plus every replica's beat-carried
+    engine snapshot as ``replica``-labeled series in a single
+    document."""
+    return _render([(tuple(labels), snap)
+                    for labels, snap in labeled_snapshots])
 
 
 def merge_snapshots(snapshots):

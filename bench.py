@@ -752,6 +752,191 @@ def _recovery_bench(batch=4, parts=8, kill_step=3, max_restarts=2,
         shutil.rmtree(work, ignore_errors=True)
 
 
+def _resize_map_fun(args, ctx):
+    """Elastic-resize trainer: per-executor checkpoint root, one
+    checkpointed step per batch, same ack-before-step discipline as
+    ``_recovery_map_fun``. Steps once at start so the scoped
+    ``drop_executor_then_return_after`` site fires in the targeted
+    executor BEFORE it consumes anything (whole-executor loss with a
+    clean ledger)."""
+    import json as _json
+    import os as _os
+
+    import numpy as _np
+
+    from tensorflowonspark_tpu import chaos as _chaos
+    from tensorflowonspark_tpu import checkpoint as _checkpoint
+    from tensorflowonspark_tpu import reservation as _reservation
+    from tensorflowonspark_tpu import supervisor as _supervisor
+
+    eid = ctx.executor_id
+    ckpt = _checkpoint.Checkpointer(
+        _os.path.join(args["dir"], "exec-{}".format(eid)), chief=True)
+    like = {"step": _np.array(0, _np.int32),
+            "seen": _np.array(0.0, _np.float64)}
+    restored = ckpt.restore(like, fallback=True)
+    state = restored if restored is not None else like
+    step = int(state["step"])
+    start = step
+    sup = _supervisor.attach(
+        ctx, restored_step=step if restored is not None else None)
+    sup.step(step)  # drop_executor chaos site (scoped by only=EID)
+    feed = ctx.get_data_feed(train_mode=True)
+
+    def _acked_up_to(n):
+        # n counts THIS executor's steps this attempt; the global ack
+        # count is >= it whenever this trainer's own partitions landed
+        # (exact in the single-consumer shrink window, conservative
+        # when siblings consume too)
+        client = _reservation.Client(ctx.cluster_meta["server_addr"])
+        try:
+            return _chaos.poll_until(lambda: len(client.acked()) >= n,
+                                     timeout=60)
+        finally:
+            client.close()
+
+    while not feed.should_stop():
+        batch = feed.next_batch(args["batch"])
+        if not batch:
+            continue
+        step += 1
+        state = {"step": _np.array(step, _np.int32),
+                 "seen": _np.array(float(state["seen"]) + sum(batch),
+                                   _np.float64)}
+        # ack-confirm BEFORE checkpoint: a teardown abort racing the
+        # feeder's join can leave a CONSUMED partition unacked — if
+        # that partition were already in a committed step, replay
+        # would double-feed it. Ordering ack -> save means an unacked
+        # partition is never in saved state: the failure mode is a
+        # clean replay, never a double count. A timed-out ack wait is
+        # the same story (the attempt is being torn down, or the
+        # server is gone): abort THIS step uncommitted.
+        if not _acked_up_to(step - start):
+            raise RuntimeError(
+                "feed ack for step {} never observed; aborting the "
+                "step uncommitted so replay covers it".format(step))
+        ckpt.save(step, state, force=True)
+        ckpt.wait()
+        sup.step(step)  # boundary: chaos kill site AND ResizeDrain site
+    ckpt.close()
+    with open(_os.path.join(args["dir"],
+                            "final-{}.json".format(eid)), "w") as f:
+        # absolute step: this executor's TOTAL consumed partitions
+        # across all of its incarnations (state accumulates through
+        # its own checkpoint chain)
+        _json.dump({"step": step, "seen": float(state["seen"])}, f)
+
+
+def _elastic_finals(ckpt_dir, records, parts):
+    """Sum the per-executor final ledgers of an elastic run; the
+    exactly-once verdict is TOTAL step count == partitions and TOTAL
+    consumed-data sum == the dataset's (nothing lost, nothing
+    double-fed, across every mesh width the job passed through)."""
+    import glob
+    total_steps, total_seen = 0, 0.0
+    for path in glob.glob(os.path.join(ckpt_dir, "final-*.json")):
+        with open(path) as f:
+            final = json.load(f)
+        total_steps += final["step"]
+        total_seen += final["seen"]
+    return {
+        "final_step_total": total_steps,
+        "expected_step": parts,
+        "exactly_once": total_steps == parts and
+        total_seen == float(sum(records)),
+    }
+
+
+def _shrink_recovery_bench(batch=4, parts=8, return_after=3600.0,
+                           heartbeat_interval=0.25, poll_interval=0.1,
+                           regrow_probe_s=3600.0, max_restarts=2):
+    """MTTR of an elastic shrink-by-one: a 2-executor supervised job
+    loses ONE WHOLE EXECUTOR (chaos drops it at the scoped trainer's
+    first step site) and the ElasticResize policy reforms immediately
+    at width 1 — no blacklist permanence, no waiting for a replacement
+    — restoring the survivor's checkpoint and rebalancing the un-ACKed
+    partitions onto the surviving width.
+
+    The published comparison (docs/fault_tolerance.md "Elastic
+    resize"): under RestartFromCheckpoint an executor loss cannot
+    recover at all until capacity returns (reform at fixed width needs
+    the dead executor back), so the honest baseline for MTTR is the
+    full-restart number ``_recovery_bench`` publishes — shrink-by-one
+    must land materially below it, and the detect stage in particular
+    collapses because the engine's liveness view classifies the loss
+    instead of waiting out heartbeat_timeout.
+
+    Defaults measure the SHRINK only (capacity never returns inside
+    the run: ``return_after``/``regrow_probe_s`` are parked at 3600s);
+    tests/test_resize.py's e2e drives the full shrink→regrow cycle.
+    """
+    import shutil
+    import tempfile
+
+    from tensorflowonspark_tpu import chaos, cluster, supervisor
+    from tensorflowonspark_tpu.engine import Context
+
+    work = tempfile.mkdtemp(prefix="tfos-shrink-")
+    ckpt_dir = os.path.join(work, "ckpt")
+    os.makedirs(ckpt_dir)
+    fuse = os.path.join(work, "fuse")
+    records = list(range(batch * parts))
+    try:
+        sc = Context(
+            num_executors=2, work_root=os.path.join(work, "engine"),
+            executor_env={
+                chaos.ENV_VAR:
+                    "drop_executor_then_return_after={},only=1,fuse={}"
+                    .format(return_after, fuse),
+                "TFOS_FEED_TRANSPORT": "queue",
+                "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+        cfg = supervisor.SupervisorConfig(
+            policy=supervisor.ElasticResize(
+                min_width=1, max_restarts=max_restarts, backoff=0.1,
+                regrow_probe_s=regrow_probe_s),
+            heartbeat_interval=heartbeat_interval,
+            heartbeat_timeout=20.0, poll_interval=poll_interval,
+            classify_grace=10.0)
+        t0 = time.monotonic()
+        try:
+            tfc = cluster.run(sc, _resize_map_fun,
+                              {"dir": ckpt_dir, "batch": batch},
+                              num_executors=2,
+                              input_mode=cluster.InputMode.SPARK,
+                              supervise=cfg)
+            tfc.train(sc.parallelize(records, parts), feed_timeout=120)
+        finally:
+            sc.stop()
+        wall = time.monotonic() - t0
+        kill_wall = float(open(fuse).read()) if os.path.exists(fuse) \
+            else None
+        stages = supervisor.recovery_stages(tfc.events, kill_wall=kill_wall)
+        rep = tfc.report()
+        widths = [e["width"] for e in rep["events"]
+                  if e["name"] == "cluster_formed"]
+        block = {
+            "workload": {"partitions": parts, "batch": batch,
+                         "drop_executor": 1,
+                         "policy": "ElasticResize(min_width=1, "
+                                   "max_restarts={})".format(max_restarts)},
+            "injection_fired": kill_wall is not None,
+            "mttr_s": stages.get("mttr_s") if stages else None,
+            "stages": None if stages is None else {
+                k: stages[k] for k in ("detect_s", "reform_s",
+                                       "restore_s", "first_step_s")},
+            "formations": rep["formations"],
+            "widths": widths,
+            "width_changes": rep["width_changes"],
+            "failure_kinds": [f["kind"] for f in rep["failures"]],
+            "acked_partitions": rep["acked_partitions"],
+            "wall_s": round(wall, 3),
+        }
+        block.update(_elastic_finals(ckpt_dir, records, parts))
+        return block
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def _probe_platform():
     """Device platform WITHOUT initializing jax in this process.
 
@@ -914,6 +1099,21 @@ def main():
         except Exception as e:  # noqa: BLE001 - report, not die
             print("recovery bench failed: {}".format(e), file=sys.stderr)
             recovery = {"error": str(e)}
+        # elastic shrink-by-one leg (PR 7): executor loss recovered by
+        # reforming at width-1 instead of waiting for capacity, MTTR
+        # published against the full-restart number above.
+        # TFOS_BENCH_SHRINK=0 skips just this leg.
+        if os.environ.get("TFOS_BENCH_SHRINK", "1") == "1":
+            try:
+                recovery["shrink"] = _shrink_recovery_bench()
+                full = recovery.get("mttr_s")
+                part = recovery["shrink"].get("mttr_s")
+                recovery["shrink_vs_full_restart_mttr"] = \
+                    round(part / full, 3) if full and part else None
+            except Exception as e:  # noqa: BLE001 - report, not die
+                print("shrink bench failed: {}".format(e),
+                      file=sys.stderr)
+                recovery["shrink"] = {"error": str(e)}
 
     # The device-only spin has no engine timeouts around it: a tunnel
     # that dies mid-run (observed round 5 — it served the fed runs then

@@ -57,6 +57,19 @@ Injection points (each checked at an instrumented framework site):
 - ``corrupt_checkpoint=N`` — after ``Checkpointer.save`` commits step N,
   garble every file of that step on disk (fired by checkpoint.py); the
   restore-with-fallback path is the recovery under test.
+- ``drop_executor_then_return_after=T`` — EXECUTOR loss, not trainer
+  crash: at the scoped trainer's first :func:`on_step` site, SIGKILL
+  the whole executor process (the trainer's parent) and then this
+  trainer — the engine sees the connection die and the heartbeat lease
+  expires, the executor-lost signature the ElasticResize policy
+  shrinks on. The value T is the RETURN delay: the driver side pairs
+  the injection with :func:`schedule_executor_return`, which watches
+  the fuse (mandatory for this point — a dropped executor must not
+  re-fire in its revived incarnation) and revives the executor T
+  seconds after the recorded fire time, so "capacity returns" is as
+  deterministic as the drop. ``only=EID`` scoping is effectively
+  required too: an unscoped drop would take down every executor at
+  once.
 
 Serving-plane points (PR 4 — fired at serving.DecodeEngine's
 instrumented sites, so the request-lifecycle story is deterministically
@@ -95,7 +108,7 @@ POINTS = ("kill_trainer_at_step", "kill_trainer_at_batch",
           "kill_trainer_when_queued", "stall_consumer_for",
           "stall_ring_slot", "drop_heartbeats_for", "corrupt_checkpoint",
           "kill_scheduler_at_step", "stall_decode_for",
-          "disconnect_client_at_token")
+          "disconnect_client_at_token", "drop_executor_then_return_after")
 
 
 class SchedulerKilled(RuntimeError):
@@ -191,6 +204,17 @@ def parse_spec(spec):
                 fuse = v
             else:
                 raise ValueError("unknown chaos field %r" % k)
+        if point == "drop_executor_then_return_after" and not fuse:
+            # the fuse is load-bearing here, not just single-shot
+            # bookkeeping: the spec rides executor_env into every
+            # incarnation, so a revived executor would re-fire the
+            # drop forever, and the driver-side return scheduler
+            # reads the fire time from the fuse file
+            raise ValueError(
+                "drop_executor_then_return_after requires fuse=PATH "
+                "(the drop must be single-shot across incarnations "
+                "and the fuse carries the fire time the return "
+                "scheduler needs)")
         out[point] = Injection(point, float(value), only=only, fuse=fuse)
     return out
 
@@ -250,6 +274,31 @@ def on_step(step):
     inj = armed("kill_trainer_at_step")
     if inj is not None and step >= inj.value:
         _kill_self(inj, "step %d >= %g" % (step, inj.value))
+    inj = armed("drop_executor_then_return_after")
+    if inj is not None:
+        _drop_executor(inj, step)
+
+
+def _drop_executor(inj, step):
+    """Fire drop_executor_then_return_after: SIGKILL the executor
+    process (this trainer's parent) and then this trainer — whole-node
+    loss, landing at the step site so the just-committed step stays
+    restorable. Refuses outside a trainer process: the parent of
+    anything else (a pytest runner, say) is not an executor."""
+    if os.environ.get("TFOS_TRAINER_EXECUTOR_ID") is None:
+        raise RuntimeError(
+            "drop_executor_then_return_after can only fire inside a "
+            "trainer process (its parent is the executor to drop); "
+            "this process has no TFOS_TRAINER_EXECUTOR_ID")
+    ppid = os.getppid()
+    logger.error("CHAOS firing drop_executor_then_return_after at step "
+                 "%s: SIGKILL executor pid %d then trainer pid %d "
+                 "(capacity should return %gs after the fuse time)",
+                 step, ppid, os.getpid(), inj.value)
+    inj.mark_fired()
+    if ppid > 1:  # orphaned trainer: the executor is already gone
+        os.kill(ppid, signal.SIGKILL)
+    os.kill(os.getpid(), signal.SIGKILL)
 
 
 def on_batch(feed, batches_served):
@@ -394,6 +443,58 @@ def kill_when(get_pid, trigger, settle=0.5, deadline=60, sig=signal.SIGKILL):
             logger.warning("chaos.kill_when could not fire: %s", e)
 
     t = threading.Thread(target=_assassin, name="chaos-assassin",
+                         daemon=True)
+    t.start()
+    return t
+
+
+def schedule_executor_return(sc, executor_id, fuse, delay=None,
+                             deadline=120):
+    """Driver-side half of ``drop_executor_then_return_after``: wait for
+    the fuse file (its content is the drop's wall-clock fire time),
+    sleep until ``fire_time + delay``, then revive the executor via
+    ``sc.revive_executor`` — deterministic "capacity returns" for the
+    elastic-regrow suite. ``delay`` defaults to the injection armed IN
+    THIS (driver) process; when the spec rides ``executor_env`` only —
+    the usual arrangement — this process has no armed injection, so
+    pass ``delay`` explicitly (a loud warning and delay 0 otherwise).
+    Returns the started thread; a drop that never fires means no
+    revival, and the caller's positive assertion (formations, width
+    history) fails loudly instead of flaking."""
+    if delay is None:
+        inj = _current().get("drop_executor_then_return_after")
+        if inj is None:
+            logger.warning(
+                "schedule_executor_return: no drop_executor_then_"
+                "return_after armed in THIS process (the spec likely "
+                "rides executor_env) — defaulting delay to 0; pass "
+                "delay= explicitly for a deterministic return time")
+            delay = 0.0
+        else:
+            delay = inj.value
+
+    def _returner():
+        if not poll_until(lambda: os.path.exists(fuse), timeout=deadline,
+                          interval=0.05):
+            logger.warning("chaos.schedule_executor_return: fuse %s "
+                           "never appeared; not reviving", fuse)
+            return
+        try:
+            fired_at = float(open(fuse).read())
+        except (OSError, ValueError):
+            fired_at = time.time()
+        wait = fired_at + float(delay) - time.time()
+        if wait > 0:
+            time.sleep(wait)
+        try:
+            logger.warning("CHAOS returning executor %s (capacity back "
+                           "%.2fs after the drop)", executor_id,
+                           time.time() - fired_at)
+            sc.revive_executor(executor_id)
+        except Exception as e:  # noqa: BLE001 - harness must not raise
+            logger.warning("chaos.schedule_executor_return failed: %s", e)
+
+    t = threading.Thread(target=_returner, name="chaos-returner",
                          daemon=True)
     t.start()
     return t

@@ -52,6 +52,57 @@ def is_fully_replicated(state):
     return True
 
 
+def respec_like(state, mesh):
+    """Cross-mesh restore template: ``state``'s shapes/dtypes with every
+    NamedSharding re-bound onto ``mesh``.
+
+    The elastic-resize enabler (docs/fault_tolerance.md "Elastic
+    resize"): GSPMD shardings are declarative — a ``PartitionSpec``
+    names mesh AXES, not devices — so the same state lays out on any
+    mesh whose named axes still factor its shapes. This maps each
+    device-array leaf (``jax.Array`` or ``jax.ShapeDtypeStruct``
+    carrying a ``NamedSharding``) to a ``ShapeDtypeStruct`` with the
+    same spec over ``mesh``; host arrays/scalars pass through
+    unchanged. Feed the result to :meth:`Checkpointer.restore` and
+    orbax reshards the checkpoint onto the new mesh — a save taken at
+    one width restores bitwise at another.
+
+    Raises ``ValueError`` naming the leaf and axis when a spec names an
+    axis ``mesh`` does not have (the one way a resized mesh can fail to
+    carry the old layout — ``respec_for_width`` keeps non-data axes
+    intact precisely so this never fires on a data-axis resize).
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
+    axes = set(mesh.axis_names)
+    out = []
+    for path, leaf in leaves:
+        sharding = getattr(leaf, "sharding", None)
+        if not isinstance(leaf, (jax.Array, jax.ShapeDtypeStruct)) \
+                or not isinstance(sharding, NamedSharding):
+            out.append(leaf)
+            continue
+        spec = sharding.spec
+        named = set()
+        for entry in spec:
+            if entry is None:
+                continue
+            named |= set(entry if isinstance(entry, tuple) else (entry,))
+        missing = named - axes
+        if missing:
+            raise ValueError(
+                "cannot respec leaf {} onto mesh axes {}: its "
+                "PartitionSpec {} names axis(es) {} the target mesh "
+                "does not have".format(
+                    jax.tree_util.keystr(path), sorted(axes), spec,
+                    sorted(missing)))
+        out.append(jax.ShapeDtypeStruct(
+            leaf.shape, leaf.dtype, sharding=NamedSharding(mesh, spec)))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 class Checkpointer(object):
     """Step-indexed train-state checkpoints under ``directory``.
 
@@ -202,6 +253,19 @@ class Checkpointer(object):
         shardings (the TP/PP case), orbax restores each process's shards
         in that layout. Returns the restored state, or None if no
         checkpoint exists.
+
+        Cross-mesh restore (elastic resize): ``state_like`` may carry
+        shardings over a DIFFERENT mesh shape than the save — e.g. a
+        checkpoint saved at data-width N restored onto a width N-1 (or
+        N+1) mesh built by ``respec_for_width``. Shardings are
+        declarative over mesh axes, so orbax reshards on read; use
+        :func:`respec_like` to rebind a template's shardings onto the
+        new mesh. The participation contract mirrors :meth:`save`'s:
+        under ``jax.distributed`` the restore is a COLLECTIVE — every
+        process of the NEW mesh must call ``restore`` with the same
+        step and the same (process-uniform) ``state_like`` shardings,
+        or the readers deadlock at orbax's barrier; single-process
+        restores have no such constraint (all shards are addressable).
 
         ``fallback=True`` (the recovery posture — supervisor.py's
         RestartFromCheckpoint contract assumes it): when the chosen step

@@ -225,7 +225,7 @@ def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
         master_node="chief", reservation_timeout=reservation.DEFAULT_TIMEOUT,
         queues=("input", "output", "error"), eval_node=False,
         manager_mode="local", filesystems=None, supervise=None,
-        exclude_executors=(), beat_interval=None):
+        exclude_executors=(), beat_interval=None, prefer_alive=False):
     """Start a cluster: one node per executor, roles per the template.
 
     Reference: ``TFCluster.run`` (SURVEY.md §3.1). ``num_ps`` is accepted
@@ -290,17 +290,21 @@ def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
             "eval_node={} but num_executors={}".format(
                 needed, num_ps, eval_node, num_executors))
     exclude = frozenset(exclude_executors or ())
-    if exclude:
-        # Blacklist (supervision plane): form the cluster on the first
-        # num_executors alive, non-excluded engine executors. Needs the
-        # built-in engine's liveness view; a Spark sc has no analog.
-        alive = getattr(sc, "executors_alive", None)
-        if alive is None:
-            raise NotImplementedError(
-                "exclude_executors requires the built-in engine "
-                "(Context.executors_alive); Spark contexts cannot "
-                "blacklist at this layer")
-        executor_ids = [e for e in alive() if e not in exclude]
+    alive_fn = getattr(sc, "executors_alive", None)
+    if exclude and alive_fn is None:
+        raise NotImplementedError(
+            "exclude_executors requires the built-in engine "
+            "(Context.executors_alive); Spark contexts cannot "
+            "blacklist at this layer")
+    if alive_fn is not None and (exclude or prefer_alive):
+        # Supervision plane (Blacklist exclusions, ElasticResize
+        # reforms): form the cluster on the first num_executors ALIVE,
+        # non-excluded engine executors — after an executor loss the
+        # surviving ids are not range(num_executors), and a shrunken
+        # or regrown width must land on whatever capacity exists NOW.
+        # Needs the built-in engine's liveness view; a Spark sc has no
+        # analog (prefer_alive simply falls back to range there).
+        executor_ids = [e for e in alive_fn() if e not in exclude]
         if len(executor_ids) < num_executors:
             raise RuntimeError(
                 "cluster needs {} executors but only {} are alive and "
@@ -326,6 +330,10 @@ def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
     # 2. reservation barrier on the driver.
     server = reservation.Server(num_executors)
     server_addr = server.start()
+    # width gauge (elastic resize observability): this formation's
+    # width; a SupervisedCluster overrides the target with the job's
+    # configured width so a shrunken attempt reads degraded
+    server.set_cluster_width(num_executors, target=num_executors)
 
     # 3. cluster metadata shipped to every node task.
     cluster_id = "{}-{}".format(

@@ -265,8 +265,10 @@ class Context(object):
         # executors, so standalone-mode launchers can read it and start
         # `python -m tensorflowonspark_tpu.engine.executor` on each host.
         self.authkey_file = self._write_connection_info()
+        self._spawn_local = spawn_local
+        self._executor_env = dict(executor_env or {})
         if spawn_local:
-            self._spawn_local_executors(executor_env or {})
+            self._spawn_local_executors(self._executor_env)
         self._await_executors(start_timeout)
 
     # -- bootstrap -------------------------------------------------------
@@ -285,28 +287,33 @@ class Context(object):
         return authkey_file
 
     def _spawn_local_executors(self, executor_env):
-        authkey_file = self.authkey_file
-        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
         for i in range(self.num_executors):
-            env = dict(os.environ)
-            env.update(executor_env)
-            env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-            work_dir = os.path.join(self.work_root, "executor-%d" % i)
-            os.makedirs(work_dir, exist_ok=True)
-            log_path = os.path.join(work_dir, "executor.log")
-            logfh = open(log_path, "ab")
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "tensorflowonspark_tpu.engine.executor",
-                 "--driver", "{}:{}".format(*self.driver_addr),
-                 "--executor-id", str(i),
-                 "--authkey-file", authkey_file,
-                 "--work-dir", work_dir],
-                env=env, stdout=logfh, stderr=subprocess.STDOUT)
-            logfh.close()
-            self._procs.append(proc)
+            self._spawn_one(i, executor_env)
         logger.info("spawned %d local executors (logs under %s)",
                     self.num_executors, self.work_root)
+
+    def _spawn_one(self, executor_id, executor_env=None):
+        """Spawn one local executor process; returns the Popen handle."""
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env.update(executor_env if executor_env is not None
+                   else self._executor_env)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        work_dir = os.path.join(self.work_root, "executor-%d" % executor_id)
+        os.makedirs(work_dir, exist_ok=True)
+        log_path = os.path.join(work_dir, "executor.log")
+        logfh = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tensorflowonspark_tpu.engine.executor",
+             "--driver", "{}:{}".format(*self.driver_addr),
+             "--executor-id", str(executor_id),
+             "--authkey-file", self.authkey_file,
+             "--work-dir", work_dir],
+            env=env, stdout=logfh, stderr=subprocess.STDOUT)
+        logfh.close()
+        self._procs.append(proc)
+        return proc
 
     def _accept_loop(self):
         while not self._stopping.is_set():
@@ -455,6 +462,47 @@ class Context(object):
     def executors_alive(self):
         with self._lock:
             return sorted(eid for eid, h in self._handles.items() if h.alive)
+
+    def revive_executor(self, executor_id, timeout=60):
+        """Respawn a dead local executor under its original id — the
+        "capacity returns" half of the supervision plane's elastic
+        resize (an ElasticResize regrow probe watches
+        :meth:`executors_alive` recover). The replacement process
+        reuses the executor's work dir and registers through the normal
+        accept loop (the duplicate-id guard passes because the old
+        handle is dead). Returns False if the executor is already
+        alive; raises in standalone mode (the launcher owns process
+        placement there) or when the replacement fails to register
+        within ``timeout``."""
+        executor_id = int(executor_id)
+        with self._lock:
+            handle = self._handles.get(executor_id)
+            if handle is not None and handle.alive:
+                return False
+        if not self._spawn_local:
+            raise NotImplementedError(
+                "revive_executor requires local mode; standalone "
+                "launchers must restart their own executor processes")
+        if self._stopping.is_set():
+            raise RuntimeError("context is stopping; not reviving")
+        proc = self._spawn_one(executor_id)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                handle = self._handles.get(executor_id)
+                if handle is not None and handle.alive:
+                    logger.info("executor %d revived (pid %d)",
+                                executor_id, proc.pid)
+                    return True
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    "revived executor {} exited with code {} during "
+                    "startup; see logs under {}".format(
+                        executor_id, proc.returncode, self.work_root))
+            time.sleep(0.05)
+        raise TimeoutError(
+            "revived executor {} did not register within {}s".format(
+                executor_id, timeout))
 
     def _on_handle_dead(self, handle):
         """Reap a dead executor: fail its pinned tasks, and if no executors

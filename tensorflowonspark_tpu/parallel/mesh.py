@@ -25,6 +25,61 @@ SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
 
 
+def respec_for_width(axis_shapes, n_devices, resize_axis=DATA_AXIS):
+    """Re-derive ``axis_shapes`` for a different device count.
+
+    The elastic-resize enabler (GSPMD named shardings are declarative
+    over a ``Mesh``, so the same application state lays out on any
+    device count that factors): shrink or grow the ``resize_axis``
+    (default ``data``) so the product matches ``n_devices``, while the
+    model/stage/seq/expert axes keep their sizes — their collectives
+    and weight shards are what the program's shardings were written
+    against, so they must not silently change shape.
+
+    Raises ``ValueError`` (loudly, naming the failing axes) when the
+    fixed axes cannot factor into ``n_devices`` — the caller (the
+    supervisor's ElasticResize policy) must treat that as "this width
+    is not reachable", not retry.
+
+    Returns a new ordered ``{axis: size}`` dict; the resize axis is
+    inserted outermost when it was absent (DP outermost is the hybrid
+    DCN/ICI convention).
+    """
+    n = int(n_devices)
+    if n < 1:
+        raise ValueError(
+            "cannot respec a mesh for {} devices".format(n))
+    shapes = dict(axis_shapes or {resize_axis: n})
+    fixed = {a: int(s) for a, s in shapes.items() if a != resize_axis}
+    for axis, size in fixed.items():
+        if size == -1:
+            raise ValueError(
+                "cannot respec for width: axis {!r} is -1 (inferred); "
+                "only the {!r} axis may change size across a resize — "
+                "resolve the shape with build_mesh first".format(
+                    axis, resize_axis))
+        if size < 1:
+            raise ValueError(
+                "cannot respec for width: axis {!r} has invalid size "
+                "{}".format(axis, size))
+    known = math.prod(fixed.values()) if fixed else 1
+    if n % known:
+        raise ValueError(
+            "cannot lay out {} devices: the fixed axes {} occupy {} "
+            "devices per {!r}-slice and {} % {} != 0 — the {!r} axis "
+            "cannot absorb the remainder. Reachable widths are "
+            "multiples of {}.".format(
+                n, fixed, known, resize_axis, n, known, resize_axis,
+                known))
+    width = n // known
+    out = {}
+    if resize_axis not in shapes:
+        out[resize_axis] = width
+    for axis in shapes:
+        out[axis] = width if axis == resize_axis else shapes[axis]
+    return out
+
+
 def build_mesh(axis_shapes=None, devices=None):
     """Build a ``jax.sharding.Mesh``.
 
@@ -54,10 +109,22 @@ def build_mesh(axis_shapes=None, devices=None):
     if sizes.count(-1) > 1:
         raise ValueError("at most one mesh axis may be -1")
     if -1 in sizes:
+        inferred = names[sizes.index(-1)]
         known = math.prod(s for s in sizes if s != -1)
-        if known == 0 or n % known:
+        if known == 0:
+            # distinct from the non-divisible case below: n % 0 is a
+            # crash and known == 0 means ANOTHER axis was given size 0,
+            # which no device count can satisfy
+            zeros = [a for a, s in zip(names, sizes) if s == 0]
             raise ValueError(
-                "cannot infer -1 axis: {} devices over {}".format(n, sizes))
+                "cannot infer axis {!r}: axis(es) {} have size 0 in "
+                "{}".format(inferred, zeros,
+                            dict(zip(names, sizes))))
+        if n % known:
+            raise ValueError(
+                "cannot infer axis {!r}: {} devices do not divide by "
+                "the known axes' product {} ({})".format(
+                    inferred, n, known, dict(zip(names, sizes))))
         sizes[sizes.index(-1)] = n // known
     total = math.prod(sizes)
     if total != n:
@@ -86,7 +153,9 @@ def build_hybrid_mesh(dcn_axis_shapes, ici_axis_shapes, devices=None):
       dcn_axis_shapes: ordered ``{axis: size}`` across slices
         (e.g. ``{"data": n_slices}``).
       ici_axis_shapes: ordered ``{axis: size}`` within a slice
-        (e.g. ``{"model": 8}``). Axis names must not overlap.
+        (e.g. ``{"model": 8}``). Axis names must not overlap. One axis
+        across BOTH dicts may be ``-1`` (inferred so the product equals
+        the device count, same contract as :func:`build_mesh`).
 
     Returns a ``jax.sharding.Mesh`` with the DCN axes first.
     """
@@ -105,14 +174,68 @@ def build_hybrid_mesh(dcn_axis_shapes, ici_axis_shapes, devices=None):
     ici_names = list(ici_axis_shapes)
     dcn_sizes = [int(s) for s in dcn_axis_shapes.values()]
     ici_sizes = [int(s) for s in ici_axis_shapes.values()]
+    n = len(devices)
+    all_names = dcn_names + ici_names
+    all_sizes = dcn_sizes + ici_sizes
+    if all_sizes.count(-1) > 1:
+        raise ValueError(
+            "at most one hybrid mesh axis (across dcn and ici shapes) "
+            "may be -1; got {} and {}".format(dict(dcn_axis_shapes),
+                                              dict(ici_axis_shapes)))
+    if -1 in all_sizes:
+        # same two-case split as build_mesh: a 0-sized sibling axis vs
+        # a device count the known axes' product does not divide
+        inferred = all_names[all_sizes.index(-1)]
+        known = math.prod(s for s in all_sizes if s != -1)
+        if known == 0:
+            zeros = [a for a, s in zip(all_names, all_sizes) if s == 0]
+            raise ValueError(
+                "cannot infer hybrid axis {!r}: axis(es) {} have size "
+                "0 in dcn={} ici={}".format(
+                    inferred, zeros, dict(dcn_axis_shapes),
+                    dict(ici_axis_shapes)))
+        if n % known:
+            raise ValueError(
+                "cannot infer hybrid axis {!r}: {} devices do not "
+                "divide by the known axes' product {} (dcn={} "
+                "ici={})".format(inferred, n, known,
+                                 dict(dcn_axis_shapes),
+                                 dict(ici_axis_shapes)))
+        idx = all_sizes.index(-1)
+        if idx < len(dcn_sizes):
+            dcn_sizes[idx] = n // known
+        else:
+            ici_sizes[idx - len(dcn_sizes)] = n // known
     total = math.prod(dcn_sizes) * math.prod(ici_sizes)
-    if total != len(devices):
+    if total != n:
         raise ValueError(
             "hybrid mesh dcn={} x ici={} needs {} devices but {} are "
-            "available".format(dict(dcn_axis_shapes),
-                               dict(ici_axis_shapes), total, len(devices)))
+            "available".format(dict(zip(dcn_names, dcn_sizes)),
+                               dict(zip(ici_names, ici_sizes)), total, n))
     slice_ids = {getattr(d, "slice_index", None) for d in devices}
     if len(slice_ids) > 1:
+        # Factoring pre-check with a layout-specific message: the
+        # generic shape error out of create_hybrid_device_mesh names
+        # array dims, not which NETWORK the user got wrong. DCN axes
+        # must jointly equal the slice count and ICI axes the
+        # per-slice device count — anything else would put an "ICI"
+        # axis across a slice boundary and quietly ride DCN.
+        n_slices = len(slice_ids)
+        if math.prod(dcn_sizes) != n_slices:
+            raise ValueError(
+                "hybrid mesh cannot factor onto this topology: dcn "
+                "axes {} multiply to {} but the hardware has {} "
+                "slices — dcn axes must exactly cover the slice "
+                "count".format(dict(zip(dcn_names, dcn_sizes)),
+                               math.prod(dcn_sizes), n_slices))
+        if math.prod(ici_sizes) != n // n_slices:
+            raise ValueError(
+                "hybrid mesh cannot factor onto this topology: ici "
+                "axes {} multiply to {} but each slice has {} "
+                "devices — an ici axis crossing the slice boundary "
+                "would silently ride DCN".format(
+                    dict(zip(ici_names, ici_sizes)),
+                    math.prod(ici_sizes), n // n_slices))
         # Real multi-slice hardware: use the topology-aware layout and
         # let genuine errors (shapes that cannot factor into slices)
         # surface — a silent reshape here would put an "ICI" axis across

@@ -163,6 +163,13 @@ class Server(object):
         self._sup_lock = threading.Lock()
         self._leases = {}   # executor_id -> (monotonic recv time, payload)
         self._acked = set()  # partition ids fully consumed by a trainer
+        # elastic-resize bookkeeping (ONE source of truth for width:
+        # SupervisedCluster sets these at every formation, so /metrics
+        # and /stats show the live attempt's width vs the configured
+        # target — a shrunken job is visibly degraded, not implicit in
+        # Decision.exclude set arithmetic)
+        self._cluster_width = None
+        self._cluster_width_target = None
 
     def lease_snapshot(self):
         """{executor_id: {"age": seconds since last beat, "payload": ...}}
@@ -176,6 +183,26 @@ class Server(object):
         """Partition ids acknowledged as fully consumed (stable copy)."""
         with self._sup_lock:
             return set(self._acked)
+
+    def set_cluster_width(self, width, target=None):
+        """Publish this formation's width (and the job's configured
+        target width) for the driver-side /metrics and /stats views —
+        ``tfos_cluster_width`` / ``tfos_cluster_width_target``."""
+        with self._sup_lock:
+            self._cluster_width = None if width is None else int(width)
+            if target is not None:
+                self._cluster_width_target = int(target)
+
+    def cluster_gauges(self):
+        """{family: value} of the width gauges (only those set)."""
+        with self._sup_lock:
+            out = {}
+            if self._cluster_width is not None:
+                out["tfos_cluster_width"] = self._cluster_width
+            if self._cluster_width_target is not None:
+                out["tfos_cluster_width_target"] = \
+                    self._cluster_width_target
+            return out
 
     def serving_snapshot(self):
         """{replica_id: serving-replica view} from leases whose BEAT
@@ -258,11 +285,19 @@ class Server(object):
                 if self.path == "/metrics":
                     code, ctype = 200, tracing.OPENMETRICS_CONTENT_TYPE
                     body = tracing.render_cluster(
-                        server.metrics_snapshot()).encode("utf-8")
+                        server.metrics_snapshot(),
+                        cluster_gauges=server.cluster_gauges()) \
+                        .encode("utf-8")
                 elif self.path == "/stats":
                     code, ctype = 200, "application/json"
                     stats = tracing.cluster_rollup(
                         server.metrics_snapshot())
+                    # elastic resize: live width vs configured target
+                    gauges = server.cluster_gauges()
+                    stats["cluster"]["width"] = gauges.get(
+                        "tfos_cluster_width")
+                    stats["cluster"]["width_target"] = gauges.get(
+                        "tfos_cluster_width_target")
                     # fleet plane: per-replica serving view (lease age,
                     # addr, load gauges) keyed by replica_id — the
                     # operator's "what is the router seeing" endpoint.

@@ -34,6 +34,15 @@ Recovery policies:
 - :class:`Blacklist` — additionally exclude an executor that failed
   ``max_failures`` times and reform the cluster at reduced width (the
   built-in engine's job scheduler honors the exclusion).
+- :class:`ElasticResize` — width as a recoverable dimension: on
+  executor loss, reform IMMEDIATELY at width-1 (no blacklist
+  permanence, no waiting for a replacement) with un-ACKed feed
+  partitions rebalanced across the surviving width; a regrow probe
+  watches engine capacity and reforms back up at the next checkpoint
+  boundary (cooperative :class:`ResizeDrain` at the step site).
+  Cross-mesh checkpoint restore (``checkpoint.respec_like`` +
+  ``parallel.mesh.respec_for_width``) is what makes the width change
+  transparent to sharded state.
 - :class:`FailJob` — clean teardown, error re-raised on the driver
   (exactly today's unsupervised behavior, made explicit).
 - :class:`RestartEngine` — the SERVING-plane policy (PR 4): a watched
@@ -114,18 +123,26 @@ class FailureEvent(object):
 
 
 class Decision(object):
-    """A policy's verdict on one failure."""
+    """A policy's verdict on one failure.
 
-    __slots__ = ("action", "delay", "exclude", "reason")
+    ``RESIZE`` (elastic resize): reform at ``width`` — no blacklist
+    permanence, no waiting for a replacement executor; the
+    SupervisedCluster rebalances un-ACKed feed partitions across the
+    new width through the existing per-partition ACK ledger."""
+
+    __slots__ = ("action", "delay", "exclude", "reason", "width")
 
     FAIL = "fail"
     RESTART = "restart"
+    RESIZE = "resize"
 
-    def __init__(self, action, delay=0.0, exclude=frozenset(), reason=""):
+    def __init__(self, action, delay=0.0, exclude=frozenset(), reason="",
+                 width=None):
         self.action = action
         self.delay = float(delay)
         self.exclude = frozenset(exclude)
         self.reason = reason
+        self.width = None if width is None else int(width)
 
 
 class FailJob(object):
@@ -133,7 +150,7 @@ class FailJob(object):
     unsupervised default, made explicit and composable)."""
 
     def decide(self, event, restarts, failure_counts, excluded,
-               num_executors):
+               num_executors, width=None):
         return Decision(Decision.FAIL,
                         reason="FailJob policy: no recovery attempted")
 
@@ -158,7 +175,7 @@ class RestartFromCheckpoint(object):
         self.max_backoff = float(max_backoff)
 
     def decide(self, event, restarts, failure_counts, excluded,
-               num_executors):
+               num_executors, width=None):
         if restarts >= self.max_restarts:
             return Decision(
                 Decision.FAIL,
@@ -186,7 +203,7 @@ class Blacklist(RestartFromCheckpoint):
         self.min_width = int(min_width)
 
     def decide(self, event, restarts, failure_counts, excluded,
-               num_executors):
+               num_executors, width=None):
         base = super(Blacklist, self).decide(
             event, restarts, failure_counts, excluded, num_executors)
         if base.action == Decision.FAIL:
@@ -206,6 +223,79 @@ class Blacklist(RestartFromCheckpoint):
                 sorted(newly), width_after)
         return Decision(Decision.RESTART, delay=base.delay, exclude=newly,
                         reason=reason)
+
+
+class ElasticResize(RestartFromCheckpoint):
+    """Width as a RECOVERABLE dimension: on executor loss, reform
+    immediately at width-1 instead of blacklisting (no permanence) or
+    waiting for a replacement; when capacity returns, a regrow probe
+    reforms back up at the next checkpoint boundary.
+
+    Mechanics (docs/fault_tolerance.md "Elastic resize"):
+
+    - ``executor_lost`` / ``reform_failed`` → ``Decision.RESIZE`` at
+      the current width minus one (floored at ``min_width``; below it
+      the job fails honestly). Un-ACKed feed partitions rebalance
+      across the surviving width through the existing per-partition
+      ACK ledger — nothing is lost, nothing double-fed.
+    - ``shrink_grace_s``: before committing the shrink, the
+      SupervisedCluster polls engine liveness for this long — a
+      flapping executor that returns inside the grace keeps the
+      original width (reform, not resize).
+    - Regrow: during an attempt running below ``max_width`` (default:
+      the job's configured width), the SupervisedCluster probes engine
+      capacity every ``regrow_probe_s``; when spare executors exist it
+      requests a BOUNDARY DRAIN — every trainer raises
+      :class:`ResizeDrain` at its next ``TrainerSide.step`` site,
+      which is AFTER that step's checkpoint committed and its
+      partition was acked, so the reform up is exactly-once by the
+      same argument as the chaos kill site.
+    - Other failure kinds (trainer crash at intact width) fall back to
+      the inherited same-width RestartFromCheckpoint behavior.
+
+    ``max_restarts`` bounds ALL recovery reforms (shrinks included) so
+    a flapping fleet cannot reform forever.
+    """
+
+    def __init__(self, min_width=1, max_width=None, shrink_grace_s=0.0,
+                 regrow_probe_s=0.5, max_restarts=8, **kw):
+        super(ElasticResize, self).__init__(max_restarts=max_restarts,
+                                            **kw)
+        self.min_width = int(min_width)
+        self.max_width = None if max_width is None else int(max_width)
+        self.shrink_grace_s = float(shrink_grace_s)
+        self.regrow_probe_s = float(regrow_probe_s)
+
+    def decide(self, event, restarts, failure_counts, excluded,
+               num_executors, width=None):
+        base = super(ElasticResize, self).decide(
+            event, restarts, failure_counts, excluded, num_executors)
+        if base.action == Decision.FAIL:
+            return base
+        if event.kind not in ("executor_lost", "reform_failed"):
+            return base  # intact width: plain restart-from-checkpoint
+        width = int(width) if width is not None \
+            else num_executors - len(excluded)
+        target = width - 1
+        if target < self.min_width:
+            return Decision(
+                Decision.FAIL,
+                reason="cannot shrink below min_width={} (width was "
+                       "{})".format(self.min_width, width))
+        return Decision(
+            Decision.RESIZE, width=target,
+            reason="{}; shrinking {} -> {} (no replacement "
+                   "awaited)".format(base.reason, width, target))
+
+
+class ResizeDrain(RuntimeError):
+    """Raised by ``TrainerSide.step`` when the driver requested a
+    boundary drain (elastic regrow): the trainer exits AT the
+    checkpoint boundary — the just-committed step is restorable and
+    its partition acked — so the reform up to the new width replays
+    exactly the unconsumed remainder. Supervision-aware map_funs let
+    it propagate (the supervisor treats the resulting attempt end as
+    planned, not as a failure)."""
 
 
 class RestartEngine(object):
@@ -292,12 +382,18 @@ class Supervisor(object):
     """
 
     def __init__(self, server=None, executors=(), config=None, events=None,
-                 attempt=1):
+                 attempt=1, alive_fn=None):
         self.server = server
         self.executors = list(executors)
         self.config = config or SupervisorConfig()
         self.events = events if events is not None else tracing.EventLog()
         self.attempt = attempt
+        #: optional engine liveness view (Context.executors_alive): an
+        #: executor whose process the ENGINE has already seen die is
+        #: classified executor_lost immediately instead of waiting out
+        #: heartbeat_timeout — the detect-stage win the elastic shrink
+        #: MTTR leg measures
+        self.alive_fn = alive_fn
         self._lock = threading.Lock()
         self._failures = []
         self._failure_evt = threading.Event()
@@ -340,12 +436,33 @@ class Supervisor(object):
         """One classification pass (the monitor thread's body; exposed
         so unit tests drive it deterministically without the thread)."""
         now = now if now is not None else time.monotonic()
+        self._classify_engine_liveness()
         if self.server is not None:
             leases = self.server.lease_snapshot()
             for event in self._classify(leases, now):
                 self._report(event)
             self._track_recovery(leases)
         self._check_watched()
+
+    def _classify_engine_liveness(self):
+        """Fast-path executor-lost detection from the engine's own
+        liveness view: a lost connection is definitive (and near
+        instant) evidence, so don't wait out heartbeat_timeout for the
+        lease to age. Lease classification remains the backstop for
+        engines without the view (Spark) and for processes that go
+        dark without dying."""
+        if self.alive_fn is None:
+            return
+        try:
+            alive = set(self.alive_fn())
+        except Exception:  # noqa: BLE001 - liveness view is best-effort
+            return
+        for eid in self.executors:
+            if eid not in self._reported and eid not in alive:
+                self._report(FailureEvent(
+                    "executor_lost", eid,
+                    "engine reports the executor process gone "
+                    "(connection lost)"))
 
     def _classify(self, leases, now):
         """Lease snapshot -> new FailureEvents (one per executor, ever:
@@ -735,8 +852,15 @@ class TrainerSide(object):
     restorable at N.
     """
 
+    #: seconds between resize_drain polls in :meth:`step` — the drain
+    #: check is one extra broker RPC, so fast step loops only pay it
+    #: ~4x/second instead of per step; a pending drain is still caught
+    #: at a step boundary, just up to this much later
+    drain_poll_interval = 0.25
+
     def __init__(self, mgr, restored_step=None):
         self.mgr = mgr
+        self._drain_checked = float("-inf")
         if restored_step is not None:
             self.report_restore(restored_step)
 
@@ -748,6 +872,20 @@ class TrainerSide(object):
         from tensorflowonspark_tpu import chaos
         self.mgr.set("train_step", int(step))
         chaos.on_step(int(step))
+        # elastic regrow: the step site IS the checkpoint boundary
+        # (callers publish AFTER the step's checkpoint committed and
+        # its partition acked — the same discipline the chaos kill
+        # site rides), so a driver-requested boundary drain exits here
+        # and the reform up is exactly-once by construction
+        now = time.monotonic()
+        if now - self._drain_checked < self.drain_poll_interval:
+            return
+        self._drain_checked = now
+        target = self.mgr.get("resize_drain")
+        if target is not None:
+            raise ResizeDrain(
+                "resize drain requested at step {} (reforming at "
+                "width {})".format(int(step), target))
 
     def hook(self, base=0):
         """``Trainer.train_loop`` hook: publishes ``base + step_no``."""
@@ -846,6 +984,14 @@ class SupervisedCluster(object):
         self.failure_counts = {}
         self.attempts = []          # one dict per FAILED attempt
         self.formations = 0
+        #: the ONE width source of truth (elastic resize): every
+        #: formation is exactly this wide. Blacklist exclusions and
+        #: RESIZE decisions both update it (and record width_change),
+        #: so /metrics' tfos_cluster_width gauge, the EventLog, and the
+        #: formation math can never disagree.
+        self.width = int(num_executors)
+        self._resize_target = None  # planned regrow width, drain sent
+        self._last_probe = 0.0
         self._acked = set()
         self._last_metrics = None   # rollup harvested before teardown
         self._tfc = None
@@ -918,9 +1064,33 @@ class SupervisedCluster(object):
                 failure = self._final_shutdown()
                 if failure is None:
                     self._done = True
+                    self._resize_target = None  # drain raced completion
                     self.events.record("job_complete",
                                        formations=self.formations)
                     return
+            if self._resize_target is not None:
+                if failure.kind in ("executor_lost", "feeder_stall",
+                                    "ring_wedge", "reform_failed"):
+                    # a REAL failure landed inside the drain window —
+                    # kinds the drain itself can never produce (its
+                    # trainers exit with code 1, classifying as
+                    # trainer_crash/task_failure). The planned resize
+                    # is moot: capacity just changed under it, so the
+                    # policy must decide with the failure on the books
+                    self._resize_target = None
+                    self._recover_or_raise(failure)
+                    continue
+                # planned boundary drain (elastic regrow), not a real
+                # failure: the trainers exited via ResizeDrain at their
+                # checkpoint boundary — reform at the target width
+                # without consulting the policy or advancing
+                # failure_counts. (A genuine trainer crash racing the
+                # drain is indistinguishable from the drain's own exit
+                # and rides this path too — bounded at one uncounted
+                # reform per regrow, and the reformed attempt's own
+                # failures count normally.)
+                self._complete_resize(failure)
+                continue
             self._recover_or_raise(failure)
 
     def inference(self, dataRDD, feed_timeout=600, qname="output"):
@@ -967,6 +1137,10 @@ class SupervisedCluster(object):
         return {
             "formations": self.formations,
             "failures": [a["failure"] for a in self.attempts],
+            "width": self.width,
+            "width_changes": [
+                {k: e[k] for k in ("from_width", "to_width", "reason")}
+                for e in self.events.events("width_change")],
             "excluded": sorted(self.excluded),
             "acked_partitions": len(self._acked),
             "recovery": recovery_stages(self.events),
@@ -976,20 +1150,26 @@ class SupervisedCluster(object):
     # -- attempt machinery -----------------------------------------------
 
     def _form(self):
-        width = self.num_executors - len(self.excluded)
+        width = self.width
         attempt_no = len(self.attempts) + 1
         self.events.record("reform_start", attempt=attempt_no, width=width)
         tfc = self._cluster_mod.run(
             self.sc, self.map_fun, self.tf_args, width,
             exclude_executors=frozenset(self.excluded),
             beat_interval=self.config.heartbeat_interval,
+            prefer_alive=True,
             **self.run_kwargs)
         self.formations += 1
         self._tfc = tfc
+        # width gauge: this formation's width against the job's
+        # CONFIGURED width — width < target on /metrics is the
+        # operator's "running degraded after a shrink" signal
+        tfc.server.set_cluster_width(width, target=self.num_executors)
         self._supervisor = Supervisor(
             server=tfc.server, executors=tfc.executor_ids,
             config=self.config, events=self.events,
-            attempt=attempt_no).start()
+            attempt=attempt_no,
+            alive_fn=getattr(self.sc, "executors_alive", None)).start()
         self.events.record("cluster_formed", attempt=attempt_no,
                            width=width, executors=list(tfc.executor_ids))
 
@@ -998,20 +1178,36 @@ class SupervisedCluster(object):
         mapped = dataRDD.mapPartitionsWithIndex(acked_feed(
             tfc.cluster_info, tfc.cluster_meta, frozenset(self._acked),
             feed_timeout=feed_timeout, qname=qname))
-        kwargs = {"exclude": tfc.exclude} if tfc.exclude else {}
+        # feed tasks may only run on executors HOSTING this formation's
+        # nodes: after an elastic shrink (or mid-attempt regrow of
+        # capacity) the engine can have alive executors that are not
+        # cluster members, and a feed task landing there has no node to
+        # feed. Blacklist exclusions fold into the same set.
+        exclude = set(tfc.exclude)
+        members = set(tfc.executor_ids)
+        universe = set(range(self.num_executors)) | \
+            set(self._capacity() or ())
+        exclude |= universe - members
+        kwargs = {"exclude": frozenset(exclude)} if exclude else {}
         result = mapped.foreachPartitionAsync(_drain_iter, **kwargs)
-        failure = self._await_result(result)
+        failure = self._await_result(result, probe=self._regrow_probe)
         # harvest acks even on failure: the next attempt must not replay
         # what this one's trainers already consumed
         self._acked |= tfc.server.acked_partitions()
         return failure
 
-    def _await_result(self, result):
+    def _await_result(self, result, probe=None):
         """Poll a job result against the monitor; None on success, else
         the classified FailureEvent. A monitor-detected failure aborts
-        the attempt remotely first so blocked tasks unwind."""
+        the attempt remotely first so blocked tasks unwind. ``probe``
+        (the elastic regrow capacity watch) runs once per poll."""
         sup = self._supervisor
         while True:
+            if probe is not None:
+                try:
+                    probe()
+                except Exception:  # noqa: BLE001 - probe is best-effort
+                    logger.debug("regrow probe failed", exc_info=True)
             failure = sup.first_failure()
             if failure is not None:
                 # monitor OFF before the remote abort: the abort flips
@@ -1029,6 +1225,12 @@ class SupervisedCluster(object):
                 # task error beat the monitor: give classification one
                 # grace window to attribute it to a lease
                 failure = sup.wait_for_failure(self.config.classify_grace)
+                # drain in-flight tasks BEFORE returning: a feed task
+                # that consumed its partition may be one reply away
+                # from completing — its ACK must land before the
+                # caller harvests acked_partitions(), or the partition
+                # replays against state that already contains it
+                self._drain_result(result)
                 return failure if failure is not None else FailureEvent(
                     "task_failure", None, str(err))
             if result.done():
@@ -1039,6 +1241,104 @@ class SupervisedCluster(object):
         deadline = time.monotonic() + (timeout or self.config.drain_timeout)
         while not result.done() and time.monotonic() < deadline:
             time.sleep(0.1)
+
+    # -- elastic resize (regrow) -----------------------------------------
+
+    def _elastic_policy(self):
+        """The configured policy when it carries the elastic knobs
+        (duck-typed: min_width/max_width/regrow_probe_s), else None."""
+        policy = self.config.policy
+        if all(hasattr(policy, a) for a in
+               ("min_width", "max_width", "regrow_probe_s",
+                "shrink_grace_s")):
+            return policy
+        return None
+
+    def _capacity(self):
+        """Alive, non-excluded engine executors (None without the
+        engine's liveness view — Spark contexts cannot regrow)."""
+        alive_fn = getattr(self.sc, "executors_alive", None)
+        if alive_fn is None:
+            return None
+        try:
+            return [e for e in alive_fn() if e not in self.excluded]
+        except Exception:  # noqa: BLE001 - liveness view is best-effort
+            return None
+
+    def _regrow_probe(self):
+        """Capacity watch, run from the attempt poll loop: when the
+        job runs below its elastic max width and spare executors
+        exist, request a boundary drain so the next checkpoint
+        boundary reforms UP. One shot per attempt (the drain itself
+        ends the attempt)."""
+        policy = self._elastic_policy()
+        if policy is None or self._resize_target is not None \
+                or self._tfc is None:
+            return
+        now = time.monotonic()
+        if now - self._last_probe < policy.regrow_probe_s:
+            return
+        self._last_probe = now
+        max_width = policy.max_width if policy.max_width is not None \
+            else self.num_executors
+        if self.width >= max_width:
+            return
+        capacity = self._capacity()
+        if capacity is None or len(capacity) <= self.width:
+            return
+        target = min(len(capacity), max_width)
+        self._resize_target = target
+        self.events.record("regrow_requested", width=self.width,
+                           target=target, capacity=len(capacity))
+        logger.warning("elastic regrow: capacity %d > width %d; "
+                       "requesting boundary drain to reform at %d",
+                       len(capacity), self.width, target)
+        self._request_resize_drain(target)
+
+    def _request_resize_drain(self, target):
+        """Set every node's broker ``resize_drain`` key so each trainer
+        exits via :class:`ResizeDrain` at its next step boundary
+        (checkpoint committed, partition acked). Best effort per node —
+        the analog of :meth:`Supervisor.abort_attempt`, but cooperative
+        and boundary-aligned instead of immediate."""
+        import multiprocessing
+
+        from tensorflowonspark_tpu import manager
+        tfc = self._tfc
+        if tfc is None:
+            return
+        authkey = bytes.fromhex(tfc.cluster_meta["authkey"])
+        multiprocessing.current_process().authkey = authkey
+        for node_meta in tfc.cluster_info:
+            try:
+                mgr = manager.connect(tuple(node_meta["mgr_addr"]), authkey)
+                mgr.set("resize_drain", int(target))
+            except Exception:  # noqa: BLE001 - node may be gone
+                logger.debug("resize drain could not reach executor %s",
+                             node_meta.get("executor_id"), exc_info=True)
+
+    def _complete_resize(self, failure):
+        """Finish a PLANNED resize: tear the drained attempt down and
+        move width to the target — no policy consult, no
+        failure_counts (the 'failure' here is the drain's own exit
+        surfacing through the normal channels)."""
+        target, self._resize_target = self._resize_target, None
+        attempt_no = len(self.attempts) + 1
+        self.events.record("attempt_teardown", attempt=attempt_no,
+                           kind="resize_drain", surfaced=failure.kind)
+        self._teardown("resize drain (regrow to width {})".format(target),
+                       attempt_no=attempt_no)
+        self._record_width_change(target, "regrow: capacity returned")
+        # the next loop iteration reforms at the new width
+
+    def _record_width_change(self, new_width, reason):
+        if new_width == self.width:
+            return
+        self.events.record("width_change", from_width=self.width,
+                           to_width=new_width, reason=reason)
+        logger.warning("cluster width %d -> %d (%s)", self.width,
+                       new_width, reason)
+        self.width = int(new_width)
 
     def _harvest_metrics(self):
         """Snapshot the live cluster's metrics rollup before a teardown
@@ -1088,16 +1388,32 @@ class SupervisedCluster(object):
     def _teardown_attempt(self, attempt_no, failure):
         self.events.record("attempt_teardown", attempt=attempt_no,
                            kind=failure.kind)
+        self._teardown(str(failure), attempt_no=attempt_no)
+
+    def _teardown(self, reason, attempt_no=None):
+        """Tear the live attempt down after a failure or planned drain:
+        abort surviving nodes FIRST (their trainers may still be
+        consuming — an executor loss ends the feed job without ever
+        delivering EndFeed to the survivors, and a shutdown join with a
+        dead executor raises before dispatching), then best-effort
+        shutdown."""
         self._harvest_metrics()
+        sup = self._supervisor
         self._stop_monitor()
         tfc, self._tfc = self._tfc, None
         if tfc is None:
             return
         try:
+            (sup or Supervisor()).abort_attempt(
+                tfc.cluster_info, tfc.cluster_meta, reason)
+        except Exception:  # noqa: BLE001 - nodes may all be gone
+            logger.debug("attempt abort failed", exc_info=True)
+        try:
             tfc.shutdown(grace_secs=1,
                          timeout=self.config.shutdown_timeout)
         except Exception as e:  # noqa: BLE001 - this IS the failure
-            logger.info("attempt %d teardown surfaced: %s", attempt_no, e)
+            logger.info("attempt %s teardown surfaced: %s",
+                        attempt_no if attempt_no is not None else "?", e)
 
     def _recover_or_raise(self, failure):
         attempt_no = len(self.attempts) + 1
@@ -1108,12 +1424,11 @@ class SupervisedCluster(object):
             self.failure_counts[failure.executor_id] = \
                 self.failure_counts.get(failure.executor_id, 0) + 1
         self._teardown_attempt(attempt_no, failure)
-        decision = self.config.policy.decide(
-            failure, restarts, dict(self.failure_counts),
-            frozenset(self.excluded), self.num_executors)
+        decision = self._decide(failure, restarts)
         self.events.record("decision", attempt=attempt_no,
                            action=decision.action, delay=decision.delay,
                            exclude=sorted(decision.exclude),
+                           width=decision.width,
                            reason=decision.reason)
         if decision.action == Decision.FAIL:
             self._done = True
@@ -1122,12 +1437,61 @@ class SupervisedCluster(object):
             raise RuntimeError(
                 "supervised job failed after {} attempt(s) — {} ({})".format(
                     attempt_no, failure, decision.reason))
+        if decision.action == Decision.RESIZE:
+            self._apply_shrink(decision)
         if decision.exclude:
             self.excluded |= set(decision.exclude)
             self.events.record("blacklisted",
                                executors=sorted(decision.exclude))
+            # blacklist and resize share ONE width source of truth
+            self._record_width_change(
+                self.num_executors - len(self.excluded),
+                "blacklist: excluded {}".format(sorted(decision.exclude)))
         if decision.delay:
             logger.info("supervisor backing off %.1fs before restart",
                         decision.delay)
             time.sleep(decision.delay)
         # the next loop iteration (train) or shutdown pass reforms
+
+    def _decide(self, failure, restarts):
+        """Consult the policy, passing the current width only to
+        policies that take it — user-defined policies implementing the
+        pre-elastic 5-argument ``decide`` signature keep working."""
+        import inspect
+        policy = self.config.policy
+        kwargs = {}
+        try:
+            params = inspect.signature(policy.decide).parameters
+            if "width" in params or any(
+                    p.kind == inspect.Parameter.VAR_KEYWORD
+                    for p in params.values()):
+                kwargs["width"] = self.width
+        except (TypeError, ValueError):  # unintrospectable callable
+            pass
+        return policy.decide(
+            failure, restarts, dict(self.failure_counts),
+            frozenset(self.excluded), self.num_executors, **kwargs)
+
+    def _apply_shrink(self, decision):
+        """Commit (or cancel) a RESIZE decision: hold for the policy's
+        shrink grace first — a flapping executor that returns within it
+        keeps the original width (reform, not resize)."""
+        grace = getattr(self.config.policy, "shrink_grace_s", 0.0)
+
+        def _capacity_back():
+            capacity = self._capacity()
+            return capacity is not None and len(capacity) >= self.width
+
+        deadline = time.monotonic() + max(0.0, grace)
+        returned = _capacity_back()
+        while not returned and time.monotonic() < deadline:
+            time.sleep(0.05)
+            returned = _capacity_back()
+        if returned:
+            self.events.record("shrink_cancelled", width=self.width,
+                               reason="capacity available within "
+                                      "shrink grace")
+            logger.warning("shrink to %s cancelled: capacity for width "
+                           "%d is available", decision.width, self.width)
+            return
+        self._record_width_change(decision.width, decision.reason)

@@ -350,6 +350,12 @@ METRIC_FAMILIES = {
         ("gauge", "executor", "DataFeed batches-served progress counter"),
     "tfos_cluster_lease_age_seconds":
         ("gauge", "executor", "seconds since each executor's last beat"),
+    "tfos_cluster_width":
+        ("gauge", "", "executors in the live formation (elastic resize "
+                      "shrinks/grows this)"),
+    "tfos_cluster_width_target":
+        ("gauge", "", "the job's configured width (width < target means "
+                      "running degraded after a shrink)"),
 }
 
 
@@ -710,13 +716,19 @@ def cluster_rollup(per_executor):
     }
 
 
-def render_cluster(per_executor):
+def render_cluster(per_executor, cluster_gauges=None):
     """OpenMetrics text for the driver-side cluster endpoint: the
     cluster gauges plus every executor's snapshot re-rendered under an
     ``executor`` label (one family, N labeled series — the shape a
-    Prometheus scrape aggregates itself)."""
+    Prometheus scrape aggregates itself). ``cluster_gauges`` adds
+    server-level gauge families ({family: value} — the elastic-resize
+    width gauges ride this)."""
     lines = ["# TYPE tfos_cluster_executors gauge",
              "tfos_cluster_executors {}".format(len(per_executor))]
+    for family in sorted(cluster_gauges or {}):
+        lines.append("# TYPE {} gauge".format(family))
+        lines.append("{} {}".format(family,
+                                    _fmt(cluster_gauges[family])))
     for name, key in (("tfos_cluster_train_step", "train_step"),
                       ("tfos_cluster_feed_hb_batches", "feed_hb"),
                       ("tfos_cluster_lease_age_seconds", "age")):

@@ -139,3 +139,93 @@ def test_save_existing_step_is_noop(jax, tmp_path):
     assert ckpt.save(2, state, force=True) is False  # no raise
     assert ckpt.latest_step() == 2
     ckpt.close()
+
+
+# -- cross-mesh restore (elastic resize) -----------------------------------
+
+def test_sharded_restore_onto_narrower_and_wider_mesh(jax, tmp_path):
+    """The elastic-resize enabler pinned bitwise: a TP-sharded save
+    restores onto a mesh with a DIFFERENT data width — both narrower
+    (8 -> 4 devices) and wider (4 -> 8) — via respec_for_width +
+    respec_like, with values identical and the layout living on the
+    new mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from tensorflowonspark_tpu import checkpoint
+    from tensorflowonspark_tpu.parallel import build_mesh
+    from tensorflowonspark_tpu.parallel.mesh import respec_for_width
+
+    devices = jax.devices()
+    wide = build_mesh({"data": 2, "model": 4})
+    state = _sharded_state(jax, wide)
+
+    ckpt = checkpoint.Checkpointer(str(tmp_path / "wide"), chief=True)
+    assert ckpt.save(7, state)
+    ckpt.wait()
+
+    narrow_spec = respec_for_width({"data": 2, "model": 4}, 4)
+    assert narrow_spec == {"data": 1, "model": 4}
+    narrow = build_mesh(narrow_spec, devices=devices[:4])
+    restored = ckpt.restore(checkpoint.respec_like(state, narrow))
+    ckpt.close()
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(state["w"]))
+    assert int(restored["step"]) == 7
+    assert restored["w"].sharding.mesh.shape == narrow.shape
+    assert tuple(restored["w"].sharding.spec) == ("model", None)
+
+    # and back up: save at width 1, restore at width 2
+    ckpt2 = checkpoint.Checkpointer(str(tmp_path / "narrow"), chief=True)
+    narrow_state = {
+        "w": jax.device_put(np.asarray(state["w"]),
+                            NamedSharding(narrow,
+                                          PartitionSpec("model", None))),
+        "step": restored["step"]}
+    assert ckpt2.save(7, narrow_state)
+    ckpt2.wait()
+    regrown = ckpt2.restore(checkpoint.respec_like(narrow_state, wide))
+    ckpt2.close()
+    np.testing.assert_array_equal(np.asarray(regrown["w"]),
+                                  np.asarray(state["w"]))
+    assert regrown["w"].sharding.mesh.shape == wide.shape
+
+
+def test_respec_like_rejects_missing_axis(jax):
+    from tensorflowonspark_tpu import checkpoint
+    from tensorflowonspark_tpu.parallel import build_mesh
+
+    mesh = build_mesh({"data": 2, "model": 4})
+    state = _sharded_state(jax, mesh)
+    data_only = build_mesh({"data": 8})
+    with pytest.raises(ValueError, match=r"'w'.*model"):
+        checkpoint.respec_like(state, data_only)
+
+
+def test_restore_fallback_cross_shape_walks_past_corrupt_latest(
+        jax, tmp_path):
+    """Satellite: fallback=True was only exercised on same-shape
+    restores — here the corrupt LATEST was saved at width 2 and the
+    clean older step restores at width 1 (the shrink-recovery
+    combination: a writer killed mid-commit by the very executor loss
+    that forces the narrower mesh)."""
+    from tensorflowonspark_tpu import chaos, checkpoint
+    from tensorflowonspark_tpu.parallel import build_mesh
+    from tensorflowonspark_tpu.parallel.mesh import respec_for_width
+
+    root = str(tmp_path / "ck")
+    wide = build_mesh({"data": 2, "model": 4})
+    ckpt = checkpoint.Checkpointer(root, chief=True)
+    state1 = _sharded_state(jax, wide)
+    assert ckpt.save(1, state1, force=True)
+    assert ckpt.save(2, _sharded_state(jax, wide), force=True)
+    ckpt.wait()
+    assert chaos.corrupt_latest_checkpoint(root) == 2
+
+    narrow = build_mesh(respec_for_width({"data": 2, "model": 4}, 4),
+                        devices=jax.devices()[:4])
+    like = checkpoint.respec_like(state1, narrow)
+    restored = ckpt.restore(like, fallback=True)
+    ckpt.close()
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(state1["w"]))
+    assert restored["w"].sharding.mesh.shape == narrow.shape

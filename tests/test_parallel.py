@@ -531,3 +531,115 @@ def test_tree_shardings_indivisible_dim_replicates():
     # an implementation that replicates everything must fail here
     assert spec_of(r"attention/query/bias")[:1] == (None,)
     assert "model" in spec_of(r"ffn_in/kernel")
+
+
+# -- elastic resize: respec_for_width + mesh-construction errors -----------
+
+def test_respec_for_width_shrinks_and_grows_data_axis():
+    from tensorflowonspark_tpu.parallel.mesh import respec_for_width
+
+    # shrink and grow: only the data axis moves, order preserved
+    assert respec_for_width({"data": 2, "model": 4}, 4) == \
+        {"data": 1, "model": 4}
+    assert respec_for_width({"data": 1, "model": 4}, 8) == \
+        {"data": 2, "model": 4}
+    assert list(respec_for_width({"model": 2, "data": 4}, 16)) == \
+        ["model", "data"]
+    assert respec_for_width({"model": 2, "data": 4}, 16)["data"] == 8
+    # pure-DP default, and a missing data axis is inserted outermost
+    assert respec_for_width(None, 3) == {"data": 3}
+    assert list(respec_for_width({"model": 2}, 8)) == ["data", "model"]
+    assert respec_for_width({"model": 2}, 8) == {"data": 4, "model": 2}
+    # a -1 DATA axis is fine (it is being replaced anyway)
+    assert respec_for_width({"data": -1, "model": 2}, 6) == \
+        {"data": 3, "model": 2}
+
+
+def test_respec_for_width_loud_errors_name_the_axes():
+    import pytest as _pytest
+
+    from tensorflowonspark_tpu.parallel.mesh import respec_for_width
+
+    # fixed axes that cannot factor: error names them and the floor
+    with _pytest.raises(ValueError, match=r"model.*4"):
+        respec_for_width({"data": 2, "model": 4}, 6)
+    with _pytest.raises(ValueError, match="multiples of 4"):
+        respec_for_width({"data": 2, "model": 4}, 2)
+    # a -1 NON-data axis cannot be respec'd
+    with _pytest.raises(ValueError, match="model"):
+        respec_for_width({"data": 2, "model": -1}, 8)
+    with _pytest.raises(ValueError):
+        respec_for_width({"data": 2}, 0)
+
+
+def test_build_mesh_error_split_names_failing_axis(jax):
+    """The two -1 inference failures are distinct errors naming the
+    axis (satellite: the old message conflated 'another axis is 0'
+    with 'device count does not divide')."""
+    import pytest as _pytest
+
+    from tensorflowonspark_tpu.parallel import build_mesh
+
+    with _pytest.raises(ValueError, match=r"infer axis 'data'.*size 0"):
+        build_mesh({"data": -1, "model": 0})
+    with _pytest.raises(ValueError,
+                        match=r"infer axis 'data'.*do not divide"):
+        build_mesh({"data": -1, "model": 3})  # 8 % 3 != 0
+    # the known==0 case names the ZERO axis, not the inferred one
+    with _pytest.raises(ValueError, match=r"\['model'\]"):
+        build_mesh({"data": -1, "model": 0})
+
+
+# -- build_hybrid_mesh table tests (satellite: no direct coverage) ---------
+
+def test_build_hybrid_mesh_rejects_axis_overlap(jax):
+    import pytest as _pytest
+
+    from tensorflowonspark_tpu.parallel.mesh import build_hybrid_mesh
+
+    with _pytest.raises(ValueError, match="exactly one"):
+        build_hybrid_mesh({"data": 2}, {"data": 4})
+
+
+def test_build_hybrid_mesh_infers_minus_one(jax):
+    from tensorflowonspark_tpu.parallel.mesh import build_hybrid_mesh
+
+    import pytest as _pytest
+
+    # -1 on the dcn side and on the ici side, inferred from 8 devices
+    mesh = build_hybrid_mesh({"data": -1}, {"model": 4})
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == \
+        {"data": 2, "model": 4}
+    mesh = build_hybrid_mesh({"data": 2}, {"model": -1})
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == \
+        {"data": 2, "model": 4}
+    # at most one -1 ACROSS both dicts
+    with _pytest.raises(ValueError, match="at most one"):
+        build_hybrid_mesh({"data": -1}, {"model": -1})
+    # non-factoring inference names the axis
+    with _pytest.raises(ValueError, match=r"hybrid axis 'data'"):
+        build_hybrid_mesh({"data": -1}, {"model": 3})
+    with _pytest.raises(ValueError, match=r"\['model'\]"):
+        build_hybrid_mesh({"data": -1}, {"model": 0})
+
+
+def test_build_hybrid_mesh_single_slice_fallback_ordering(jax):
+    """CPU/single-slice fallback: slice-major contiguous blocks — DCN
+    axes outermost over jax.devices()' process-major order, ICI axes
+    contiguous within a block."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.parallel.mesh import build_hybrid_mesh
+
+    devices = jax.devices()
+    mesh = build_hybrid_mesh({"data": 2}, {"model": 4})
+    assert mesh.axis_names == ("data", "model")
+    grid = mesh.devices
+    assert grid.shape == (2, 4)
+    # row i holds devices[i*4:(i+1)*4] in order: an ici axis never
+    # crosses a block boundary
+    for i in range(2):
+        for j in range(4):
+            assert grid[i, j] is devices[i * 4 + j]
+    # flattening recovers the original global device order
+    assert list(grid.flatten()) == list(devices)

@@ -1,0 +1,436 @@
+"""Elastic mesh resize (ISSUE 7): width as a recoverable dimension.
+
+Layer by layer:
+
+- the ElasticResize policy's decision table (shrink on executor loss,
+  min_width floor, same-width restart for intact-width failures,
+  restart budget) — driven directly, no cluster;
+- Decision.RESIZE plumbing and the width single-source-of-truth
+  (``tfos_cluster_width`` gauges on the reservation server, rendered
+  by the driver-side /metrics, plus ``width_change`` EventLog entries);
+- Supervisor's engine-liveness fast path (executor_lost classified
+  from ``Context.executors_alive`` without waiting out
+  heartbeat_timeout — the detect-stage win the shrink MTTR leg rides);
+- the cooperative boundary drain (``TrainerSide.step`` raises
+  ``ResizeDrain`` when the driver posts ``resize_drain``);
+- chaos grammar for ``drop_executor_then_return_after`` and the
+  engine's ``revive_executor`` (capacity returns);
+- [chaos] the acceptance e2e: a 2-executor supervised job loses one
+  whole executor (SIGKILL at the scoped step site), shrinks to width
+  1, regrows to width 2 when the executor returns, and finishes with
+  the SAME total step count and consumed-data sum as an uninterrupted
+  fixed-width run — the exactly-once boundary across three mesh
+  shapes.
+"""
+
+import json
+import os
+import sys
+import time
+
+import cloudpickle
+import pytest
+
+from tensorflowonspark_tpu import chaos, cluster, reservation, \
+    supervisor, tracing
+from tensorflowonspark_tpu.engine import Context
+
+# Executor processes cannot import this test module, so its map_funs
+# must ship by value (the engine's cloudpickle serializer honors this).
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(autouse=True)
+def _disarmed(monkeypatch):
+    monkeypatch.delenv(chaos.ENV_VAR, raising=False)
+    chaos.disarm()
+    yield
+    chaos.disarm()
+
+
+# -- policy decision table -------------------------------------------------
+
+def _evt(kind="executor_lost", eid=1):
+    return supervisor.FailureEvent(kind, eid, "test")
+
+
+def test_elastic_resize_shrinks_on_executor_loss():
+    p = supervisor.ElasticResize(min_width=1, max_restarts=4)
+    d = p.decide(_evt(), 0, {1: 1}, frozenset(), 2, width=2)
+    assert d.action == supervisor.Decision.RESIZE
+    assert d.width == 1 and not d.exclude
+    assert "no replacement" in d.reason
+    # reform_failed (capacity shrank between decision and formation)
+    # shrinks too
+    d = p.decide(_evt("reform_failed", None), 1, {}, frozenset(), 3,
+                 width=3)
+    assert d.action == supervisor.Decision.RESIZE and d.width == 2
+
+
+def test_elastic_resize_respects_min_width_and_budget():
+    p = supervisor.ElasticResize(min_width=2, max_restarts=4)
+    d = p.decide(_evt(), 0, {1: 1}, frozenset(), 2, width=2)
+    assert d.action == supervisor.Decision.FAIL
+    assert "min_width" in d.reason
+    p = supervisor.ElasticResize(min_width=1, max_restarts=1)
+    assert p.decide(_evt(), 1, {}, frozenset(), 2, width=2).action == \
+        supervisor.Decision.FAIL
+
+
+def test_elastic_resize_restarts_at_intact_width_on_trainer_crash():
+    p = supervisor.ElasticResize(min_width=1, max_restarts=4)
+    d = p.decide(_evt("trainer_crash", 0), 0, {0: 1}, frozenset(), 2,
+                 width=2)
+    assert d.action == supervisor.Decision.RESTART
+    assert d.width is None
+
+
+def test_elastic_resize_width_defaults_from_exclusions():
+    # width omitted (legacy 5-arg callers): derived from
+    # num_executors - excluded
+    p = supervisor.ElasticResize(min_width=1, max_restarts=4)
+    d = p.decide(_evt(), 0, {}, frozenset({2}), 3)
+    assert d.action == supervisor.Decision.RESIZE and d.width == 1
+
+
+def test_legacy_policies_accept_width_kwarg():
+    for policy in (supervisor.FailJob(),
+                   supervisor.RestartFromCheckpoint(),
+                   supervisor.Blacklist()):
+        d = policy.decide(_evt("trainer_crash", 0), 0, {0: 1},
+                          frozenset(), 2, width=2)
+        assert d.action in (supervisor.Decision.FAIL,
+                            supervisor.Decision.RESTART)
+
+
+def test_decide_supports_legacy_five_arg_policies():
+    """User-defined policies implementing the pre-elastic 5-argument
+    decide signature must keep working: width is passed only to
+    policies whose signature takes it."""
+    class Legacy(object):
+        def decide(self, event, restarts, failure_counts, excluded,
+                   num_executors):
+            return supervisor.Decision(supervisor.Decision.RESTART,
+                                       reason="legacy")
+
+    class Kw(object):
+        def decide(self, event, restarts, failure_counts, excluded,
+                   num_executors, **kw):
+            return supervisor.Decision(supervisor.Decision.RESTART,
+                                       reason=str(kw.get("width")))
+
+    scl = object.__new__(supervisor.SupervisedCluster)
+    scl.failure_counts = {}
+    scl.excluded = set()
+    scl.num_executors = 2
+    scl.width = 2
+    scl.config = supervisor.SupervisorConfig(policy=Legacy())
+    assert scl._decide(_evt(), 0).reason == "legacy"
+    scl.config = supervisor.SupervisorConfig(policy=Kw())
+    assert scl._decide(_evt(), 0).reason == "2"
+
+
+# -- width gauges + width_change events ------------------------------------
+
+def test_server_width_gauges_render_on_cluster_metrics():
+    server = reservation.Server(1)
+    assert server.cluster_gauges() == {}
+    server.set_cluster_width(2, target=3)
+    gauges = server.cluster_gauges()
+    assert gauges == {"tfos_cluster_width": 2,
+                      "tfos_cluster_width_target": 3}
+    text = tracing.render_cluster({}, cluster_gauges=gauges)
+    assert "# TYPE tfos_cluster_width gauge" in text
+    assert "tfos_cluster_width 2" in text
+    assert "tfos_cluster_width_target 3" in text
+    # families are cataloged (the metrics-lint contract)
+    assert "tfos_cluster_width" in tracing.METRIC_FAMILIES
+    assert "tfos_cluster_width_target" in tracing.METRIC_FAMILIES
+    # width can move without touching the target
+    server.set_cluster_width(1)
+    assert server.cluster_gauges()["tfos_cluster_width"] == 1
+    assert server.cluster_gauges()["tfos_cluster_width_target"] == 3
+
+
+# -- engine-liveness fast path ---------------------------------------------
+
+class _FakeLeaseServer(object):
+    def __init__(self):
+        self.leases = {}
+
+    def set(self, eid, age=0.0, **payload):
+        self.leases[eid] = (age, payload)
+
+    def lease_snapshot(self):
+        return {eid: {"age": age, "payload": dict(p)}
+                for eid, (age, p) in self.leases.items()}
+
+    def acked_partitions(self):
+        return set()
+
+
+def test_supervisor_classifies_executor_lost_from_engine_liveness():
+    srv = _FakeLeaseServer()
+    # both leases FRESH: the lease channel alone sees nothing wrong
+    srv.set(0, state="running", trainer_alive=True)
+    srv.set(1, state="running", trainer_alive=True)
+    sup = supervisor.Supervisor(
+        server=srv, executors=[0, 1],
+        config=supervisor.SupervisorConfig(heartbeat_timeout=1000.0),
+        alive_fn=lambda: [0])  # the engine already saw executor 1 die
+    sup.poll_once()
+    failure = sup.first_failure()
+    assert failure is not None and failure.kind == "executor_lost"
+    assert failure.executor_id == 1
+    assert "engine reports" in failure.detail
+    # attributed once, and the healthy executor stays unreported
+    sup.poll_once()
+    assert len(sup.failures()) == 1
+
+
+def test_supervisor_liveness_view_errors_are_non_fatal():
+    def _boom():
+        raise RuntimeError("liveness view broke")
+
+    sup = supervisor.Supervisor(server=_FakeLeaseServer(),
+                                executors=[0], alive_fn=_boom)
+    sup.poll_once()  # must not raise
+    assert sup.first_failure() is None
+
+
+# -- cooperative boundary drain --------------------------------------------
+
+class _FakeMgr(object):
+    def __init__(self):
+        self.kv = {}
+
+    def get(self, key):
+        return self.kv.get(key)
+
+    def set(self, key, value):
+        self.kv[key] = value
+
+
+def test_trainer_side_step_raises_resize_drain_at_boundary():
+    mgr = _FakeMgr()
+    side = supervisor.TrainerSide(mgr)
+    side.drain_poll_interval = 0.0  # no throttle: check every step
+    side.step(3)  # no drain requested: publishes and returns
+    assert mgr.kv["train_step"] == 3
+    mgr.set("resize_drain", 2)
+    with pytest.raises(supervisor.ResizeDrain, match="width 2"):
+        side.step(4)
+    # the step was still published BEFORE the raise (the boundary is
+    # after checkpoint+ack — the caller's contract)
+    assert mgr.kv["train_step"] == 4
+
+
+def test_trainer_side_drain_poll_is_throttled():
+    """The drain check is one extra broker RPC: fast step loops must
+    not pay it per step (at most ~1/drain_poll_interval)."""
+    class _CountingMgr(_FakeMgr):
+        def __init__(self):
+            super(_CountingMgr, self).__init__()
+            self.gets = 0
+
+        def get(self, key):
+            self.gets += 1
+            return super(_CountingMgr, self).get(key)
+
+    mgr = _CountingMgr()
+    side = supervisor.TrainerSide(mgr)  # default 0.25s throttle
+    for step in range(50):
+        side.step(step)
+    assert mgr.gets <= 2, mgr.gets  # first step checks; the rest skip
+
+
+# -- chaos grammar + capacity return ---------------------------------------
+
+def test_parse_spec_drop_executor_point(tmp_path):
+    spec = "drop_executor_then_return_after=2.5,only=1,fuse={}".format(
+        tmp_path / "fuse")
+    out = chaos.parse_spec(spec)
+    inj = out["drop_executor_then_return_after"]
+    assert inj.value == 2.5 and inj.only == 1
+    assert inj.fuse == str(tmp_path / "fuse")
+    # the fuse is mandatory for this point: without it the revived
+    # executor's inherited spec would re-fire the drop forever and the
+    # return scheduler has no fire time to anchor on
+    with pytest.raises(ValueError, match="fuse"):
+        chaos.parse_spec("drop_executor_then_return_after=2,only=1")
+
+
+def test_drop_executor_refuses_outside_trainer(monkeypatch, tmp_path):
+    """The drop site SIGKILLs its parent — firing in anything but a
+    trainer process (whose parent is the executor) must refuse loudly
+    instead of killing, say, the pytest runner."""
+    monkeypatch.delenv("TFOS_TRAINER_EXECUTOR_ID", raising=False)
+    chaos.arm("drop_executor_then_return_after=1,fuse={}".format(
+        tmp_path / "fuse"))
+    with pytest.raises(RuntimeError, match="trainer process"):
+        chaos.on_step(1)
+
+
+def test_revive_executor_restores_engine_capacity(tmp_path):
+    """Engine half of 'capacity returns': SIGKILL one local executor,
+    watch executors_alive shrink (on the next dispatch), revive it
+    under the same id, run a job across both again."""
+    sc = Context(num_executors=2, work_root=str(tmp_path / "engine"))
+    try:
+        assert sc.executors_alive() == [0, 1]
+        assert sc.revive_executor(0) is False  # already alive
+        sc._procs[1].kill()
+        # death is noticed at dispatch: run a job until the engine
+        # reaps the handle (the doomed task fails the job)
+        def _dead():
+            try:
+                sc.parallelize([1, 2], 2).foreachPartition(lambda it: None)
+            except Exception:  # noqa: BLE001 - the job on the corpse
+                pass
+            return sc.executors_alive() == [0]
+        assert chaos.poll_until(_dead, timeout=30)
+        assert sc.revive_executor(1) is True
+        assert sc.executors_alive() == [0, 1]
+        got = sc.parallelize([10, 20], 2).mapPartitions(
+            lambda it: [sum(it)]).collect()
+        assert sorted(got) == [10, 20]
+    finally:
+        sc.stop()
+
+
+# -- the acceptance e2e ----------------------------------------------------
+
+BATCH, PARTS = 4, 10
+
+
+def _elastic_train_fun(args, ctx):
+    """Per-executor checkpoint chain + the ack-before-step discipline;
+    steps once at start so the scoped drop-executor site fires before
+    the target consumes anything. Identical in shape to bench.py's
+    _resize_map_fun (kept separate so the test pins its own contract
+    and ships by value)."""
+    import json as _json
+    import os as _os
+
+    import numpy as _np
+
+    from tensorflowonspark_tpu import chaos as _chaos
+    from tensorflowonspark_tpu import checkpoint as _checkpoint
+    from tensorflowonspark_tpu import reservation as _reservation
+    from tensorflowonspark_tpu import supervisor as _supervisor
+
+    eid = ctx.executor_id
+    ckpt = _checkpoint.Checkpointer(
+        _os.path.join(args["dir"], "exec-{}".format(eid)), chief=True)
+    like = {"step": _np.array(0, _np.int32),
+            "seen": _np.array(0.0, _np.float64)}
+    restored = ckpt.restore(like, fallback=True)
+    state = restored if restored is not None else like
+    step = int(state["step"])
+    start = step
+    sup = _supervisor.attach(
+        ctx, restored_step=step if restored is not None else None)
+    sup.step(step)  # drop_executor chaos site (only=<eid> scoped)
+    feed = ctx.get_data_feed(train_mode=True)
+
+    def _acked_up_to(n):
+        client = _reservation.Client(ctx.cluster_meta["server_addr"])
+        try:
+            return _chaos.poll_until(lambda: len(client.acked()) >= n,
+                                     timeout=60)
+        finally:
+            client.close()
+
+    while not feed.should_stop():
+        batch = feed.next_batch(args["batch"])
+        if not batch:
+            continue
+        step += 1
+        state = {"step": _np.array(step, _np.int32),
+                 "seen": _np.array(float(state["seen"]) + sum(batch),
+                                   _np.float64)}
+        # ack-confirm BEFORE checkpoint: an abort racing the feeder's
+        # join leaves a consumed partition unacked — committing it
+        # first would turn the replay into a double count; a timed-out
+        # wait aborts the step uncommitted for the same reason
+        if not _acked_up_to(step - start):
+            raise RuntimeError("feed ack never observed; step {} "
+                               "aborted uncommitted".format(step))
+        ckpt.save(step, state, force=True)
+        ckpt.wait()
+        sup.step(step)  # checkpoint boundary: kill site AND drain site
+    ckpt.close()
+    with open(_os.path.join(args["dir"],
+                            "final-{}.json".format(eid)), "w") as f:
+        _json.dump({"step": step, "seen": float(state["seen"])}, f)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_elastic_shrink_then_regrow_matches_uninterrupted(tmp_path):
+    """Acceptance e2e (three mesh shapes, one exactly-once boundary):
+    executor 1 is SIGKILLed whole (drop at its first step site, before
+    it consumes anything), ElasticResize reforms at width 1 with the
+    un-ACKed partitions rebalanced onto the survivor; the executor
+    returns ~2s later, the regrow probe requests a boundary drain, and
+    the job reforms back at width 2 — finishing with the SAME total
+    step count and consumed-data sum an uninterrupted width-2 run
+    produces."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    os.makedirs(ckpt_dir)
+    fuse = str(tmp_path / "fuse")
+    records = list(range(BATCH * PARTS))
+    sc = Context(
+        num_executors=2, work_root=str(tmp_path / "engine"),
+        executor_env={
+            chaos.ENV_VAR:
+                "drop_executor_then_return_after=2,only=1,fuse=" + fuse,
+            "TFOS_FEED_TRANSPORT": "queue"})
+    cfg = supervisor.SupervisorConfig(
+        policy=supervisor.ElasticResize(
+            min_width=1, max_restarts=4, backoff=0.1,
+            regrow_probe_s=0.2),
+        heartbeat_interval=0.25, heartbeat_timeout=20.0,
+        poll_interval=0.1, classify_grace=10.0)
+    try:
+        tfc = cluster.run(sc, _elastic_train_fun,
+                          {"dir": ckpt_dir, "batch": BATCH},
+                          num_executors=2,
+                          input_mode=cluster.InputMode.SPARK,
+                          supervise=cfg)
+        assert isinstance(tfc, supervisor.SupervisedCluster)
+        chaos.schedule_executor_return(sc, 1, fuse, delay=2.0)
+        tfc.train(sc.parallelize(records, PARTS), feed_timeout=60)
+    finally:
+        sc.stop()
+
+    assert os.path.exists(fuse), "the drop injection never fired"
+    # exactly-once across three mesh shapes: total steps == partitions
+    # and total consumed-data sum == the dataset's
+    totals = {"step": 0, "seen": 0.0}
+    for eid in (0, 1):
+        path = os.path.join(ckpt_dir, "final-{}.json".format(eid))
+        if os.path.exists(path):
+            final = json.load(open(path))
+            totals["step"] += final["step"]
+            totals["seen"] += final["seen"]
+    assert totals["step"] == PARTS, totals
+    assert totals["seen"] == float(sum(records)), totals
+
+    rep = tfc.report()
+    # three formations: 2 -> 1 (shrink) -> 2 (regrow)
+    assert rep["formations"] == 3, rep
+    widths = [e["width"] for e in rep["events"]
+              if e["name"] == "cluster_formed"]
+    assert widths == [2, 1, 2], widths
+    assert rep["width"] == 2
+    assert [(c["from_width"], c["to_width"])
+            for c in rep["width_changes"]] == [(2, 1), (1, 2)]
+    # the drop is the ONLY counted failure: the regrow drain is
+    # planned, never policy-decided, never in failure_counts
+    assert [f["kind"] for f in rep["failures"]] == ["executor_lost"], rep
+    assert rep["failures"][0]["executor_id"] == 1
+    assert rep["excluded"] == [], "resize must leave no blacklist mark"
+    assert rep["acked_partitions"] == PARTS
+    # the regrow milestones are on the record
+    assert any(e["name"] == "regrow_requested" for e in rep["events"])

@@ -5,11 +5,16 @@ workload through serving.DecodeEngine and prints the per-stage
 attribution the engine's own tracing hooks collect:
 
 - ``prefill``       — per-admission fused prompt pass (one jit call per
-                      request, compiled per shape bucket)
+                      request, compiled per shape bucket; on a warm
+                      prefix hit this is the TAIL only)
 - ``decode_step``   — the fixed-shape S-slot step, including the
                       per-step host sync that reads the emitted tokens
 - ``host_schedule`` — pure scheduler bookkeeping between steps
                       (admission scans, EOS checks, stream delivery)
+- ``prefix_lookup`` — paged KV (PR 8): prefix-cache chain match at
+                      admission (the TTFT attribution for warm hits)
+- ``block_alloc``   — paged KV: free-list allocation + LRU eviction at
+                      admission and at decode-time block growth
 
 plus the engine's counters (tokens/step = effective slot occupancy,
 prefills, steps), compile stats (programs vs buckets), the request-
@@ -109,6 +114,8 @@ def main(argv=None):
             print("    {:<12} {}".format(key, r["hist"][key]))
         print("  compile: {}".format(r["compile"]))
         print("  lifecycle: {}".format(r["lifecycle"]))
+        if "kv" in r:
+            print("  kv blocks: {}".format(r["kv"]))
 
 
 if __name__ == "__main__":

@@ -103,6 +103,16 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     ``max_new_tokens`` steps; finished ones just stop changing, the
     TPU-correct formulation of early stop).
     """
+    if getattr(model, "kv_block_size", 0):
+        # the solo path has no block allocator: a paged model's default
+        # table maps every row to the scratch block, which would serve
+        # garbage silently. Paged decode is the serving engine's job
+        # (serving.DecodeEngine manages tables via paging.BlockPool);
+        # solo generation wants the contiguous-cache twin of the model.
+        raise ValueError(
+            "generate() needs a contiguous-cache model "
+            "(kv_block_size=0); paged KV decode runs through "
+            "serving.DecodeEngine")
     prompt = jnp.asarray(prompt, jnp.int32)
     b, s = prompt.shape
     if int(max_new_tokens) < 0:
@@ -329,6 +339,125 @@ def slot_step_fns(model, temperature=0.0, top_k=None, top_p=None):
         decode_step(model, params, cache, tokens, idx,
                     temperature=temperature, top_k=top_k, top_p=top_p,
                     rng=key),
+        donate_argnums=(1,))
+    return prefill, decode
+
+
+# -- paged-KV slot primitives (PR 8) -----------------------------------
+#
+# The paged siblings of ``prefill_into_slot``/``decode_step`` above,
+# for models built with ``kv_block_size > 0`` (models/decoder.py): K/V
+# lives in a shared block pool and each slot reaches its sequence
+# through a block-table row. Because the POOL is batch-independent
+# (only tables and cursors are per-row), prefill needs no mini cache +
+# scatter-merge at all: a batch-1 apply with the slot's table row and a
+# start cursor writes the tail's K/V straight into the slot's blocks —
+# which is also exactly how a PREFIX-CACHED admission prefills only the
+# un-shared tail of its prompt (start = shared prefix length, a block
+# multiple; the fused mid-sequence continuation branch reads the shared
+# prefix K/V through the table).
+
+
+def _set_paged_leaves(cache, idx, tables):
+    """Cache pytree with cursor leaves replaced by ``idx`` and
+    ``block_table`` leaves by ``tables`` — the paged extension of
+    :func:`_set_cursor_leaves`: the host scheduler is the authority on
+    both position AND block mapping, every call."""
+    def repl(path, leaf):
+        name = _leaf_name(path)
+        if name in _CURSOR_LEAVES:
+            return idx.astype(leaf.dtype)
+        if name == "block_table":
+            return tables.astype(leaf.dtype)
+        return leaf
+    return jax.tree_util.tree_map_with_path(repl, cache)
+
+
+def paged_prefill_into_slot(model, params, cache, table_row, tokens,
+                            tail_len, start, temperature=0.0, top_k=None,
+                            top_p=None, rng=None):
+    """Prefill a prompt TAIL into the pool blocks ``table_row`` maps.
+
+    ``tokens [bucket]`` is the un-shared tail of the prompt padded to
+    its shape bucket; ``tail_len`` its real length; ``start`` the
+    logical position the tail begins at (0 cold, the shared-prefix
+    length — always a block multiple — on a prefix-cache hit).
+    ``table_row [MB]`` is the slot's full block table: shared prefix
+    blocks first (read-only here: the cursor starts past them), then
+    the private blocks the tail writes, then scratch (0) padding that
+    absorbs bucket-pad writes.
+
+    Runs as ONE batch-1 apply against the SHARED pool — no mini cache:
+    the pool leaves are batch-independent, so the slot's writes land in
+    place and no other slot's blocks are touched. Returns
+    ``(cache', first_token)`` with the first generated token picked
+    from the logits at the last real tail position (so a warm
+    ``max_new_tokens=1`` request costs one tiny-bucket forward)."""
+    table_row = jnp.asarray(table_row, jnp.int32)
+    start = jnp.asarray(start, jnp.int32)
+    tail_len = jnp.asarray(tail_len, jnp.int32)
+
+    def view(path, leaf):
+        name = _leaf_name(path)
+        if name in _CURSOR_LEAVES:
+            return jnp.full((1,), start, leaf.dtype)
+        if name == "block_table":
+            return table_row[None, :].astype(leaf.dtype)
+        return leaf
+
+    mini = jax.tree_util.tree_map_with_path(view, cache)
+    logits, upd = model.apply(
+        {"params": params, "cache": mini}, tokens[None, :],
+        mutable=["cache"])
+    cap = jax.lax.dynamic_index_in_dim(
+        logits, tail_len - 1, axis=1, keepdims=False)
+    first = _pick_tokens(cap, rng, temperature, top_k, top_p)[0]
+
+    def merge(path, big, new):
+        # pool leaves take the update; the engine-shaped [S] cursor and
+        # [S, MB] table leaves keep their (host-overwritten-anyway)
+        # storage so the cache pytree's shapes never change
+        if _leaf_name(path) in _CURSOR_LEAVES + ("block_table",):
+            return big
+        return new
+
+    cache = jax.tree_util.tree_map_with_path(merge, cache, upd["cache"])
+    return cache, first
+
+
+def paged_decode_step(model, params, cache, tokens, idx, tables,
+                      temperature=0.0, top_k=None, top_p=None, rng=None):
+    """One fixed-shape decode step over every slot, paged: identical to
+    :func:`decode_step` except the host also supplies ``tables
+    [S, MB]`` — each slot's block-table row — alongside the cursors."""
+    cache = _set_paged_leaves(cache, jnp.asarray(idx, jnp.int32),
+                              jnp.asarray(tables, jnp.int32))
+    logits, upd = model.apply(
+        {"params": params, "cache": cache}, tokens[:, None],
+        mutable=["cache"])
+    picked = _pick_tokens(logits[:, -1, :], rng, temperature, top_k, top_p)
+    return upd["cache"], picked
+
+
+@functools.lru_cache(maxsize=32)
+def paged_step_fns(model, temperature=0.0, top_k=None, top_p=None):
+    """(jitted paged prefill, jitted paged decode) for one paged model
+    + sampling config — the paged sibling of :func:`slot_step_fns`,
+    same compile-count contract: ONE decode program per engine config,
+    one prefill program per TAIL bucket (``start``/``tail_len`` are
+    traced scalars, so a warm prefix and a cold prompt of equal tail
+    bucket share a program)."""
+    prefill = jax.jit(
+        lambda params, cache, table_row, tokens, tail_len, start, key:
+        paged_prefill_into_slot(model, params, cache, table_row, tokens,
+                                tail_len, start, temperature=temperature,
+                                top_k=top_k, top_p=top_p, rng=key),
+        donate_argnums=(1,))
+    decode = jax.jit(
+        lambda params, cache, tokens, idx, tables, key:
+        paged_decode_step(model, params, cache, tokens, idx, tables,
+                          temperature=temperature, top_k=top_k,
+                          top_p=top_p, rng=key),
         donate_argnums=(1,))
     return prefill, decode
 

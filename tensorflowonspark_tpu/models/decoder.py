@@ -24,6 +24,24 @@ initialized cache is a fused prefill continuing from each row's cursor
 formulated per query row exactly like s single-token steps — equal to
 float noise in general and bitwise-equal on the engine's pinned
 serving configs.
+
+PAGED KV (PR 8): with ``kv_block_size > 0`` the decode cache stores
+K/V in a shared BLOCK POOL ``[kv_blocks, kv_block_size, N, D]``
+instead of per-row contiguous ``[B, max_len, N, D]`` regions, plus a
+per-row ``block_table`` mapping logical block index -> pool row.
+Writes scatter through the table (position ``p`` lands in pool row
+``table[b, p // bs]`` at offset ``p % bs``); reads gather the row's
+blocks back into logical order and attend exactly as the contiguous
+path does — same shapes, same mask, same einsums — so paged outputs
+are bitwise-identical to contiguous ones whenever
+``kv_block_size * table_width == the contiguous cache length``
+(serving.DecodeEngine enforces this). Block allocation, sharing, and
+reclamation are HOST decisions (paging.BlockPool via the engine); the
+module just writes and gathers where the table says. The gather
+materializes the logical ``[B, L, N, D]`` view transiently during the
+step (the XLA formulation of paged attention — resident KV is the
+pool; a fused kernel that skips the materialization is a TPU follow-up
+noted in docs/serving.md).
 """
 
 import functools
@@ -50,6 +68,13 @@ class CausalSelfAttention(nn.Module):
 
     num_heads: int
     decode: bool = False
+    #: paged KV (PR 8): block size in tokens; 0 = contiguous per-row
+    #: cache (the pre-paged layout, kept for comparison benches and the
+    #: bitwise three-way pin)
+    kv_block_size: int = 0
+    #: pool rows when paged (INCLUDING the scratch block row 0 that
+    #: absorbs pad-position writes — see paging.py)
+    kv_blocks: int = 0
 
     @nn.compact
     def __call__(self, x):
@@ -71,11 +96,34 @@ class CausalSelfAttention(nn.Module):
         v = dg(name="value")(x)
 
         if self.decode:
+            paged = self.kv_block_size > 0
             is_initialized = self.has_variable("cache", "cached_key")
-            cached_key = self.variable(
-                "cache", "cached_key", jnp.zeros, k.shape, k.dtype)
-            cached_value = self.variable(
-                "cache", "cached_value", jnp.zeros, v.shape, v.dtype)
+            if paged:
+                if self.kv_blocks < 2:
+                    raise ValueError(
+                        "paged decode needs kv_blocks >= 2 (row 0 is "
+                        "the scratch block), got {}".format(
+                            self.kv_blocks))
+                bs_blk = self.kv_block_size
+                pool_shape = (self.kv_blocks, bs_blk) + k.shape[2:]
+                cached_key = self.variable(
+                    "cache", "cached_key", jnp.zeros, pool_shape, k.dtype)
+                cached_value = self.variable(
+                    "cache", "cached_value", jnp.zeros, pool_shape,
+                    v.dtype)
+                # per-row block table [B, MB]: logical block j of row b
+                # lives in pool row table[b, j]. Sized at CREATION from
+                # the dummy pass's length (init_cache's total_len);
+                # entry 0 (the scratch block) everywhere until the host
+                # allocator assigns real blocks.
+                block_table = self.variable(
+                    "cache", "block_table",
+                    lambda: jnp.zeros((b, -(-s // bs_blk)), jnp.int32))
+            else:
+                cached_key = self.variable(
+                    "cache", "cached_key", jnp.zeros, k.shape, k.dtype)
+                cached_value = self.variable(
+                    "cache", "cached_value", jnp.zeros, v.shape, v.dtype)
             # Per-ROW write cursor [B], not a scalar: each batch row is an
             # independent sequence (a serving "slot"), so row b writes its
             # token at its own position and attends its own prefix. Whole-
@@ -85,7 +133,49 @@ class CausalSelfAttention(nn.Module):
             cache_index = self.variable(
                 "cache", "cache_index",
                 lambda: jnp.zeros((b,), jnp.int32))
-            if is_initialized and s == 1:
+            if is_initialized and paged:
+                # PAGED step/prefill, any s: write K/V for logical
+                # positions [idx, idx+s) through the block table, then
+                # gather each row's blocks back into logical order and
+                # attend exactly like the contiguous branches below —
+                # same [B, L] view, same mask, same einsums, so outputs
+                # are bitwise-identical whenever L matches the
+                # contiguous cache length (the engine sizes tables so
+                # it does). s==1 is a decode step; s>1 a fused
+                # (possibly mid-sequence, prefix-cached) prefill.
+                idx = cache_index.value                    # [B]
+                table = block_table.value                  # [B, MB]
+                mb = table.shape[1]
+                pos = idx[:, None] + jnp.arange(s)[None, :]  # [B, s]
+                blk_idx = pos // bs_blk
+                # pad positions past the logical capacity route to the
+                # scratch block (pool row 0): bucket-padded prefill
+                # tails can overshoot L, and a clamped write would
+                # otherwise land on a VISIBLE offset of whatever block
+                # sits in the last table entry
+                blk = jnp.take_along_axis(
+                    table, jnp.minimum(blk_idx, mb - 1), axis=1)
+                blk = jnp.where(blk_idx < mb, blk, 0)
+                off = pos % bs_blk
+                pk = cached_key.value.at[blk, off].set(k)
+                pv = cached_value.value.at[blk, off].set(v)
+                cached_key.value = pk
+                cached_value.value = pv
+                cache_index.value = idx + s
+                L = mb * bs_blk
+                ck = pk[table].reshape((b, L) + k.shape[2:])
+                cv = pv[table].reshape((b, L) + v.shape[2:])
+                scale = head_dim ** -0.5
+                logits = jnp.einsum("bqnd,bknd->bnqk", q, ck,
+                                    preferred_element_type=jnp.float32)
+                logits = logits * scale
+                visible = (jnp.arange(L)[None, None, :]
+                           <= pos[:, :, None])             # [B, s, L]
+                logits = jnp.where(visible[:, None, :, :], logits,
+                                   jnp.finfo(jnp.float32).min)
+                probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
+                ctx = jnp.einsum("bnqk,bknd->bqnd", probs, cv)
+            elif is_initialized and s == 1:
                 # one token per step against the cache prefix
                 idx = cache_index.value
                 max_len = cached_key.value.shape[1]
@@ -154,11 +244,15 @@ class CausalSelfAttention(nn.Module):
 class DecoderBlock(nn.Module):
     num_heads: int
     decode: bool = False
+    kv_block_size: int = 0
+    kv_blocks: int = 0
 
     @nn.compact
     def __call__(self, x):
         y = nn.LayerNorm(name="ln1")(x)
         y = CausalSelfAttention(self.num_heads, decode=self.decode,
+                                kv_block_size=self.kv_block_size,
+                                kv_blocks=self.kv_blocks,
                                 name="attn")(y)
         x = x + y
         y = nn.LayerNorm(name="ln2")(x)
@@ -184,6 +278,12 @@ class DecoderLM(nn.Module):
     num_layers: int = 2
     max_len: int = 512
     decode: bool = False
+    #: paged KV (PR 8; decode=True only): block size in tokens (0 =
+    #: contiguous per-row cache) and pool rows including the scratch
+    #: row. serving.DecodeEngine clones its model with these set; see
+    #: CausalSelfAttention and docs/serving.md.
+    kv_block_size: int = 0
+    kv_blocks: int = 0
 
     @nn.compact
     def __call__(self, tokens):
@@ -220,6 +320,8 @@ class DecoderLM(nn.Module):
         # cache visibility) — no mask threading
         for i in range(self.num_layers):
             x = DecoderBlock(self.num_heads, decode=self.decode,
+                             kv_block_size=self.kv_block_size,
+                             kv_blocks=self.kv_blocks,
                              name="block_%d" % i)(x)
         x = nn.LayerNorm(name="ln_f")(x)
         return nn.Dense(self.vocab, name="head")(x)

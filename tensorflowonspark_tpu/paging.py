@@ -1,0 +1,299 @@
+"""Block-granular KV cache accounting: free-list allocator + prefix cache.
+
+The HOST half of the paged KV cache (PR 8). The device half lives in
+models/decoder.py (a ``[num_blocks, block_size, heads, dim]`` K/V pool
+per attention layer, gathered through per-slot block tables); this
+module owns which block holds what:
+
+- **free-list allocation** — blocks are fixed-size; a sequence consumes
+  ``ceil(len / block_size)`` of them as it grows instead of reserving a
+  contiguous ``max_len`` region up front (the PagedAttention insight:
+  KV fragmentation drops to at most one partial block per sequence, so
+  memory — not compute — stops capping concurrency).
+- **ref-counted prefix sharing** — full blocks of a prompt are
+  registered under their token chain (the key for block ``j`` is the
+  EXACT token tuple ``prompt[:(j+1)*block_size]``, so a hit guarantees
+  the whole prefix matches — content-addressed, no hash collisions to
+  reason about). A later request whose prompt starts with the same
+  tokens points its block table at the shared blocks and prefills only
+  the tail. Shared blocks are read-only by construction: only COMPLETE
+  blocks are ever shared, and a sharer's write cursor starts at the
+  first position past them — so "copy-on-write on the first divergent
+  block" degenerates to allocating a fresh private block (there is
+  nothing to copy; divergent content simply prefills into it).
+- **LRU retention** — a released block that is registered in the prefix
+  cache is RETAINED (refcount 0, evictable) rather than freed, so the
+  next same-prefix request still hits; under allocation pressure the
+  least-recently-released cached blocks are evicted back into
+  circulation. ``allocatable()`` counts both (free + evictable): it is
+  the number the admission gate and the ``kv_blocks_free`` gauge read.
+
+Block id 0 is the SCRATCH block: never allocated, parked in every
+unused block-table entry. Prefill pads prompts to a shape bucket, and
+the pad positions' K/V writes land through the table — scratch absorbs
+them. Its content is garbage by design and is never visible (attention
+masks every position past a row's cursor). The device pool therefore
+carries ``num_blocks + 1`` rows for a pool of ``num_blocks`` usable
+blocks.
+
+Single-writer convention: the engine's scheduler thread is the only
+mutator. The internal lock exists so observers (``load_stats``,
+``/healthz``, admission estimates on client threads) can read
+consistent counts, not to support concurrent mutation.
+"""
+
+import collections
+import threading
+
+
+class PoolExhausted(RuntimeError):
+    """``alloc`` could not supply the requested blocks even after
+    evicting every unreferenced cached block. The engine's scheduler
+    preempts or defers admission instead of letting this escape."""
+
+
+class BlockPool(object):
+    """Free-list allocator over ``num_blocks`` usable KV blocks of
+    ``block_size`` tokens each (ids ``1..num_blocks``; id 0 is the
+    scratch block pad writes land in — see module docstring).
+
+    ``hits``/``misses`` count prefix-cache outcomes at BLOCK
+    granularity (a request with 12 shareable full blocks that finds 8
+    resident scores 8 hits + 4 misses); ``evictions`` counts cached
+    blocks reclaimed by the LRU under allocation pressure.
+    """
+
+    def __init__(self, num_blocks, block_size):
+        if int(num_blocks) < 1:
+            raise ValueError(
+                "num_blocks must be >= 1, got {}".format(num_blocks))
+        if int(block_size) < 1:
+            raise ValueError(
+                "block_size must be >= 1, got {}".format(block_size))
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self._lock = threading.Lock()
+        # LIFO free list: recently freed blocks are re-handed first
+        self._free = list(range(self.num_blocks, 0, -1))
+        self._ref = {}                # id -> refcount (> 0: live)
+        self._by_key = {}             # token-chain key -> block id
+        self._key_of = {}             # block id -> its registered key
+        # refcount-0 blocks still registered in the prefix cache, in
+        # least-recently-released-first order (the eviction order)
+        self._lru = collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        # mutation epoch: bumped by every state change that could alter
+        # an admission verdict (alloc/release/acquire/register/
+        # drop_cache). The engine's blocked-head memo keys on it — a
+        # raw allocatable() reading can return to a memoized value
+        # while a registration changed the head's need underneath it.
+        self._epoch = 0
+
+    # -- sizing ----------------------------------------------------------
+
+    def blocks_for(self, n_tokens):
+        """Blocks a sequence of ``n_tokens`` occupies (ceil)."""
+        if n_tokens <= 0:
+            return 0
+        return (int(n_tokens) + self.block_size - 1) // self.block_size
+
+    def allocatable(self):
+        """Blocks an ``alloc`` could supply right now: the free list
+        plus every evictable (refcount-0) cached block."""
+        with self._lock:
+            return len(self._free) + len(self._lru)
+
+    def stats(self):
+        """{'total', 'free', 'cached', 'live', 'hits', 'misses',
+        'evictions', 'hit_rate'} — the numbers ``load_stats`` /
+        ``/healthz`` / the BEAT payload surface. ``free`` is
+        ALLOCATABLE (free list + evictable cache); ``cached`` the
+        evictable subset; ``live`` blocks referenced by in-flight
+        sequences."""
+        with self._lock:
+            lookups = self.hits + self.misses
+            return {
+                "total": self.num_blocks,
+                "free": len(self._free) + len(self._lru),
+                "cached": len(self._lru),
+                "live": len(self._ref),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "hit_rate": (self.hits / lookups) if lookups else 0.0,
+            }
+
+    def epoch(self):
+        """Mutation counter: changes whenever alloc / release /
+        acquire / register / drop_cache changed pool state. Equal
+        epochs guarantee an admission plan's verdict is unchanged."""
+        with self._lock:
+            return self._epoch
+
+    def ref_count(self, block_id):
+        """Live refcount of ``block_id`` (0 when unreferenced)."""
+        with self._lock:
+            return self._ref.get(int(block_id), 0)
+
+    def live_refs(self):
+        """{block_id: refcount} for every referenced block — the
+        leak-audit view the churn test asserts empties."""
+        with self._lock:
+            return dict(self._ref)
+
+    # -- prefix cache ----------------------------------------------------
+
+    @staticmethod
+    def _chain_key(tokens, n):
+        return tuple(tokens[:n])
+
+    def _walk_locked(self, tokens):
+        """Longest resident chain of FULL blocks for ``tokens`` (caller
+        holds ``_lock``), capped so at least one token is always left
+        for the tail prefill (a fully-cached prompt still needs a
+        forward pass to produce the logits its first generated token
+        samples from). Returns ``(ids, shareable)`` — the ONE chain
+        walk behind :meth:`match_prefix` and :meth:`plan`, so the
+        admission gate's dry run can never disagree with what admission
+        actually acquires."""
+        shareable = max(0, (len(tokens) - 1) // self.block_size)
+        ids = []
+        for j in range(shareable):
+            key = self._chain_key(tokens, (j + 1) * self.block_size)
+            bid = self._by_key.get(key)
+            if bid is None:
+                break
+            ids.append(bid)
+        return ids, shareable
+
+    def match_prefix(self, tokens):
+        """Resident shared-prefix block ids for ``tokens``, in chain
+        order. Does NOT take references — call :meth:`acquire` before
+        using them. Tallies hits/misses."""
+        tokens = list(tokens)
+        with self._lock:
+            ids, shareable = self._walk_locked(tokens)
+            self.hits += len(ids)
+            self.misses += shareable - len(ids)
+        return ids
+
+    def plan(self, tokens):
+        """(shared_ids, new_blocks_needed, lru_resident) for admitting
+        ``tokens`` — the admission gate's dry run (no refs taken, no
+        tallies). ``lru_resident`` counts the shared blocks currently
+        parked in the LRU: acquiring THOSE removes capacity from
+        :meth:`allocatable`, while sharing a LIVE block (another
+        in-flight sequence holds a reference) costs nothing — the
+        distinction that lets concurrent same-prefix requests admit
+        together instead of serializing on a pool-sized prefix."""
+        tokens = list(tokens)
+        with self._lock:
+            ids, _ = self._walk_locked(tokens)
+            lru_resident = sum(1 for bid in ids if bid in self._lru)
+        return ids, self.blocks_for(len(tokens)) - len(ids), lru_resident
+
+    def register(self, tokens, n_tokens, block_id):
+        """Publish ``block_id`` as holding the K/V of the FULL block
+        ending at ``n_tokens`` (``tokens[:n_tokens]`` is its chain
+        key; ``n_tokens`` must be a block multiple). First writer
+        wins: if the chain is already registered to another block the
+        existing entry stands and this one stays private."""
+        if n_tokens % self.block_size:
+            raise ValueError(
+                "register at {} tokens: not a multiple of block_size {}"
+                .format(n_tokens, self.block_size))
+        key = self._chain_key(tokens, n_tokens)
+        with self._lock:
+            bid = int(block_id)
+            if key in self._by_key or bid in self._key_of:
+                return
+            if self._ref.get(bid, 0) < 1:
+                raise ValueError(
+                    "register of unreferenced block {}".format(bid))
+            self._by_key[key] = bid
+            self._key_of[bid] = key
+            self._epoch += 1
+
+    def drop_cache(self):
+        """Unregister every EVICTABLE cached block and return it to the
+        free list (live shared blocks keep their registration). The
+        operator's 'flush the prefix cache' hook, and how the leak test
+        proves retention is cache, not leak. Returns the count."""
+        with self._lock:
+            dropped = list(self._lru)
+            if dropped:
+                self._epoch += 1
+            for bid in dropped:
+                self._lru.pop(bid)
+                key = self._key_of.pop(bid)
+                self._by_key.pop(key)
+                self._free.append(bid)
+            return len(dropped)
+
+    # -- allocation ------------------------------------------------------
+
+    def acquire(self, block_ids):
+        """Take one reference on each shared block in ``block_ids`` (a
+        refcount-0 cached block leaves the LRU: it is live again)."""
+        with self._lock:
+            if block_ids:
+                self._epoch += 1
+            for bid in block_ids:
+                bid = int(bid)
+                self._ref[bid] = self._ref.get(bid, 0) + 1
+                self._lru.pop(bid, None)
+
+    def alloc(self, n):
+        """``n`` fresh private blocks (refcount 1 each), from the free
+        list first, then by evicting least-recently-released cached
+        blocks. Raises :class:`PoolExhausted` (allocating NOTHING) if
+        fewer than ``n`` are obtainable."""
+        n = int(n)
+        if n <= 0:
+            return []
+        with self._lock:
+            if len(self._free) + len(self._lru) < n:
+                raise PoolExhausted(
+                    "need {} block(s); {} free + {} cached evictable "
+                    "of {} total".format(n, len(self._free),
+                                         len(self._lru), self.num_blocks))
+            self._epoch += 1
+            ids = []
+            while len(ids) < n:
+                if self._free:
+                    ids.append(self._free.pop())
+                    continue
+                bid, _ = self._lru.popitem(last=False)  # oldest first
+                key = self._key_of.pop(bid)
+                self._by_key.pop(key)
+                self.evictions += 1
+                ids.append(bid)
+            for bid in ids:
+                self._ref[bid] = 1
+            return ids
+
+    def release(self, block_ids):
+        """Drop one reference per block. A block reaching refcount 0
+        returns to the free list — unless it is registered in the
+        prefix cache, in which case it parks in the LRU (evictable,
+        still hittable)."""
+        with self._lock:
+            if block_ids:
+                self._epoch += 1
+            for bid in block_ids:
+                bid = int(bid)
+                left = self._ref.get(bid, 0) - 1
+                if left < 0:
+                    raise ValueError(
+                        "release of unreferenced block {}".format(bid))
+                if left:
+                    self._ref[bid] = left
+                    continue
+                del self._ref[bid]
+                if bid in self._key_of:
+                    self._lru[bid] = None
+                    self._lru.move_to_end(bid)
+                else:
+                    self._free.append(bid)

@@ -61,6 +61,7 @@ import time
 import numpy as np
 
 from tensorflowonspark_tpu import chaos
+from tensorflowonspark_tpu import paging
 from tensorflowonspark_tpu import tracing
 
 logger = logging.getLogger(__name__)
@@ -371,6 +372,27 @@ class DecodeEngine(object):
         it, sustained overload grows the queue without limit while
         every client times out and abandons work the engine still
         decodes to completion.
+      kv_block_size: paged-KV block size in tokens (PR 8). None (the
+        default) auto-picks the largest divisor of ``total_len`` up to
+        16; 0 selects the pre-paged CONTIGUOUS per-slot cache (kept
+        for comparison benches and the three-way bitwise pin). Paged,
+        K/V lives in a shared block pool and a sequence consumes
+        ``ceil(len / block_size)`` blocks as it grows instead of a
+        ``total_len`` region up front — memory stops capping
+        concurrency at ``slots = pool_bytes / max_len_bytes``.
+      kv_blocks: pool size in blocks (paged only). Default:
+        ``slots * total_len / kv_block_size`` — capacity parity with
+        the contiguous layout; shrink it to serve more slots from the
+        same KV budget (admission gates on block availability, and a
+        sequence outgrowing the pool preempts the youngest admission,
+        which resumes seamlessly when blocks free).
+      prefix_cache: share resident prompt-prefix blocks across
+        requests (paged only; default True). Full blocks of every
+        prompt are registered under their exact token chain; a request
+        whose prefix is resident admits by pointing its block table at
+        the shared ref-counted blocks and prefills only the tail.
+        Released registered blocks are RETAINED (LRU-evicted under
+        pressure), so repeat system prompts keep hitting.
 
     Request lifecycle (PR 4): ``submit(..., deadline_s=T)`` attaches a
     completion deadline. Admission SHEDS the request
@@ -391,7 +413,8 @@ class DecodeEngine(object):
                  buckets=None, temperature=0.0, top_k=None, top_p=None,
                  eos_token=None, rng=None, counters=None, timers=None,
                  max_queue=1024, metrics=None, flight=None,
-                 replica_id=None):
+                 replica_id=None, kv_block_size=None, kv_blocks=None,
+                 prefix_cache=True):
         import jax
 
         from tensorflowonspark_tpu import generation
@@ -411,7 +434,9 @@ class DecodeEngine(object):
             model=model, params=params, slots=slots, total_len=total_len,
             buckets=buckets, temperature=temperature, top_k=top_k,
             top_p=top_p, eos_token=eos_token, rng=rng,
-            max_queue=max_queue, replica_id=self.replica_id)
+            max_queue=max_queue, replica_id=self.replica_id,
+            kv_block_size=kv_block_size, kv_blocks=kv_blocks,
+            prefix_cache=prefix_cache)
         self._generation = generation
         total_len = int(total_len or model.max_len)
         if total_len > model.max_len:
@@ -467,10 +492,83 @@ class DecodeEngine(object):
         self.flight = flight if flight is not None \
             else tracing.flight_recorder()
         self._temperature = float(temperature)
-        self._prefill_fn, self._decode_fn = generation.slot_step_fns(
-            model, self._temperature,
-            None if top_k is None else int(top_k),
-            None if top_p is None else float(top_p))
+        norm_top_k = None if top_k is None else int(top_k)
+        norm_top_p = None if top_p is None else float(top_p)
+        # -- paged KV setup (PR 8) ------------------------------------
+        # kv_block_size: None = auto (largest divisor of total_len up
+        # to 16 — the divisibility makes the paged logical view exactly
+        # total_len long, the bitwise-parity condition); 0 = the
+        # pre-paged contiguous per-slot cache (kept for comparison
+        # benches and the three-way bitwise pin).
+        kv_auto = kv_block_size is None
+        if kv_auto:
+            kv_block_size = next(b for b in range(16, 0, -1)
+                                 if total_len % b == 0)
+            if not (hasattr(model, "kv_block_size")
+                    and hasattr(model, "clone")):
+                # AUTO mode must not break model types that predate the
+                # paged fields — they keep the contiguous path they had;
+                # only an EXPLICIT kv_block_size>0 hard-errors below
+                logger.info(
+                    "model %s has no paged-KV fields; serving with the "
+                    "contiguous per-slot cache",
+                    type(model).__name__)
+                kv_block_size = 0
+        self.kv_block_size = int(kv_block_size)
+        self._paged = self.kv_block_size > 0
+        if self._paged:
+            if total_len % self.kv_block_size:
+                raise ValueError(
+                    "kv_block_size {} must divide total_len {} (the "
+                    "paged logical view must equal the contiguous "
+                    "cache length for bitwise parity)".format(
+                        self.kv_block_size, total_len))
+            self._blocks_per_slot = total_len // self.kv_block_size
+            # pool default: capacity parity with the contiguous layout
+            # (slots x total_len tokens) — shrink kv_blocks to trade
+            # memory for admission pressure (paging makes short
+            # sequences stop paying max_len worth of blocks)
+            self.kv_blocks = int(kv_blocks) if kv_blocks is not None \
+                else self.slots * self._blocks_per_slot
+            if self.kv_blocks < 1:
+                raise ValueError("kv_blocks must be >= 1, got {}".format(
+                    self.kv_blocks))
+            self.prefix_cache = bool(prefix_cache)
+            self._pool = paging.BlockPool(self.kv_blocks,
+                                          self.kv_block_size)
+            self._last_prefix_evictions = 0
+            self._last_prefix_hits = 0
+            self._last_prefix_misses = 0
+            #: (head handle, available) when the queue head last failed
+            #: the block gate — skips re-planning it until the pool
+            #: changes (see the admission scan)
+            self._head_block_memo = None
+            try:
+                # the served model is the caller's, re-speced for the
+                # pool (+1 device row: the scratch block pad writes
+                # land in). Params are layout-identical — only the
+                # cache collection's structure changes.
+                model = model.clone(kv_block_size=self.kv_block_size,
+                                    kv_blocks=self.kv_blocks + 1)
+            except TypeError:
+                raise ValueError(
+                    "model {} does not support paged KV (no "
+                    "kv_block_size/kv_blocks fields); pass "
+                    "kv_block_size=0 for the contiguous cache".format(
+                        type(model).__name__))
+            self._model = model
+            self._prefill_fn, self._decode_fn = generation.paged_step_fns(
+                model, self._temperature, norm_top_k, norm_top_p)
+        else:
+            if kv_blocks is not None:
+                raise ValueError(
+                    "kv_blocks needs a paged engine (kv_block_size>0)")
+            self.kv_blocks = 0
+            self.prefix_cache = False
+            self._pool = None
+            self._model = model
+            self._prefill_fn, self._decode_fn = generation.slot_step_fns(
+                model, self._temperature, norm_top_k, norm_top_p)
         self._key = rng if rng is not None else jax.random.PRNGKey(0)
         self._queue = collections.deque()
         self._cv = threading.Condition()
@@ -492,7 +590,19 @@ class DecodeEngine(object):
         self._slot_req = [None] * self.slots
         self._idx = np.zeros(self.slots, np.int32)
         self._last = np.zeros(self.slots, np.int32)
+        if self._paged:
+            # host-authoritative block tables: row s mirrors
+            # _slot_blocks[s] padded with scratch (0). A freed slot's
+            # row resets to scratch AND its cursor to 0, so the idle
+            # slot's per-step write lands in the scratch block instead
+            # of whatever its released blocks became.
+            self._slot_blocks = [[] for _ in range(self.slots)]
+            self._tables = np.zeros(
+                (self.slots, self._blocks_per_slot), np.int32)
+            self._admit_seq = itertools.count()
+            self._slot_seq = [0] * self.slots
         self._cache = generation.init_cache(model, self.slots, total_len)
+        self._publish_kv_gauges()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="tfos-decode-engine")
         self._thread.start()
@@ -528,6 +638,14 @@ class DecodeEngine(object):
             raise ValueError(
                 "prompt {} + max_new_tokens {} exceeds total_len {}".format(
                     len(prompt), max_new, self.total_len))
+        if self._paged:
+            need = self._pool.blocks_for(len(prompt) + max_new)
+            if need > self.kv_blocks:
+                # permanent infeasibility, not load: the request's
+                # worst case can never fit the pool even running alone
+                raise ValueError(
+                    "request needs up to {} KV blocks but the pool has "
+                    "{} (kv_blocks)".format(need, self.kv_blocks))
         return prompt, max_new
 
     def submit(self, prompt, max_new_tokens, deadline_s=None):
@@ -546,7 +664,7 @@ class DecodeEngine(object):
         return self._submit_many([self.validate(prompt, max_new_tokens)],
                                  deadline_s=deadline_s)[0]
 
-    def estimate_admission(self, max_new_tokens):
+    def estimate_admission(self, max_new_tokens, prompt=None):
         """{'queue_wait_s', 'service_s'} — what admitting a request of
         ``max_new_tokens`` now would plausibly cost, from the engine's
         own measured rates (EWMA decode-step and prefill wall times).
@@ -555,29 +673,52 @@ class DecodeEngine(object):
         steps are shared, so the token backlog (queued max_new plus
         what in-flight slots still owe) drains at ``slots`` tokens per
         step. ``service_s`` is the request's own prefill + max_new
-        steps. Zeros until the engine has served anything — admission
-        control sheds on EVIDENCE, never on a cold engine's guess.
+        steps. ``prompt`` (the token list) lets a PAGED engine price
+        block availability too: a request whose prefill blocks are not
+        obtainable cannot start before an in-flight sequence finishes
+        and frees some, so its queue wait is floored at the earliest
+        possible release. Zeros until the engine has served anything —
+        admission control sheds on EVIDENCE, never on a cold engine's
+        guess.
         """
         with self._cv:
-            return self._estimate_locked(int(max_new_tokens))
+            return self._estimate_locked(int(max_new_tokens),
+                                         prompt=prompt)
 
-    def _estimate_locked(self, max_new, extra_requests=0, extra_tokens=0):
-        """``extra_requests``/``extra_tokens``: work ahead of this
-        request that is not in the queue yet — the earlier members of
-        the same multi-prompt body during whole-body shed vetting. A
-        body's members queue together, so member k waits behind members
-        0..k-1 exactly as it would behind queued strangers."""
+    def _estimate_locked(self, max_new, extra_requests=0, extra_tokens=0,
+                         prompt=None, extra_blocks=0):
+        """``extra_requests``/``extra_tokens``/``extra_blocks``: work
+        ahead of this request that is not in the queue yet — the
+        earlier members of the same multi-prompt body during whole-body
+        shed vetting. A body's members queue together, so member k
+        waits behind members 0..k-1 exactly as it would behind queued
+        strangers."""
         step = self._step_ewma or 0.0
         prefill = self._prefill_ewma or 0.0
         backlog = extra_tokens + sum(h.max_new_tokens
                                      for h in self._queue)
+        remaining = []
         for s in range(self.slots):
             handle = self._slot_req[s]
             if handle is not None:
-                backlog += max(
-                    handle.max_new_tokens - len(handle._tokens), 0)
+                owed = max(handle.max_new_tokens - len(handle._tokens), 0)
+                backlog += owed
+                remaining.append(owed)
         wait = (len(self._queue) + extra_requests) * prefill \
             + backlog * step / self.slots
+        if self._paged and prompt is not None and step:
+            # block-pressure pricing (PR 8): when the pool cannot
+            # supply this request's prefill blocks right now, no slot
+            # math helps — it waits until an in-flight sequence
+            # finishes and releases blocks. Floor the wait at the
+            # EARLIEST possible release so a tight deadline sheds at
+            # the door (503 + Retry-After) instead of queueing into a
+            # certain 504.
+            shared, need, lru_shared = self._pool.plan(prompt)
+            deficit = need + lru_shared + extra_blocks \
+                - self._pool.allocatable()
+            if deficit > 0 and remaining:
+                wait = max(wait, min(remaining) * step)
         return {"queue_wait_s": wait,
                 "service_s": prefill + max_new * step}
 
@@ -625,13 +766,14 @@ class DecodeEngine(object):
                 # max_new==0 members complete inline — they never
                 # queue, prefill, or decode, so they are neither
                 # priced nor charged to later members
-                ahead_requests = ahead_tokens = 0
-                for _, max_new in vetted:
+                ahead_requests = ahead_tokens = ahead_blocks = 0
+                for prompt, max_new in vetted:
                     if max_new == 0:
                         continue
                     est = self._estimate_locked(
                         max_new, extra_requests=ahead_requests,
-                        extra_tokens=ahead_tokens)
+                        extra_tokens=ahead_tokens, prompt=prompt,
+                        extra_blocks=ahead_blocks)
                     need = est["queue_wait_s"] + est["service_s"]
                     if need > deadline_s:
                         self.counters.inc("shed", len(vetted))
@@ -648,6 +790,8 @@ class DecodeEngine(object):
                             retry_after=math.ceil(est["queue_wait_s"]))
                     ahead_requests += 1
                     ahead_tokens += max_new
+                    if self._paged:
+                        ahead_blocks += self._pool.blocks_for(len(prompt))
             deadline = None if deadline_s is None \
                 else time.monotonic() + deadline_s
             handles = []
@@ -705,14 +849,44 @@ class DecodeEngine(object):
             occupancy = len(self._active_slots())
             qwait = self._qwait_ewma
         health = self.healthy()
-        return {"replica_id": self.replica_id,
-                "queue_depth": queue_depth,
-                "slot_occupancy": occupancy,
-                "slots": self.slots,
-                "queue_wait_ewma_s": round(qwait, 6)
-                if qwait is not None else 0.0,
-                "alive": health["alive"],
-                "draining": health["draining"]}
+        stats = {"replica_id": self.replica_id,
+                 "queue_depth": queue_depth,
+                 "slot_occupancy": occupancy,
+                 "slots": self.slots,
+                 "queue_wait_ewma_s": round(qwait, 6)
+                 if qwait is not None else 0.0,
+                 "alive": health["alive"],
+                 "draining": health["draining"]}
+        # block-pool view (PR 8): rides the fleet BEAT payload and
+        # /healthz so routers and operators see memory headroom, not
+        # just slot occupancy (a paged engine can be slot-free but
+        # block-bound, or the reverse). Contiguous engines report the
+        # zero schema so consumers need no presence checks.
+        if self._paged:
+            ps = self._pool.stats()
+            stats["kv_blocks_total"] = ps["total"]
+            stats["kv_blocks_free"] = ps["free"]
+            stats["prefix_hit_rate"] = round(ps["hit_rate"], 4)
+        else:
+            stats["kv_blocks_total"] = 0
+            stats["kv_blocks_free"] = 0
+            stats["prefix_hit_rate"] = 0.0
+        return stats
+
+    def kv_cache_bytes(self):
+        """Resident KV-cache bytes: the block pool (paged — including
+        the scratch row) or the contiguous per-slot regions. The number
+        the ``bench.py serving_decode.paged`` leg holds fixed while
+        scaling concurrency."""
+        import jax
+
+        total = 0
+        for path, leaf in jax.tree_util.tree_leaves_with_path(
+                self._cache):
+            if self._generation._leaf_name(path) in (
+                    "cached_key", "cached_value"):
+                total += leaf.size * leaf.dtype.itemsize
+        return total
 
     def outstanding(self):
         """Queued + in-flight request count (the number drain waits on)."""
@@ -901,6 +1075,7 @@ class DecodeEngine(object):
             if err is not None:
                 self._evict(self._slot_req[s], err)
                 self._slot_req[s] = None
+                self._release_slot(s)
 
     def _loop(self):
         import jax.numpy as jnp
@@ -918,16 +1093,57 @@ class DecodeEngine(object):
                         return
                     self._prune_queue_locked(time.monotonic())
                     admits = []
+                    planned_blocks = 0
                     for s in range(self.slots):
-                        if self._slot_req[s] is None and self._queue:
-                            handle = self._queue.popleft()
-                            # occupy the slot AT pop time: every popped
-                            # handle must be findable by the failure
-                            # paths (_fail_outstanding) even if an
-                            # EARLIER admit's prefill dies before this
-                            # one runs
-                            self._slot_req[s] = handle
-                            admits.append((s, handle))
+                        if self._slot_req[s] is not None \
+                                or not self._queue:
+                            continue
+                        if self._paged:
+                            # block-aware admission: the FIFO head only
+                            # enters a slot when its prefill blocks are
+                            # obtainable NOW. Shared prefix blocks need
+                            # no allocation; only the LRU-RESIDENT ones
+                            # cost capacity (acquire removes them from
+                            # the allocatable set), while sharing a
+                            # LIVE block is free — so concurrent
+                            # same-prefix requests admit together
+                            # instead of serializing on a pool-sized
+                            # prefix. No head-of-line bypass:
+                            # completions free blocks and the scan
+                            # reruns every step.
+                            head = self._queue[0]
+                            # blocked-head memo: while the head waits
+                            # for blocks, re-walking its prefix chain
+                            # every decode step is O(prompt) wasted on
+                            # the scheduler thread. The memo keys on
+                            # the pool's MUTATION EPOCH — every event
+                            # that could change the verdict (release,
+                            # alloc, acquire, prefix registration)
+                            # bumps it, and with an unchanged epoch
+                            # this scan's planned_blocks is provably 0
+                            # (planned admissions alloc — and bump —
+                            # right after the scan), so the old
+                            # verdict stands.
+                            epoch = self._pool.epoch()
+                            if self._head_block_memo == (head, epoch):
+                                break
+                            toks = head.prompt + head._tokens
+                            shared, need, lru_shared = \
+                                self._pool.plan(toks)
+                            if need + lru_shared + planned_blocks \
+                                    > self._pool.allocatable():
+                                self._head_block_memo = (head, epoch)
+                                break
+                            self._head_block_memo = None
+                            planned_blocks += need + lru_shared
+                        handle = self._queue.popleft()
+                        # occupy the slot AT pop time: every popped
+                        # handle must be findable by the failure
+                        # paths (_fail_outstanding) even if an
+                        # EARLIER admit's prefill dies before this
+                        # one runs
+                        self._slot_req[s] = handle
+                        admits.append((s, handle))
                     self.counters.gauge("queue_depth", len(self._queue))
                 # prefill OUTSIDE the lock: submit() must never block on
                 # device work
@@ -937,6 +1153,11 @@ class DecodeEngine(object):
                 # requests free their slots BEFORE the step computes
                 # for them, so the next admission scan can reuse them
                 self._evict_expired(time.monotonic())
+                if self._paged:
+                    # lazy block growth (and, under exhaustion,
+                    # youngest-first preemption) for every slot whose
+                    # NEXT write crosses a block boundary
+                    self._grow_active_blocks()
                 active = self._active_slots()
                 self.counters.gauge("slot_occupancy", len(active))
                 if not active:
@@ -949,9 +1170,17 @@ class DecodeEngine(object):
                 chaos.on_decode_step(steps, self.replica_id)
                 t0 = time.monotonic()
                 with self.timers.timed("decode_step"):
-                    self._cache, toks = self._decode_fn(
-                        self.params, self._cache, jnp.asarray(self._last),
-                        jnp.asarray(self._idx), self._next_key())
+                    if self._paged:
+                        self._cache, toks = self._decode_fn(
+                            self.params, self._cache,
+                            jnp.asarray(self._last),
+                            jnp.asarray(self._idx),
+                            jnp.asarray(self._tables), self._next_key())
+                    else:
+                        self._cache, toks = self._decode_fn(
+                            self.params, self._cache,
+                            jnp.asarray(self._last),
+                            jnp.asarray(self._idx), self._next_key())
                     toks = np.asarray(toks)  # the per-step host sync
                 t1 = time.monotonic()
                 self._step_ewma = self._ewma(self._step_ewma, t1 - t0)
@@ -993,6 +1222,7 @@ class DecodeEngine(object):
         failed = [self._slot_req[s] for s in self._active_slots()]
         for s in range(self.slots):
             self._slot_req[s] = None
+            self._release_slot(s)
         failed.extend(self._queue)
         self._queue.clear()
         for handle in failed:
@@ -1008,11 +1238,198 @@ class DecodeEngine(object):
         self.counters.gauge("queue_depth", 0)
         self.counters.gauge("slot_occupancy", 0)
 
+    # -- paged-KV block management (PR 8; scheduler thread only) ---------
+
+    def _publish_kv_gauges(self):
+        """Refresh the block-pool gauges (kv_blocks_free / total /
+        cached) and roll the pool's monotonic tallies (hits / misses /
+        LRU evictions) into the prefix counters."""
+        if not self._paged:
+            # the documented zero schema: a contiguous engine still
+            # EXPORTS the kv gauges (as zeros), so dashboards keyed on
+            # the catalog rows see data, not absence
+            for gauge in ("kv_blocks_total", "kv_blocks_free",
+                          "kv_blocks_cached"):
+                self.counters.gauge(gauge, 0)
+            return
+        stats = self._pool.stats()
+        self.counters.gauge("kv_blocks_total", stats["total"])
+        self.counters.gauge("kv_blocks_free", stats["free"])
+        self.counters.gauge("kv_blocks_cached", stats["cached"])
+        # roll the pool's own monotonic tallies into the counters —
+        # the pool's chain walk is the ONE place hit/miss/eviction
+        # semantics live (no re-derived formulas to desync)
+        for counter, tally, attr in (
+                ("prefix_evictions", stats["evictions"],
+                 "_last_prefix_evictions"),
+                ("prefix_hit_blocks", stats["hits"],
+                 "_last_prefix_hits"),
+                ("prefix_miss_blocks", stats["misses"],
+                 "_last_prefix_misses")):
+            delta = tally - getattr(self, attr)
+            if delta > 0:
+                self.counters.inc(counter, delta)
+                setattr(self, attr, tally)
+
+    def _release_slot(self, slot):
+        """Return a freed slot's blocks to the pool and park its table
+        row on scratch / cursor at 0, so the idle slot's per-step write
+        lands in the scratch block instead of its released — possibly
+        already re-allocated — blocks. Private blocks go back to the
+        free list; registered prefix blocks decref into the LRU cache
+        (still hittable, evicted only under pressure)."""
+        if not self._paged:
+            return
+        if self._slot_blocks[slot]:
+            self._pool.release(self._slot_blocks[slot])
+            self._slot_blocks[slot] = []
+        self._tables[slot][:] = 0
+        self._idx[slot] = 0
+        self._publish_kv_gauges()
+
+    def _preempt(self, slot):
+        """Free a slot's blocks under pool exhaustion and requeue its
+        request at the queue FRONT: it re-admits as soon as blocks
+        free, with a continuation re-prefill of prompt + the tokens it
+        already emitted — the client's stream continues seamlessly, and
+        at temperature=0 bitwise-identically (pinned in
+        tests/test_paged_kv.py)."""
+        handle = self._slot_req[slot]
+        self._slot_req[slot] = None
+        self._release_slot(slot)
+        with self._cv:
+            self._queue.appendleft(handle)
+            self.counters.gauge("queue_depth", len(self._queue))
+        self.counters.inc("preemptions")
+        self.flight.instant("preempt", trace=handle.trace,
+                            tokens=len(handle._tokens))
+        logger.info(
+            "preempted request after %d/%d tokens (kv pool pressure); "
+            "requeued at front", len(handle._tokens),
+            handle.max_new_tokens)
+
+    def _grow_active_blocks(self):
+        """Ensure every active slot owns the block its NEXT write lands
+        in, allocating one as the sequence crosses a block boundary —
+        the lazy-growth half of paging (a sequence consumes blocks as
+        it grows, never ``max_len`` up front). Under exhaustion the
+        YOUNGEST admission is preempted (LIFO victims), so the oldest
+        request always progresses: no preemption livelock, and
+        ``validate``'s worst-case-fits-the-pool bound guarantees the
+        oldest alone can always finish."""
+        bs = self.kv_block_size
+        for s in sorted(self._active_slots(),
+                        key=lambda v: self._slot_seq[v]):
+            if self._slot_req[s] is None:
+                continue  # preempted by an earlier slot's growth
+            bi = int(self._idx[s]) // bs
+            if bi < len(self._slot_blocks[s]):
+                continue
+            while True:
+                try:
+                    with self.timers.timed("block_alloc"):
+                        new_id = self._pool.alloc(1)[0]
+                except paging.PoolExhausted:
+                    victim = max(self._active_slots(),
+                                 key=lambda v: self._slot_seq[v])
+                    self._preempt(victim)
+                    if victim == s:
+                        break  # this slot itself yielded
+                    continue
+                self._slot_blocks[s].append(new_id)
+                self._tables[s][bi] = new_id
+                self._publish_kv_gauges()
+                break
+
+    def _admit_paged(self, slot, handle):
+        """Paged admission: point the slot's block table at any
+        resident shared-prefix blocks, allocate private blocks for the
+        rest, and prefill ONLY the un-shared tail (the warm-prefix TTFT
+        win — a resident prefix costs a table write, not a forward
+        pass). Also the preemption re-entry path: a requeued handle
+        re-prefills prompt + already-emitted tokens and resumes."""
+        import jax.numpy as jnp
+
+        full = handle.prompt + handle._tokens
+        n = len(full)
+        bs = self.kv_block_size
+        shared = []
+        if self.prefix_cache:
+            with self.timers.timed("prefix_lookup"):
+                shared = self._pool.match_prefix(full)
+            # hit/miss counters roll from the pool's own tallies in
+            # _publish_kv_gauges — one formula, no desync
+        start = len(shared) * bs
+        with self.timers.timed("block_alloc"):
+            # acquire BEFORE alloc: shared blocks may sit in the LRU
+            # (refcount 0), and an alloc running first could evict the
+            # very blocks this admission is about to share
+            self._pool.acquire(shared)
+            try:
+                new_ids = self._pool.alloc(
+                    self._pool.blocks_for(n) - len(shared))
+            except paging.PoolExhausted:
+                self._pool.release(shared)
+                raise
+        ids = list(shared) + new_ids
+        self._slot_blocks[slot] = ids
+        row = self._tables[slot]
+        row[:] = 0
+        row[:len(ids)] = ids
+        self._slot_seq[slot] = next(self._admit_seq)
+        tail = full[start:]
+        try:
+            bucket = self._generation.bucket_for(len(tail), self.buckets)
+        except ValueError:
+            # a preemption continuation's prompt+generated tail can
+            # outgrow CUSTOM buckets (validate only vets the original
+            # prompt); one total_len-shaped program beats crashing the
+            # scheduler
+            bucket = self.total_len
+        toks = np.zeros(bucket, np.int32)
+        toks[:len(tail)] = tail
+        t0 = time.monotonic()
+        if handle._decode_t0 is None:
+            # queue-wait metrics describe FIRST admissions only; a
+            # preemption re-entry is a continuation, not a queue wait
+            self._hist_qwait.observe(t0 - handle.submitted)
+            self._qwait_ewma = self._ewma(self._qwait_ewma,
+                                          t0 - handle.submitted)
+            self.flight.span("queue", handle.submitted, t0,
+                             trace=handle.trace, slot=slot)
+        with self.timers.timed("prefill"):
+            self._cache, first = self._prefill_fn(
+                self.params, self._cache, jnp.asarray(row),
+                jnp.asarray(toks), jnp.int32(len(tail)),
+                jnp.int32(start), self._next_key())
+            first = int(first)
+        t1 = time.monotonic()
+        self._prefill_ewma = self._ewma(self._prefill_ewma, t1 - t0)
+        self.flight.span("prefill", t0, t1, trace=handle.trace,
+                         bucket=bucket, prompt_len=n,
+                         prefix_blocks=len(shared))
+        handle._decode_t0 = t1
+        self.counters.inc("prefills")
+        if self.prefix_cache:
+            # publish every FULL prompt block (now holding valid K/V)
+            # under its token-chain key; re-registration of shared
+            # blocks is a no-op, and a losing racer of two identical
+            # cold prompts just keeps its blocks private
+            for j in range(n // bs):
+                self._pool.register(full, (j + 1) * bs, ids[j])
+        self._publish_kv_gauges()
+        self._idx[slot] = n
+        self._last[slot] = first
+        self._deliver(slot, first)
+        self.counters.inc("tokens")
+
     def _admit(self, slot, handle):
         """Prefill ``handle``'s prompt into ``slot`` and emit its first
         token (a max_new_tokens=1 request completes right here)."""
         import jax.numpy as jnp
 
+        if self._paged:
+            return self._admit_paged(slot, handle)
         n = len(handle.prompt)
         bucket = self._generation.bucket_for(n, self.buckets)
         toks = np.zeros(bucket, np.int32)
@@ -1062,6 +1479,7 @@ class DecodeEngine(object):
         if done:
             handle._finish()
             self._slot_req[slot] = None
+            self._release_slot(slot)
             self.counters.inc("requests_completed")
             self._trace_finish(handle, "finish")
         elif chaos.on_token(len(handle._tokens)):
@@ -1565,6 +1983,17 @@ class ModelServer(object):
             body["queue_depth"] = snap["gauges"].get("queue_depth", 0)
             body["slot_occupancy"] = snap["gauges"].get("slot_occupancy", 0)
             body["counts"] = snap["counts"]
+            # block-pool headroom (PR 8): same pinned keys the fleet
+            # BEAT payload carries, so an operator curl and a router
+            # decision read one schema (zeros on a contiguous engine).
+            # getattr: supervision fakes duck-type only healthy() +
+            # counters, and a health probe must not 500 over a gauge
+            load_stats = getattr(engine, "load_stats", None)
+            if callable(load_stats):
+                load = load_stats()
+                for key in ("kv_blocks_total", "kv_blocks_free",
+                            "prefix_hit_rate"):
+                    body[key] = load[key]
             if self._draining:
                 # draining outranks the liveness checks below: mid-
                 # drain the engine transitions draining -> stopped by

@@ -272,6 +272,29 @@ METRIC_FAMILIES = {
         ("counter", "", "requests evicted past their deadline"),
     "tfos_serving_engine_restarts":
         ("counter", "", "RestartEngine rebuilds of a dead scheduler"),
+    # -- paged KV cache (PR 8): block pool + prefix cache --
+    "tfos_serving_kv_blocks_total":
+        ("gauge", "", "usable KV blocks in the paged pool (0 on a "
+                      "contiguous engine)"),
+    "tfos_serving_kv_blocks_free":
+        ("gauge", "", "KV blocks obtainable right now (free list + "
+                      "evictable prefix-cached)"),
+    "tfos_serving_kv_blocks_cached":
+        ("gauge", "", "refcount-0 blocks retained by the prefix cache "
+                      "(evictable subset of kv_blocks_free)"),
+    "tfos_serving_prefix_hit_blocks":
+        ("counter", "", "shareable prompt blocks found resident at "
+                        "admission (each skips its share of prefill)"),
+    "tfos_serving_prefix_miss_blocks":
+        ("counter", "", "shareable prompt blocks NOT resident at "
+                        "admission (prefilled fresh)"),
+    "tfos_serving_prefix_evictions":
+        ("counter", "", "prefix-cached blocks reclaimed by the LRU "
+                        "under allocation pressure"),
+    "tfos_serving_preemptions":
+        ("counter", "", "in-flight requests preempted (blocks freed, "
+                        "requeued for continuation) under pool "
+                        "exhaustion"),
     "tfos_serving_queue_depth":
         ("gauge", "", "requests waiting for a slot"),
     "tfos_serving_slot_occupancy":

@@ -1,0 +1,405 @@
+"""Paged KV cache + prefix reuse invariants (PR 8).
+
+The tentpole's whole contract is that paging is INVISIBLE to every
+request: block tables, lazy growth, prefix sharing, LRU eviction, and
+even mid-flight preemption may only change WHERE K/V bytes live, never
+what tokens come out. Pinned here as the three-way bitwise equality
+(paged engine == pre-paged contiguous engine == solo ``generate``) at
+temperature=0, warm-prefix == cold-prefix twins, and bitwise
+continuation across a preemption. Plus the accounting contracts:
+admission honesty under block pressure (shed, don't 504), and the
+leak-proofing churn loop (cancel / disconnect / deadline-evict / drain
+returns every block — refcounts zero, free list full).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import chaos, generation, paging, serving
+from tensorflowonspark_tpu.models.decoder import DecoderLM
+
+V, H, NH, L, MAXLEN = 17, 32, 4, 2, 64
+
+
+@pytest.fixture(scope="module")
+def lm():
+    train = DecoderLM(vocab=V, hidden=H, num_heads=NH, num_layers=L,
+                      max_len=MAXLEN, decode=False)
+    dec = DecoderLM(vocab=V, hidden=H, num_heads=NH, num_layers=L,
+                    max_len=MAXLEN, decode=True)
+    params = train.init(jax.random.PRNGKey(7),
+                        jnp.zeros((2, MAXLEN), jnp.int32))["params"]
+    return dec, params
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    chaos.disarm()
+
+
+def _solo(dec, params, prompt, max_new):
+    out = generation.generate_jit(
+        dec, params, jnp.asarray([prompt], jnp.int32), max_new)
+    return np.asarray(out)[0].tolist()
+
+
+def _counts(eng):
+    return eng.counters.snapshot()["counts"]
+
+
+# -- BlockPool (host allocator) unit tests ------------------------------
+
+
+def test_pool_alloc_release_refcounts():
+    pool = paging.BlockPool(4, 8)
+    ids = pool.alloc(3)
+    assert len(ids) == 3 and len(set(ids)) == 3
+    assert 0 not in ids  # scratch is never handed out
+    assert pool.allocatable() == 1
+    assert all(pool.ref_count(b) == 1 for b in ids)
+    pool.acquire(ids[:1])  # a sharer
+    assert pool.ref_count(ids[0]) == 2
+    pool.release(ids)
+    assert pool.ref_count(ids[0]) == 1 and pool.allocatable() == 3
+    pool.release(ids[:1])
+    assert pool.allocatable() == 4 and pool.live_refs() == {}
+    with pytest.raises(ValueError, match="unreferenced"):
+        pool.release(ids[:1])
+
+
+def test_pool_exhaustion_is_atomic():
+    pool = paging.BlockPool(3, 8)
+    pool.alloc(2)
+    with pytest.raises(paging.PoolExhausted):
+        pool.alloc(2)
+    # nothing was allocated by the failed call
+    assert pool.allocatable() == 1
+
+
+def test_pool_prefix_chain_and_lru():
+    pool = paging.BlockPool(4, 4)
+    prompt = list(range(10))  # blocks at 4 and 8; tail 2
+    ids = pool.alloc(pool.blocks_for(len(prompt)))  # 3 blocks
+    pool.register(prompt, 4, ids[0])
+    pool.register(prompt, 8, ids[1])
+    # full-block sharing only, capped to leave >= 1 tail token
+    assert pool.match_prefix(prompt) == ids[:2]
+    assert pool.match_prefix(prompt[:8] + [99]) == ids[:2]
+    assert pool.match_prefix(prompt[:4] + [99] * 6) == ids[:1]
+    assert pool.match_prefix(prompt[:8]) == ids[:1]  # block 2 is tail
+    assert pool.match_prefix([99] * 10) == []
+    # release: registered blocks park in the LRU (still hittable),
+    # unregistered go straight to the free list
+    pool.release(ids)
+    assert pool.stats()["cached"] == 2
+    assert pool.allocatable() == 4
+    assert pool.match_prefix(prompt) == ids[:2]
+    # allocation pressure evicts the LEAST recently released first and
+    # unregisters it; a later match stops at the broken chain
+    taken = pool.alloc(3)  # free list has 2 -> evicts one cached block
+    assert pool.stats()["evictions"] == 1
+    assert pool.match_prefix(prompt) in ([], ids[:1])
+    pool.release(taken)
+    dropped = pool.drop_cache()
+    assert pool.stats()["cached"] == 0
+    assert dropped >= 1
+
+
+def test_pool_register_first_writer_wins():
+    pool = paging.BlockPool(4, 4)
+    prompt = list(range(6))
+    a, b = pool.alloc(2)
+    pool.register(prompt, 4, a)
+    pool.register(prompt, 4, b)  # duplicate chain: no-op
+    assert pool.match_prefix(prompt) == [a]
+    pool.release([a, b])
+    # b was never registered -> free list; a -> LRU
+    assert pool.stats()["cached"] == 1
+
+
+# -- the three-way bitwise pin ------------------------------------------
+
+
+def test_three_way_bitwise_paged_contiguous_solo(lm):
+    """THE acceptance pin: mixed-length requests through the paged
+    engine, the pre-paged contiguous engine, and solo ``generate`` all
+    emit exactly the same tokens at temperature=0."""
+    dec, params = lm
+    rng = np.random.RandomState(0)
+    reqs = []
+    for _ in range(6):
+        p = rng.randint(0, V, size=rng.randint(3, 20)).tolist()
+        reqs.append((p, int(rng.randint(1, 10))))
+    want = [_solo(dec, params, p, mn) for p, mn in reqs]
+    with serving.DecodeEngine(dec, params, slots=2) as eng:
+        assert eng._paged  # paged is the default engine
+        paged = [h.result(300) for h in
+                 [eng.submit(p, mn) for p, mn in reqs]]
+    with serving.DecodeEngine(dec, params, slots=2,
+                              kv_block_size=0) as eng:
+        assert not eng._paged
+        contig = [h.result(300) for h in
+                  [eng.submit(p, mn) for p, mn in reqs]]
+    assert paged == want
+    assert contig == want
+
+
+def test_warm_prefix_bitwise_and_hit_counters(lm):
+    """A warm-prefix admission (block-table pointing at shared blocks,
+    tail-only prefill) must be bitwise-identical to its cold twin —
+    and provably WARM (hit counters, fewer prefilled tokens)."""
+    dec, params = lm
+    rng = np.random.RandomState(3)
+    sys_prompt = rng.randint(0, V, size=40).tolist()  # 2 full 16-blocks
+    reqs = [(sys_prompt + rng.randint(0, V, size=4).tolist(), 8)
+            for _ in range(3)]
+    want = [_solo(dec, params, p, mn) for p, mn in reqs]
+    with serving.DecodeEngine(dec, params, slots=2,
+                              kv_block_size=16) as eng:
+        # serial: the first request is cold and registers the prefix,
+        # the rest hit its blocks
+        got = [eng.submit(p, mn).result(300) for p, mn in reqs]
+        counts = _counts(eng)
+        stats = eng.load_stats()
+    assert got == want
+    assert counts.get("prefix_hit_blocks", 0) == 4  # 2 blocks x 2 warm
+    assert counts.get("prefix_miss_blocks", 0) == 2  # the cold twin
+    assert stats["prefix_hit_rate"] > 0.5
+    # all blocks returned; the shared prefix is retained as cache
+    assert stats["kv_blocks_free"] == stats["kv_blocks_total"]
+
+
+def test_identical_prompt_full_hit_still_generates(lm):
+    """A FULLY cached prompt still leaves >= 1 tail token for the
+    prefill forward (the logits its first token samples from), and its
+    output replays bitwise."""
+    dec, params = lm
+    prompt = list(range(16)) * 2  # 32 tokens = 2 exact blocks of 16
+    want = _solo(dec, params, prompt, 6)
+    with serving.DecodeEngine(dec, params, slots=2,
+                              kv_block_size=16) as eng:
+        assert eng.submit(prompt, 6).result(300) == want
+        assert eng.submit(prompt, 6).result(300) == want
+        # sharing is capped at (len-1)//bs = 1 block: the second block
+        # holds the last prompt token, which the tail must recompute
+        assert _counts(eng).get("prefix_hit_blocks", 0) == 1
+
+
+def test_live_shared_prefix_admits_concurrently(lm):
+    """Sharing a LIVE prefix block costs no pool capacity: with the
+    pool nearly exhausted by request A (32-token shared prefix + tail,
+    3 of 4 blocks live), a same-prefix request B must still admit
+    CONCURRENTLY — its plan needs only its 1 tail block, not
+    tail + prefix. (Regression: the admission gate once counted live
+    shared blocks against allocatable and serialized exactly this
+    workload.) Both ride the same decode steps, so B's 4 tokens finish
+    strictly before A's 12 — impossible if B had waited for A."""
+    dec, params = lm
+    sys_prompt = list(range(1, 17)) + list(range(16, 0, -1))  # 2 blocks
+    with serving.DecodeEngine(dec, params, slots=2, kv_block_size=16,
+                              kv_blocks=4) as eng:
+        a = eng.submit(sys_prompt + [3], 12)
+        deadline = time.monotonic() + 60
+        while not a.generated:  # A's prefix is registered and LIVE
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        b = eng.submit(sys_prompt + [5], 4)
+        got_b = b.result(120)
+        assert not a._done.is_set(), \
+            "B should finish mid-A (concurrent admission)"
+        got_a = a.result(120)
+        assert _counts(eng).get("prefix_hit_blocks", 0) == 2
+        assert _counts(eng).get("preemptions", 0) == 0
+    assert got_a == _solo(dec, params, sys_prompt + [3], 12)
+    assert got_b == _solo(dec, params, sys_prompt + [5], 4)
+
+
+def test_preemption_continuation_bitwise(lm):
+    """Pool exhaustion preempts the youngest admission (blocks freed,
+    requeued at front); its continuation re-prefill must resume the
+    stream bitwise-identically."""
+    dec, params = lm
+    rng = np.random.RandomState(5)
+    p1 = rng.randint(0, V, size=9).tolist()
+    p2 = rng.randint(0, V, size=9).tolist()
+    want = [_solo(dec, params, p1, 20), _solo(dec, params, p2, 20)]
+    # each request grows to ceil(29/8)=4 blocks; two need 8 > 5
+    with serving.DecodeEngine(dec, params, slots=2, kv_block_size=8,
+                              kv_blocks=5, prefix_cache=False) as eng:
+        h1 = eng.submit(p1, 20)
+        h2 = eng.submit(p2, 20)
+        got = [h1.result(300), h2.result(300)]
+        counts = _counts(eng)
+        pool = eng._pool
+    assert counts.get("preemptions", 0) >= 1
+    assert got == want
+    assert pool.live_refs() == {} and pool.allocatable() == 5
+
+
+def test_paged_outperforms_contiguous_capacity(lm):
+    """The memory story: 6 sequences whose worst case is 18 blocks all
+    serve correctly through an 8-block pool (the contiguous layout
+    would need 6 full-length slots), and the paged pool at that budget
+    is smaller than the contiguous cache it replaces."""
+    dec, params = lm
+    rng = np.random.RandomState(6)
+    reqs = [(rng.randint(0, V, size=9).tolist(), 15) for _ in range(6)]
+    want = [_solo(dec, params, p, mn) for p, mn in reqs]
+    with serving.DecodeEngine(dec, params, slots=6, kv_block_size=8,
+                              kv_blocks=8, prefix_cache=False) as eng:
+        paged_bytes = eng.kv_cache_bytes()
+        got = [h.result(600) for h in
+               [eng.submit(p, mn) for p, mn in reqs]]
+    assert got == want
+    with serving.DecodeEngine(dec, params, slots=6,
+                              kv_block_size=0) as eng:
+        contig_bytes = eng.kv_cache_bytes()
+    # 9 blocks of 8 tokens resident (incl. scratch) vs 6 x 64 rows
+    assert paged_bytes < contig_bytes / 4
+
+
+def test_block_pressure_prices_admission_and_sheds(lm):
+    """Admission honesty under block pressure: a request whose prefill
+    blocks are unobtainable gets its queue wait floored at the earliest
+    possible release, so a deadline feasible by slot math alone sheds
+    (503 + Retry-After) instead of queueing into a 504."""
+    dec, params = lm
+    with serving.DecodeEngine(dec, params, slots=8, kv_block_size=16,
+                              kv_blocks=4, prefix_cache=False) as eng:
+        # warm the EWMAs (cold engines never shed)
+        eng.submit([1, 2, 3], 2).result(300)
+        # blocker takes all 4 blocks at admission and decodes a while
+        blocker = eng.submit((list(range(1, 14)) * 4)[:50], 14)
+        deadline = time.monotonic() + 60
+        while _counts(eng).get("prefills", 0) < 2:
+            assert time.monotonic() < deadline, "blocker never admitted"
+            time.sleep(0.005)
+        probe = [4, 5, 6, 7]
+        plain = eng.estimate_admission(4)
+        priced = eng.estimate_admission(4, prompt=probe)
+        # the block floor is visible in the estimate itself
+        assert priced["queue_wait_s"] > plain["queue_wait_s"]
+        # a deadline the slot math would admit but the block math
+        # cannot meet -> Shed at the door
+        infeasible = (plain["queue_wait_s"] + plain["service_s"]
+                      + priced["queue_wait_s"] + priced["service_s"]) / 2
+        with pytest.raises(serving.Shed):
+            eng.submit(probe, 4, deadline_s=infeasible)
+        assert _counts(eng).get("shed", 0) == 1
+        assert isinstance(serving.Shed("x"), serving.Retriable)
+        blocker.result(600)
+
+
+def test_validate_rejects_request_larger_than_pool(lm):
+    dec, params = lm
+    with serving.DecodeEngine(dec, params, slots=2, kv_block_size=16,
+                              kv_blocks=2) as eng:
+        with pytest.raises(ValueError, match="KV blocks"):
+            eng.submit(list(range(1, 9)), 30)  # 38 tokens = 3 blocks
+        # a fitting request still serves
+        assert len(eng.submit([1, 2], 4).result(300)) == 6
+
+
+def test_contiguous_mode_rejects_kv_blocks_and_reports_zeroes(lm):
+    dec, params = lm
+    with pytest.raises(ValueError, match="paged"):
+        serving.DecodeEngine(dec, params, slots=1, kv_block_size=0,
+                             kv_blocks=4)
+    with serving.DecodeEngine(dec, params, slots=1,
+                              kv_block_size=0) as eng:
+        stats = eng.load_stats()
+        assert stats["kv_blocks_total"] == 0
+        assert stats["kv_blocks_free"] == 0
+        assert stats["prefix_hit_rate"] == 0.0
+
+
+def test_solo_generate_rejects_paged_model(lm):
+    dec, params = lm
+    paged = dec.clone(kv_block_size=16, kv_blocks=9)
+    with pytest.raises(ValueError, match="contiguous"):
+        generation.generate(paged, params, jnp.asarray([[1, 2]]), 4)
+
+
+def test_healthz_and_load_stats_carry_block_pool(lm):
+    """The pinned operator schema: /healthz and the BEAT-riding
+    load_stats both carry kv_blocks_free / kv_blocks_total /
+    prefix_hit_rate."""
+    dec, params = lm
+    with serving.DecodeEngine(dec, params, slots=2) as eng:
+        eng.submit([1, 2, 3], 2).result(300)
+        server = serving.ModelServer(None, engine=eng, name="m")
+        code, body = server.healthz()
+        assert code == 200
+        assert body["kv_blocks_total"] == eng.kv_blocks > 0
+        assert body["kv_blocks_free"] == eng.kv_blocks
+        assert body["prefix_hit_rate"] == 0.0
+        stats = eng.load_stats()
+        assert stats["kv_blocks_total"] == eng.kv_blocks
+        gauges = eng.counters.snapshot()["gauges"]
+        assert gauges["kv_blocks_total"] == eng.kv_blocks
+        assert gauges["kv_blocks_free"] == eng.kv_blocks
+        server.engine = None  # the engine is this test's to stop
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_leak_churn_cancel_disconnect_evict_drain(lm):
+    """The leak-proofing pin: a churn loop of cancel / injected client
+    disconnect / deadline eviction / drain returns EVERY block — live
+    refcounts empty, the allocatable set back to full, and after
+    flushing the (deliberate) prefix-cache retention the literal free
+    list is full too. No orphaned shared blocks."""
+    dec, params = lm
+    rng = np.random.RandomState(9)
+    eng = serving.DecodeEngine(dec, params, slots=2, kv_block_size=8,
+                               kv_blocks=12)
+    try:
+        pool = eng._pool
+        for round_ in range(3):
+            prompt = rng.randint(0, V, size=18).tolist()  # shares blocks
+            # 1) explicit cancel mid-decode
+            victim = eng.submit(prompt, 30)
+            deadline = time.monotonic() + 60
+            while not victim.generated:
+                assert time.monotonic() < deadline
+                time.sleep(0.002)
+            victim.cancel()
+            with pytest.raises(serving.Cancelled):
+                victim.result(120)
+            # 2) injected client disconnect (chaos plane)
+            chaos.arm("disconnect_client_at_token=2")
+            gone = eng.submit(prompt, 30)
+            with pytest.raises(serving.Cancelled):
+                gone.result(120)
+            # 3) deadline eviction mid-decode (blank the rate evidence
+            # so the tight deadline ADMITS — the established idiom from
+            # test_serving_lifecycle — and expires at a step boundary)
+            eng._step_ewma = eng._prefill_ewma = None
+            slow = eng.submit(prompt, 40, deadline_s=0.005)
+            with pytest.raises(serving.DeadlineExceeded):
+                slow.result(120)
+            # plus a request that finishes normally
+            ok = eng.submit(prompt, 3)
+            assert ok.result(120) == _solo(dec, params, prompt, 3)
+            assert chaos.poll_until(
+                lambda: pool.live_refs() == {}, timeout=30), \
+                pool.live_refs()
+            assert pool.allocatable() == 12
+        # 4) drain with work in flight: zero loss, zero leak
+        last = eng.submit(rng.randint(0, V, size=10).tolist(), 6)
+        assert eng.drain(timeout=120) is True
+        assert last.result(5)
+        assert pool.live_refs() == {}
+        assert pool.allocatable() == 12
+        # retention was CACHE, not leak: flushing it fills the literal
+        # free list
+        pool.drop_cache()
+        stats = pool.stats()
+        assert stats["cached"] == 0 and stats["free"] == 12
+    finally:
+        eng.stop()

@@ -9,7 +9,7 @@ PYTHON ?= python
 SHELL := /bin/bash
 
 .PHONY: test test-fast bench smoke install lint native clean chaos \
-  metrics-lint
+  metrics-lint goodput-report
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -25,6 +25,14 @@ tensorflowonspark_tpu/_libshmring.so: native/shm_ring.cpp
 # catalog cannot drift from the code
 metrics-lint:
 	$(PYTHON) scripts/metrics_lint.py
+
+# goodput plane (PR 10): render the badput/straggler tables — hermetic
+# demo here; point scripts/goodput_report.py --url at a live driver's
+# stats port for a real job (the chaos goodput e2e rides `make chaos`
+# via its chaos marker, and `make bench` publishes the goodput leg)
+goodput-report:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+	$(PYTHON) scripts/goodput_report.py --demo
 
 # per-suite wall clock cap via coreutils timeout (pytest-timeout is not a
 # hard dependency); a wedged multi-process test fails CI instead of hanging

@@ -754,21 +754,30 @@ def _serving_fleet_bench(on_tpu, replica_counts=(1, 2, 4)):
 
 
 def _recovery_map_fun(args, ctx):
-    """Supervision-aware trainer for the recovery bench: restore ->
-    attach -> one checkpointed step per batch -> publish. The chaos
-    kill-at-step site fires inside ``sup.step`` — AFTER that step's
-    checkpoint committed and its feed partition was recorded consumed,
-    so a killed step N is restorable at N with nothing double-fed."""
+    """Supervision-aware trainer for the recovery AND goodput legs:
+    restore -> attach -> one checkpointed step per batch -> publish.
+    The chaos kill-at-step site fires inside ``sup.step`` — AFTER that
+    step's checkpoint committed and its feed partition was recorded
+    consumed, so a killed step N is restorable at N with nothing
+    double-fed. ONE copy of that exactly-once protocol serves both
+    benches; ``args["step_s"]`` (goodput leg) adds a synthetic device
+    step of that wall time inside ``ledger.step_span()`` — so the
+    published ratio has a real numerator — and attaches the feed so
+    the step boundary flushes accounting before the kill site."""
     import json as _json
     import os as _os
+    import time as _time
 
     import numpy as _np
 
     from tensorflowonspark_tpu import chaos as _chaos
     from tensorflowonspark_tpu import checkpoint as _checkpoint
+    from tensorflowonspark_tpu import goodput as _goodput
     from tensorflowonspark_tpu import reservation as _reservation
     from tensorflowonspark_tpu import supervisor as _supervisor
 
+    step_s = args.get("step_s")
+    ledger = _goodput.ledger() if step_s else None
     ckpt = _checkpoint.Checkpointer(args["dir"], chief=True)
     like = {"step": _np.array(0, _np.int32),
             "seen": _np.array(0.0, _np.float64)}
@@ -776,9 +785,10 @@ def _recovery_map_fun(args, ctx):
     state = restored if restored is not None else like
     step = int(state["step"])
     start = step
-    sup = _supervisor.attach(
-        ctx, restored_step=step if restored is not None else None)
     feed = ctx.get_data_feed(train_mode=True)
+    sup = _supervisor.attach(
+        ctx, restored_step=step if restored is not None else None,
+        feed=feed if step_s else None)
 
     def _acked_up_to(n):
         # n counts THIS attempt's steps (a reformed cluster's server
@@ -791,18 +801,26 @@ def _recovery_map_fun(args, ctx):
         finally:
             client.close()
 
+    def _advance(batch):
+        return {"step": _np.array(step, _np.int32),
+                "seen": _np.array(float(state["seen"]) + sum(batch),
+                                  _np.float64)}
+
     while not feed.should_stop():
         batch = feed.next_batch(args["batch"])
         if not batch:
             continue
         step += 1
-        state = {"step": _np.array(step, _np.int32),
-                 "seen": _np.array(float(state["seen"]) + sum(batch),
-                                   _np.float64)}
+        if ledger is not None:
+            with ledger.step_span():
+                _time.sleep(step_s)  # the synthetic device step
+                state = _advance(batch)
+        else:
+            state = _advance(batch)
         ckpt.save(step, state, force=True)
         ckpt.wait()
         _acked_up_to(step - start)  # one partition == one step
-        sup.step(step)  # chaos kill site
+        sup.step(step)  # (flushes accounting, then) chaos kill site
     ckpt.close()
     with open(_os.path.join(args["dir"], "final.json"), "w") as f:
         _json.dump({"step": step, "seen": float(state["seen"])}, f)
@@ -1077,6 +1095,125 @@ def _shrink_recovery_bench(batch=4, parts=8, return_after=3600.0,
         shutil.rmtree(work, ignore_errors=True)
 
 
+def _ledger_overhead(step_s):
+    """Per-operation cost of the accounting itself, measured: one
+    track() enter/exit cycle and one note_step, amortized over 20k
+    reps, against the leg's step time — the <1%-of-step acceptance
+    bound."""
+    from tensorflowonspark_tpu import goodput as goodput_mod
+
+    ledger = goodput_mod.GoodputLedger(flight=False)
+    reps = 20000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        with ledger.track("feed_wait"):
+            pass
+    track_s = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ledger.note_step(1e-7)
+    note_s = (time.perf_counter() - t0) / reps
+    # per step the framework pays ~1 step_span + ~2 track cycles
+    # (feed wait + checkpoint)
+    per_step = note_s + 2 * track_s
+    return {"track_cycle_us": round(track_s * 1e6, 3),
+            "note_step_us": round(note_s * 1e6, 3),
+            "frac_of_step": round(per_step / step_s, 6) if step_s
+            else None}
+
+
+def _goodput_bench(batch=4, parts=8, kill_step=3, stall_s=2.0,
+                   step_s=0.2, max_restarts=2):
+    """Goodput accounting under chaos: one supervised job with an
+    injected consumer stall (batch 1) AND a trainer SIGKILL (after
+    ``kill_step``'s checkpoint) — recovery included — publishing the
+    job goodput ratio, per-category badput, the sum-to-wall invariant
+    residual, and the measured ledger overhead. The same harness the
+    chaos e2e in tests/test_goodput.py pins."""
+    import shutil
+    import tempfile
+
+    from tensorflowonspark_tpu import cluster, goodput, supervisor
+    from tensorflowonspark_tpu import chaos as chaos_mod  # noqa: F401
+    from tensorflowonspark_tpu.engine import Context
+
+    work = tempfile.mkdtemp(prefix="tfos-goodput-")
+    ckpt_dir = os.path.join(work, "ckpt")
+    os.makedirs(ckpt_dir)
+    kill_fuse = os.path.join(work, "kill_fuse")
+    stall_fuse = os.path.join(work, "stall_fuse")
+    records = list(range(batch * parts))
+    try:
+        spec = ("kill_trainer_at_step={},fuse={};"
+                "stall_consumer_for={},fuse={}").format(
+                    kill_step, kill_fuse, stall_s, stall_fuse)
+        sc = Context(
+            num_executors=1, work_root=os.path.join(work, "engine"),
+            executor_env={
+                "TFOS_CHAOS": spec,
+                "TFOS_FEED_TRANSPORT": "queue",
+                "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+        cfg = supervisor.SupervisorConfig(
+            policy=supervisor.RestartFromCheckpoint(
+                max_restarts=max_restarts, backoff=0.1),
+            heartbeat_interval=0.25, heartbeat_timeout=20.0,
+            poll_interval=0.1, classify_grace=10.0)
+        t0 = time.monotonic()
+        try:
+            tfc = cluster.run(sc, _recovery_map_fun,
+                              {"dir": ckpt_dir, "batch": batch,
+                               "step_s": step_s},
+                              num_executors=1,
+                              input_mode=cluster.InputMode.SPARK,
+                              supervise=cfg)
+            tfc.train(sc.parallelize(records, parts), feed_timeout=120)
+        finally:
+            sc.stop()
+        wall = time.monotonic() - t0
+        report = tfc.goodput_report()
+        rep = tfc.report()
+        with open(os.path.join(ckpt_dir, "final.json")) as f:
+            final = json.load(f)
+        # snapshot-internal invariant: categories vs the wall gauge
+        # each executor published ATOMICALLY with them
+        rollup = tfc.metrics() or {}
+        merged = rollup.get("cluster", {}).get("merged")
+        cats = goodput.merged_categories(merged)
+        wall_gauge = (((merged or {}).get("counters") or {})
+                      .get("tfos_goodput") or {}).get("gauges", {}) \
+            .get("wall_seconds", 0.0)
+        accounted = sum(cats.values())
+        return {
+            "workload": {"partitions": parts, "batch": batch,
+                         "kill_at_step": kill_step,
+                         "stall_s": stall_s, "step_s": step_s},
+            "injection_fired": {
+                "kill": os.path.exists(kill_fuse),
+                "stall": os.path.exists(stall_fuse)},
+            "report": report,
+            # per-executor skew rows (goodput.skew_rows shape) so
+            # `goodput_report.py --from-bench` renders a real
+            # straggler table instead of "no step-time skew data"
+            "stragglers": goodput.skew_rows(rollup.get("executors")),
+            "goodput_ratio": report["goodput_ratio"],
+            "badput": report["badput"],
+            "unaccounted_frac_of_wall": round(
+                report["unaccounted_s"] / report["wall_s"], 4)
+            if report["wall_s"] else None,
+            "snapshot_residual_frac": round(
+                abs(accounted - wall_gauge) / wall_gauge, 4)
+            if wall_gauge else None,
+            "ledger_overhead": _ledger_overhead(step_s),
+            "formations": rep["formations"],
+            "failure_kinds": [f["kind"] for f in rep["failures"]],
+            "exactly_once": final["step"] == parts and
+            final["seen"] == float(sum(records)),
+            "wall_s": round(wall, 3),
+        }
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def _probe_platform():
     """Device platform WITHOUT initializing jax in this process.
 
@@ -1255,6 +1392,19 @@ def main():
                       file=sys.stderr)
                 recovery["shrink"] = {"error": str(e)}
 
+    # Goodput plane (PR 10): badput-attributed wall time of a short
+    # supervised job under one injected consumer stall + one trainer
+    # kill — publishes the goodput ratio, per-category badput, the
+    # sum-to-wall residual, and the ledger's own measured overhead.
+    # Shares the fed gate; TFOS_BENCH_GOODPUT=0 skips it.
+    goodput_leg = None
+    if fed_enabled and os.environ.get("TFOS_BENCH_GOODPUT", "1") == "1":
+        try:
+            goodput_leg = _goodput_bench()
+        except Exception as e:  # noqa: BLE001 - report, not die
+            print("goodput bench failed: {}".format(e), file=sys.stderr)
+            goodput_leg = {"error": str(e)}
+
     # The device-only spin has no engine timeouts around it: a tunnel
     # that dies mid-run (observed round 5 — it served the fed runs then
     # wedged on the very next client, inside a C-level PJRT call that no
@@ -1366,6 +1516,9 @@ def main():
         # supervision plane MTTR: injected trainer SIGKILL -> detect ->
         # reform -> restore -> first step (PR 3; docs/fault_tolerance.md)
         "recovery": recovery,
+        # goodput plane (PR 10): badput-attributed wall time + ledger
+        # overhead under an injected stall + kill + recovery
+        "goodput": goodput_leg,
     }))
 
 

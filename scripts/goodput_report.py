@@ -1,0 +1,141 @@
+"""Render a job's goodput ledger + straggler table (goodput plane).
+
+Reads the SAME accounting the driver exposes — the goodput families
+(``tfos_badput_seconds``/``tfos_goodput_*``) that ride each executor's
+BEAT-carried registry snapshot, plus the driver-computed
+``tfos_train_step_skew`` — and prints the operator view: headline
+goodput ratio, badput table sorted by cost, per-executor skew table.
+Formatting comes from the shared ``metrics_report`` helpers, so the
+bench's goodput leg, this CLI, and a scrape all describe one ledger.
+
+Three sources:
+
+    # a live driver's stats endpoint (cluster.metrics_url() minus the
+    # /metrics suffix — the JSON sibling):
+    python scripts/goodput_report.py --url http://DRIVER:PORT
+
+    # a bench artifact's goodput block (bench.py output JSON):
+    python scripts/goodput_report.py --from-bench bench.json
+
+    # hermetic demo: a synthetic 8-step run with a feed wait, a
+    # checkpoint, a restore, and one reform window (no cluster, <1s):
+    python scripts/goodput_report.py --demo
+
+Exit code 0; ``make goodput-report`` runs the demo.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tensorflowonspark_tpu import goodput, metrics_report, tracing  # noqa: E402
+
+
+def _fetch_stats(url):
+    import urllib.request
+
+    with urllib.request.urlopen(url.rstrip("/") + "/stats",
+                                timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def report_from_stats(stats):
+    """(job-ish goodput report, straggler rows) from a driver
+    ``/stats`` document. Wall time is not knowable from a scrape (the
+    ledger families are cumulative seconds, not a wall clock), so the
+    report's denominator is the ACCOUNTED time — ratios read as
+    share-of-accounted rather than share-of-wall; the bench leg and
+    ``SupervisedCluster.goodput_report()`` carry the true wall-clock
+    form."""
+    merged = (stats.get("cluster") or {}).get("merged") or {}
+    cats = goodput.merged_categories(merged)
+    accounted = sum(cats.values())
+    productive = cats.get(goodput.PRODUCTIVE, 0.0)
+    report = {
+        "wall_s": round(accounted, 6),
+        "productive_s": round(productive, 6),
+        "goodput_ratio": round(productive / accounted, 6)
+        if accounted else 0.0,
+        "badput": {c: round(cats.get(c, 0.0), 6)
+                   for c in goodput.BADPUT},
+        "unaccounted_s": None,
+    }
+    return report, goodput.skew_rows(stats.get("executors"))
+
+
+def _demo():
+    """Drive one ledger through every category deterministically (tiny
+    sleeps — the point is the table, not the durations)."""
+    import time
+
+    ledger = goodput.GoodputLedger(flight=tracing.FlightRecorder())
+    with ledger.track("restore"):
+        time.sleep(0.02)
+    for step in range(8):
+        with ledger.track("feed_wait"):
+            time.sleep(0.002)
+        with ledger.step_span():          # step 1 charges as compile
+            time.sleep(0.01)
+        if step % 4 == 3:
+            with ledger.track("checkpoint_save"):
+                time.sleep(0.008)
+    with ledger.track("reform"):
+        time.sleep(0.015)
+    report = ledger.report()
+    skews = goodput.step_skew({
+        0: {"metrics": {"counters": {"tfos_goodput": {"gauges": {
+            "step_ewma_seconds": ledger.step_ewma_s}}}}},
+        1: {"metrics": {"counters": {"tfos_goodput": {"gauges": {
+            "step_ewma_seconds": (ledger.step_ewma_s or 0.01) * 4}}}}},
+    })
+    rows = [{"executor": eid, "skew": skew} for eid, skew in
+            sorted(skews.items())]
+    return report, rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render a job's goodput ledger + straggler table")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="driver stats base URL (reads "
+                                   "GET /stats)")
+    src.add_argument("--from-bench", metavar="JSON",
+                     help="bench.py artifact; renders its 'goodput' "
+                          "block")
+    src.add_argument("--demo", action="store_true",
+                     help="hermetic synthetic ledger run")
+    args = ap.parse_args(argv)
+
+    if args.demo:
+        report, rows = _demo()
+    elif args.from_bench:
+        with open(args.from_bench) as f:
+            artifact = json.load(f)
+        block = artifact.get("goodput") or {}
+        if block.get("error"):
+            # a failed bench leg must not render as a zeroed-but-valid
+            # table ("goodput 0.00%" reads as a catastrophic ratio,
+            # not a failed measurement)
+            print("bench goodput leg failed: {}".format(block["error"]),
+                  file=sys.stderr)
+            return 1
+        report = block.get("report") or block
+        rows = block.get("stragglers") or []
+        if not report or "badput" not in report:
+            print("no goodput block in {}".format(args.from_bench),
+                  file=sys.stderr)
+            return 1
+    else:
+        report, rows = report_from_stats(_fetch_stats(args.url))
+
+    print(metrics_report.format_goodput(report))
+    print()
+    print(metrics_report.format_straggler_table(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,15 +8,24 @@ directly in Perfetto (https://ui.perfetto.dev) or chrome://tracing:
 open the output file and every request is a labeled row whose spans
 nest inside its admit->finish envelope.
 
-Two sources:
+Three sources:
 
-    # a live server's ring (ModelServer GET /debug/trace):
+    # a live server's ring (ModelServer GET /debug/trace; a fleet
+    # ROUTER's URL dumps the STITCHED cross-replica timeline instead):
     python scripts/trace_dump.py --url http://HOST:PORT -o trace.json
 
     # hermetic demo: a tiny in-process engine serves --requests
     # mixed-length generations and dumps their spans (CPU, no server):
     JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     python scripts/trace_dump.py --demo [--requests 3] -o trace.json
+
+    # hermetic TRAINING demo (goodput plane, PR 10): a tiny Trainer
+    # runs a few steps with the goodput ledger mirroring its
+    # compile / train_step / checkpoint / feed-wait intervals into a
+    # private FlightRecorder — the training-run timeline (traces were
+    # serving-only before):
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python scripts/trace_dump.py --train-demo [--steps 6] -o trace.json
 
 ``-o -`` (default) writes to stdout. The schema tests in
 tests/test_observability.py pin the output shape: every span event
@@ -69,6 +78,45 @@ def _demo(n_requests):
         return engine.flight.chrome_trace()
 
 
+def _train_demo(n_steps):
+    """Run ``n_steps`` tiny training steps through ``training.Trainer``
+    with a PRIVATE ledger+recorder: the goodput ledger mirrors every
+    interval (compile / train_step / checkpoint_save / feed_wait) into
+    the ring, so the dump is a training-run timeline."""
+    import flax.linen as nn
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import goodput, tracing, training
+
+    class TinyMLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(nn.relu(nn.Dense(8)(x)))
+
+    flight = tracing.FlightRecorder()
+    ledger = goodput.GoodputLedger(flight=flight)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("data",))
+    trainer = training.Trainer(model=TinyMLP(),
+                               optimizer=optax.sgd(1e-2), mesh=mesh)
+    rng = np.random.RandomState(0)
+    sample = {"x": rng.randn(4, 8).astype(np.float32),
+              "y": rng.randint(0, 4, size=4)}
+    state = trainer.init(jax.random.PRNGKey(0), sample["x"])
+
+    def batches():
+        import time as _time
+        for _ in range(n_steps):
+            with ledger.track("feed_wait"):  # a stand-in feed stall
+                _time.sleep(0.002)
+            yield {"x": rng.randn(4, 8).astype(np.float32),
+                   "y": rng.randint(0, 4, size=4)}
+
+    trainer.train_loop(state, batches(), log_every=0, ledger=ledger)
+    return flight.chrome_trace()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="dump a serving trace timeline as Perfetto-loadable "
@@ -78,13 +126,23 @@ def main(argv=None):
                                    "GET /debug/trace ring")
     src.add_argument("--demo", action="store_true",
                      help="hermetic in-process engine run (CPU)")
+    src.add_argument("--train-demo", action="store_true",
+                     help="hermetic in-process TRAINING run (CPU): "
+                          "goodput-ledger spans on the timeline")
     ap.add_argument("--requests", type=int, default=3,
                     help="demo-mode request count (default 3)")
+    ap.add_argument("--steps", type=int, default=6,
+                    help="train-demo step count (default 6)")
     ap.add_argument("-o", "--out", default="-",
                     help="output path ('-' = stdout)")
     args = ap.parse_args(argv)
 
-    trace = _demo(args.requests) if args.demo else _fetch(args.url)
+    if args.demo:
+        trace = _demo(args.requests)
+    elif args.train_demo:
+        trace = _train_demo(args.steps)
+    else:
+        trace = _fetch(args.url)
     spans = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
     if args.out == "-":
         json.dump(trace, sys.stdout)

@@ -232,8 +232,15 @@ class Checkpointer(object):
             # at orbax's barrier while others return False.
             return False
         state = jax.tree.map(lambda x: x, state)  # shallow copy
-        saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
-                               force=force)
+        from tensorflowonspark_tpu import goodput
+        with goodput.ledger().track("checkpoint_save"):
+            # the synchronous slice of the save (orbax may commit
+            # asynchronously; wait() time lands here too via the same
+            # category when callers block on it) — the goodput plane's
+            # checkpoint_save badput
+            saved = self._mgr.save(step,
+                                   args=ocp.args.StandardSave(state),
+                                   force=force)
         if saved:
             self._saved_steps.add(step)
             # fault-injection site (chaos.py corrupt_checkpoint=N):
@@ -284,11 +291,13 @@ class Checkpointer(object):
                                 reverse=True)
         if not candidates:
             return None
+        from tensorflowonspark_tpu import goodput
         first_error = None
         for s in candidates:
             try:
-                return self._mgr.restore(
-                    s, args=ocp.args.StandardRestore(state_like))
+                with goodput.ledger().track("restore"):
+                    return self._mgr.restore(
+                        s, args=ocp.args.StandardRestore(state_like))
             except Exception as e:  # noqa: BLE001 - orbax raises variously
                 if not fallback:
                     raise
@@ -302,7 +311,10 @@ class Checkpointer(object):
             "(tried {})".format(self.directory, candidates)) from first_error
 
     def wait(self):
-        self._mgr.wait_until_finished()
+        from tensorflowonspark_tpu import goodput
+        with goodput.ledger().track("checkpoint_save"):
+            # blocking on an async commit is checkpoint badput too
+            self._mgr.wait_until_finished()
 
     def close(self):
         self._mgr.close()

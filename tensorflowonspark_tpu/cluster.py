@@ -205,9 +205,12 @@ class TFCluster(object):
         merged}}`` where ``merged`` sums every executor's feed-stage
         timers and counters (``tracing.merge_snapshots``). The same
         view the driver's stats endpoint serves over HTTP — see
-        :meth:`metrics_url` and docs/observability.md."""
-        from tensorflowonspark_tpu import tracing
-        return tracing.cluster_rollup(self.server.metrics_snapshot())
+        :meth:`metrics_url` and docs/observability.md. Per-executor
+        views carry ``step_skew`` (goodput plane) once trainers have
+        beaten step-time EWMAs."""
+        from tensorflowonspark_tpu import goodput, tracing
+        return tracing.cluster_rollup(
+            goodput.attach_step_skew(self.server.metrics_snapshot()))
 
     def metrics_url(self):
         """URL of the driver-side OpenMetrics exposition (the

@@ -46,6 +46,7 @@ import numpy as np
 
 from tensorflowonspark_tpu import chaos
 from tensorflowonspark_tpu import frames as frames_lib
+from tensorflowonspark_tpu import goodput as goodput_mod
 from tensorflowonspark_tpu import tracing
 from tensorflowonspark_tpu.frames import ColumnarChunk
 from tensorflowonspark_tpu.marker import EndFeed, EndPartition, Marker
@@ -189,6 +190,18 @@ class DataFeed(object):
         self.metrics = tracing.MetricsRegistry()
         self.metrics.add_counters("tfos_feed", self._counts)
         self.metrics.add_timers("tfos_feed_stage", self.timers)
+        # Goodput plane (goodput.py): the PROCESS ledger registers into
+        # this registry, so the beat-piggybacked snapshot carries the
+        # trainer's wall-time classification (productive steps, compile,
+        # checkpoint, feed waits) to the driver on the channel the feed
+        # metrics already ride — and this feed charges its blocked
+        # transport reads to it as ``feed_wait``.
+        self.goodput = goodput_mod.ledger()
+        self.goodput.register(self.metrics)
+        # the trainer's span ring (train_step/compile/badput spans land
+        # in the process recorder): surface its eviction tally too
+        tracing.expose_flight_drops(self.metrics,
+                                    tracing.flight_recorder())
         try:
             # publish the (empty) snapshot immediately: an executor
             # whose feed never serves a batch still beats a metrics
@@ -258,7 +271,12 @@ class DataFeed(object):
                 # hand and stays zero-copy.
                 _unpin_segments(segs)
             t0 = time.monotonic()
-            item = self._next_item()
+            with self.goodput.track("feed_wait"):
+                # blocked-on-transport time (decode included — it is
+                # part of what the trainer waits on) is feed_wait
+                # badput; innermost-wins nesting keeps it out of any
+                # enclosing productive_step claim
+                item = self._next_item()
             self._wait_s += time.monotonic() - t0
             if isinstance(item, Marker):
                 self._item_done()
@@ -298,8 +316,11 @@ class DataFeed(object):
             self._last_progress = time.monotonic()
             self._heartbeat()
             # deterministic fault injection (chaos.py): kill/stall sites
-            # keyed on batches served — a no-op O(1) check when unarmed
-            chaos.on_batch(self, self._counts.get("batches"))
+            # keyed on batches served — a no-op O(1) check when unarmed.
+            # An injected consumer stall is feed-plane badput: charge
+            # it where a real stalled transport would land
+            with self.goodput.track("feed_wait"):
+                chaos.on_batch(self, self._counts.get("batches"))
         if self.done_feeding and not self._metrics_flushed:
             # final flush at end-of-feed: the 2s heartbeat throttle
             # otherwise leaves everything since the last publish — on a
@@ -319,6 +340,15 @@ class DataFeed(object):
         if chaos.on_heartbeat():  # injected heartbeat outage: do NOT
             return                # advance the throttle — retry next batch
         self._hb_at = now
+        self._publish_metrics()
+
+    def publish_metrics(self):
+        """Force-publish progress + the registry snapshot NOW,
+        bypassing the 2s heartbeat throttle (and re-arming it). The
+        supervised step boundary calls this so a trainer killed right
+        after a step loses at most the publish-to-beat gap of goodput
+        accounting, not a whole throttle window."""
+        self._hb_at = time.monotonic()
         self._publish_metrics()
 
     def _publish_metrics(self):

@@ -345,17 +345,21 @@ class _ClientGone(RuntimeError):
 
 
 def _http_request(addr, method, path, body=None, timeout=600.0,
-                  abort=None):
+                  abort=None, extra_headers=None):
     """One plain HTTP exchange -> (status, raw body bytes, headers).
 
     ``abort`` (zero-arg callable): polled while the exchange runs;
     when it turns True the upstream connection is CLOSED — the replica
     sees socket EOF and cancels the in-flight body exactly as it would
     for a directly-connected client — and :class:`_ClientGone` is
-    raised. Without ``abort`` the exchange is a plain blocking call."""
+    raised. Without ``abort`` the exchange is a plain blocking call.
+    ``extra_headers``: request headers to add (the trace-propagation
+    ``X-TFOS-Trace`` rides this)."""
     conn = http.client.HTTPConnection(addr[0], int(addr[1]),
                                       timeout=timeout)
     headers = {"Content-Type": "application/json"} if body else {}
+    if extra_headers:
+        headers.update(extra_headers)
     if abort is None:
         try:
             conn.request(method, path, body=body, headers=headers)
@@ -459,6 +463,13 @@ class FleetRouter(object):
             "tfos_fleet_upstream_seconds")
         self._hist_overhead = self.metrics.histogram(
             "tfos_fleet_route_overhead_seconds")
+        #: the router's own span ring (trace-context propagation): one
+        #: minted trace id per client request, a ``dispatch`` envelope
+        #: plus one ``upstream`` span per attempt — stitched with the
+        #: replicas' rings by GET /debug/trace into the end-to-end
+        #: timeline of a (possibly failed-over) request
+        self.flight = tracing.FlightRecorder()
+        tracing.expose_flight_drops(self.metrics, self.flight)
         self._inflight = {}
         self._inflight_lock = threading.Lock()
         # every histogram/timer/counter write goes through this lock:
@@ -536,11 +547,23 @@ class FleetRouter(object):
         t0 = time.monotonic()
         upstream_spent = [0.0]
         tried = set()
+        # upstream attempts actually made — counted explicitly because
+        # ``tried`` is CLEARED when every replica has been attempted
+        # once (so a same-replica retry can proceed), and len(tried)
+        # would then under-report a real failover on the dispatch span
+        attempts_made = [0]
+        # ONE trace id per client request, minted here and forwarded
+        # to every upstream attempt via X-TFOS-Trace — failover
+        # attempts REUSE it, so the replicas' engine spans and this
+        # router's spans share a timeline row end to end
+        trace = tracing.mint_trace_id()
+        status = None
         try:
             try:
                 status, body, headers = serving.retry_call(
                     lambda: self._attempt(raw_body, tried,
-                                          upstream_spent, client_gone),
+                                          upstream_spent, client_gone,
+                                          trace, attempts_made),
                     attempts=self.attempts, base_delay=self.base_delay,
                     max_delay=self.max_delay)
                 retry_after = None
@@ -556,7 +579,12 @@ class FleetRouter(object):
             # counts: tfos_fleet_requests is "requests the router
             # answered (ANY status)" and the latency/overhead
             # histograms must not silently exclude disconnects
-            wall = time.monotonic() - t0
+            now = time.monotonic()
+            wall = now - t0
+            self.flight.span("dispatch", t0, now, trace=trace,
+                             status=status if status is not None
+                             else "client_gone",
+                             attempts=attempts_made[0] or 1)
             with self._obs_lock:
                 self.counters.inc("requests")
                 self._hist_request.observe(wall)
@@ -565,7 +593,7 @@ class FleetRouter(object):
         return status, body, retry_after
 
     def _attempt(self, raw_body, tried, upstream_spent,
-                 client_gone=None):
+                 client_gone=None, trace=0, attempts_made=None):
         """One dispatch attempt: pick the best untried replica, POST,
         classify the outcome. Raises Retriable to make retry_call fail
         over; anything else returns verbatim for the client."""
@@ -602,12 +630,15 @@ class FleetRouter(object):
                 "replica {} has no advertised address".format(rid))
         more = len(order) > 1
         path = "/v1/models/{}:generate".format(self.name)
+        if attempts_made is not None:
+            attempts_made[0] += 1
         self._note_inflight(rid, +1)
         t_up = time.monotonic()
         try:
             status, body, headers = _http_request(
                 addr, "POST", path, body=raw_body,
-                timeout=self.upstream_timeout, abort=client_gone)
+                timeout=self.upstream_timeout, abort=client_gone,
+                extra_headers={"X-TFOS-Trace": str(trace)})
         except _ClientGone:
             # OUR client hung up; the upstream teardown already told
             # the replica (socket EOF -> its disconnect cancel). Not a
@@ -626,6 +657,8 @@ class FleetRouter(object):
                 retry_after=0.0 if more else 0.5)
         finally:
             dt = time.monotonic() - t_up
+            self.flight.span("upstream", t_up, t_up + dt, trace=trace,
+                             replica=rid)
             with self._obs_lock:
                 self.timers.add("upstream", dt)
                 self._hist_upstream.observe(dt)
@@ -762,6 +795,62 @@ class FleetRouter(object):
             body = "\n".join(lines) + "\n" + body
         return body
 
+    def debug_trace(self):
+        """(stitched_chrome_trace, dropped_total) — the router's span
+        ring plus every live replica's ``GET /debug/trace`` dump,
+        stitched onto ONE wall-clock-aligned timeline
+        (``tracing.stitch_traces``): a request that failed over
+        mid-stream reads as one causal row — router ``dispatch``
+        envelope, an ``upstream`` span per attempt, and each replica's
+        engine spans — because every span shares the minted
+        ``X-TFOS-Trace`` id. Replica fetches are best-effort (a dead
+        replica's ring is simply absent); ``dropped_total`` sums every
+        source ring's eviction tally (the ``X-TFOS-Trace-Dropped``
+        response header — ring saturation must not be silent)."""
+        snapshot = self._snapshot()
+        fetched = {}
+        fetched_lock = threading.Lock()
+
+        def _fetch(rid, addr):
+            try:
+                status, body, _ = _http_request(addr, "GET",
+                                                "/debug/trace",
+                                                timeout=5.0)
+                if status == 200:
+                    doc = json.loads(body)
+                    with fetched_lock:
+                        fetched[rid] = doc
+            except (OSError, ValueError,
+                    http.client.HTTPException) as e:
+                logger.debug("trace fetch from replica %s failed: %s",
+                             rid, e)
+
+        # fetch CONCURRENTLY: the dump is most wanted exactly when
+        # some replicas are wedged, and sequential 5s timeouts would
+        # make it cost 5s per hung host instead of ~one fetch's worth
+        threads = []
+        for rid in sorted(snapshot):
+            addr = (snapshot.get(rid) or {}).get("addr")
+            if not addr:
+                continue
+            t = threading.Thread(target=_fetch, args=(rid, addr),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=6.0)
+        # a straggler past the join timeout may STILL insert (daemon
+        # thread): snapshot under the lock, into a DIFFERENT name —
+        # rebinding `fetched` would swap the closure cell the straggler
+        # writes through, putting its insert right back into the dict
+        # the stitch iterates
+        with fetched_lock:
+            docs = dict(fetched)
+        sources = [("router", self.flight.chrome_trace())]
+        sources.extend((rid, docs[rid]) for rid in sorted(docs))
+        stitched = tracing.stitch_traces(sources)
+        return stitched, sum(stitched["dropped"].values())
+
     # -- rolling drain -----------------------------------------------------
 
     def rolling_drain(self, upgrade=None, drain_timeout=None,
@@ -870,6 +959,12 @@ class FleetRouter(object):
                     return self._send(
                         200, router.metrics_text().encode("utf-8"),
                         serving.OPENMETRICS_CONTENT_TYPE)
+                if self.path == "/debug/trace":
+                    stitched, dropped = router.debug_trace()
+                    return self._send(
+                        200, json.dumps(stitched).encode("utf-8"),
+                        "application/json",
+                        headers={"X-TFOS-Trace-Dropped": str(dropped)})
                 return self._send_json(
                     404, {"error": "not found: %s" % self.path})
 
@@ -1007,9 +1102,17 @@ class ServingFleet(object):
             else:
                 resv_addr = self.reservation.addr
             for i in range(self.n_replicas):
+                # one FlightRecorder PER replica (unless the caller
+                # provided one): real deployments have one ring per
+                # process, and the router's /debug/trace stitch labels
+                # spans by source — in-process replicas sharing the
+                # process-global ring would each dump EVERYONE's spans
+                # under their own label and multiply the dropped tally
+                kw = dict(self.engine_kw)
+                kw.setdefault("flight", tracing.FlightRecorder())
                 engine = DecodeEngine(self.model, self.params,
                                       replica_id="replica-{}".format(i),
-                                      **self.engine_kw)
+                                      **kw)
                 try:
                     server = ModelServer(None, engine=engine,
                                          name=self.name,

@@ -72,3 +72,46 @@ def format_stage_ms(timers):
     per = stage_ms(timers)
     return "  ".join("{}={}".format(k, per[k])
                      for k in sorted(per, key=per.get, reverse=True))
+
+
+def format_goodput(report):
+    """Multi-line rendering of a goodput report (``goodput.
+    GoodputLedger.report`` or ``goodput.job_report`` shape): headline
+    ratio, then the badput table sorted by cost with each category's
+    share of wall time — what ``scripts/goodput_report.py`` prints and
+    the bench's goodput leg logs."""
+    wall = report.get("wall_s") or 0.0
+    lines = ["goodput {:6.2%}  (productive {:.3f}s of {:.3f}s wall)"
+             .format(report.get("goodput_ratio", 0.0),
+                     report.get("productive_s", 0.0), wall)]
+    badput = report.get("badput") or {}
+    for category in sorted(badput, key=badput.get, reverse=True):
+        seconds = badput[category]
+        if not seconds:
+            continue
+        lines.append("  badput {:16s} {:9.3f}s  ({:5.1%})".format(
+            category, seconds, seconds / wall if wall else 0.0))
+    unacc = report.get("unaccounted_s")
+    if unacc is not None:
+        lines.append("  unaccounted {:+.3f}s ({:+.2%} of wall)".format(
+            unacc, unacc / wall if wall else 0.0))
+    return "\n".join(lines)
+
+
+def format_straggler_table(rows):
+    """Straggler table from per-executor skew rows
+    ``[{executor, skew, step_ewma_s?}]`` (or a plain {executor: skew}
+    dict), worst first."""
+    if isinstance(rows, dict):
+        rows = [{"executor": eid, "skew": skew}
+                for eid, skew in rows.items()]
+    if not rows:
+        return "no step-time skew data (no executor has stepped yet)"
+    lines = ["{:>10s} {:>8s} {:>14s}".format(
+        "executor", "skew", "step_ewma_ms")]
+    for row in sorted(rows, key=lambda r: -(r.get("skew") or 0)):
+        ewma = row.get("step_ewma_s")
+        lines.append("{:>10s} {:>8.2f} {:>14s}".format(
+            str(row.get("executor")), float(row.get("skew") or 0.0),
+            "-" if ewma is None else "{:.3f}".format(ewma * 1e3)))
+    return "\n".join(lines)

@@ -1374,11 +1374,17 @@ def shutdown(cluster_info, cluster_meta, queues=("input",), grace_secs=0):
             exit_code = None if proc is None else proc.exitcode
             fc = reservation.Client(tuple(cluster_meta["server_addr"]))
             try:
-                fc.beat(_local_executor_id(), {
-                    "state": mgr.get("state"), "trainer_exit": exit_code,
+                # the FULL payload, not a minimal one: a beat REPLACES
+                # the lease payload wholesale, and the goodput plane's
+                # driver-side harvest reads the metrics snapshot off
+                # the LAST lease — a final beat that dropped "metrics"
+                # would erase the trainer's final accounting flush
+                payload = _beat_payload(mgr, _local_executor_id())
+                payload.update({
+                    "trainer_exit": exit_code,
                     "trainer_alive": False if proc is not None else None,
-                    "executor_id": _local_executor_id(), "final": True,
-                    "errors": len(errors)})
+                    "final": True, "errors": len(errors)})
+                fc.beat(_local_executor_id(), payload)
             finally:
                 fc.close()
         except Exception:  # noqa: BLE001 - server may already be gone

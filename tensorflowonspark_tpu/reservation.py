@@ -282,16 +282,22 @@ class Server(object):
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
+                from tensorflowonspark_tpu import goodput
                 if self.path == "/metrics":
                     code, ctype = 200, tracing.OPENMETRICS_CONTENT_TYPE
+                    # goodput plane: annotate per-executor step-time
+                    # skew vs the fleet median so the exposition
+                    # carries tfos_train_step_skew{executor=...}
                     body = tracing.render_cluster(
-                        server.metrics_snapshot(),
+                        goodput.attach_step_skew(
+                            server.metrics_snapshot()),
                         cluster_gauges=server.cluster_gauges()) \
                         .encode("utf-8")
                 elif self.path == "/stats":
                     code, ctype = 200, "application/json"
                     stats = tracing.cluster_rollup(
-                        server.metrics_snapshot())
+                        goodput.attach_step_skew(
+                            server.metrics_snapshot()))
                     # elastic resize: live width vs configured target
                     gauges = server.cluster_gauges()
                     stats["cluster"]["width"] = gauges.get(

@@ -211,7 +211,8 @@ class GenerationHandle(object):
     :class:`Cancelled`.
     """
 
-    def __init__(self, prompt, max_new_tokens, deadline=None):
+    def __init__(self, prompt, max_new_tokens, deadline=None,
+                 trace=None):
         # constructed by DecodeEngine AFTER validate() normalized both
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
@@ -219,8 +220,13 @@ class GenerationHandle(object):
         self.submitted = time.monotonic()
         self.completed = None
         #: request trace id: every span this request's lifecycle emits
-        #: into the FlightRecorder lands on this timeline row
-        self.trace = tracing.next_trace_id()
+        #: into the FlightRecorder lands on this timeline row. An
+        #: externally minted id (the fleet router's ``X-TFOS-Trace``
+        #: header) is ADOPTED verbatim, so a request that failed over
+        #: between replicas shares one id across every engine's ring —
+        #: the stitched end-to-end timeline's join key.
+        self.trace = int(trace) if trace is not None \
+            else tracing.next_trace_id()
         self._tokens = []
         self._q = queue_mod.Queue()
         self._done = threading.Event()
@@ -491,6 +497,9 @@ class DecodeEngine(object):
         #: scripts/trace_dump.py render it as Chrome trace JSON
         self.flight = flight if flight is not None \
             else tracing.flight_recorder()
+        # ring saturation is an exported signal, not a silent loss:
+        # /metrics carries tfos_trace_spans_dropped_total
+        tracing.expose_flight_drops(self.metrics, self.flight)
         self._temperature = float(temperature)
         norm_top_k = None if top_k is None else int(top_k)
         norm_top_p = None if top_p is None else float(top_p)
@@ -722,7 +731,7 @@ class DecodeEngine(object):
         return {"queue_wait_s": wait,
                 "service_s": prefill + max_new * step}
 
-    def _submit_many(self, vetted, deadline_s=None):
+    def _submit_many(self, vetted, deadline_s=None, trace=None):
         """Atomically queue a whole vetted body: either every request is
         admitted or none is (QueueFull / Shed / stopped / draining /
         broken raise before any handle exists), so a mid-batch refusal
@@ -730,7 +739,9 @@ class DecodeEngine(object):
         client that already got its error. max_new==0 requests complete
         inline (the prompt IS the answer) but still pass the liveness
         checks — a dead engine must refuse degenerate requests as
-        loudly as real ones."""
+        loudly as real ones. ``trace``: adopt an externally minted
+        trace id (the router's ``X-TFOS-Trace``) for every handle of
+        the body — one propagated id, one Perfetto row."""
         if deadline_s is not None:
             deadline_s = float(deadline_s)
             if not deadline_s > 0:
@@ -797,7 +808,8 @@ class DecodeEngine(object):
             handles = []
             for prompt, max_new in vetted:
                 handle = GenerationHandle(prompt, max_new,
-                                          deadline=deadline)
+                                          deadline=deadline,
+                                          trace=trace)
                 self.flight.instant("admit", trace=handle.trace,
                                     prompt_len=len(prompt),
                                     max_new=max_new,
@@ -1831,8 +1843,13 @@ class ModelServer(object):
                 outputs = self._apply(self._variables, batch)
         return _to_json(outputs, row_format)
 
-    def generate(self, payload, client_gone=None):
+    def generate(self, payload, client_gone=None, trace=None):
         """{'prompt': [[...], ...], 'max_new_tokens': N} -> {'tokens': ...}.
+
+        ``trace``: an externally minted trace id (the fleet router's
+        ``X-TFOS-Trace`` request header) adopted for the body's engine
+        spans — a failed-over request's spans share one id across
+        replicas, stitchable into a single end-to-end timeline.
 
         Each prompt becomes one engine request; the handles resolve
         concurrently (slot-interleaved), so a multi-prompt body — or many
@@ -1884,7 +1901,8 @@ class ModelServer(object):
         # atomic whole-body admission: QueueFull surfaces as 429 (and a
         # Shed as 503) with nothing queued, instead of part of the body
         # decoding for a client that got an error
-        handles = engine._submit_many(vetted, deadline_s=deadline_s)
+        handles = engine._submit_many(vetted, deadline_s=deadline_s,
+                                      trace=trace)
         try:
             tokens = [self._await_handle(h, handles, client_gone)
                       for h in handles]
@@ -2196,7 +2214,13 @@ class ModelServer(object):
                     return self._send_text(200, server.metrics_text(),
                                            OPENMETRICS_CONTENT_TYPE)
                 if self.path == "/debug/trace":
-                    return self._send(200, server.debug_trace())
+                    trace = server.debug_trace()
+                    # ring saturation travels with the dump: a reader
+                    # must know when spans were evicted under it
+                    return self._send(
+                        200, trace,
+                        headers={"X-TFOS-Trace-Dropped":
+                                 str(trace.get("dropped", 0))})
                 base = "/v1/models/%s" % server.name
                 if self.path == base:
                     return self._send(200, server.status())
@@ -2214,11 +2238,22 @@ class ModelServer(object):
                         server._inflight -= 1
 
             def _do_post_tracked(self):
+                # trace-context propagation (fleet plane): a router-
+                # minted X-TFOS-Trace id is adopted as the engine trace
+                # id so this replica's spans join the fleet timeline
+                trace = None
+                raw_trace = self.headers.get("X-TFOS-Trace")
+                if raw_trace:
+                    try:
+                        trace = int(raw_trace)
+                    except ValueError:
+                        trace = None  # malformed header: local id
                 routes = {"/v1/models/%s:predict" % server.name:
                           server.predict,
                           "/v1/models/%s:generate" % server.name:
                           lambda payload: server.generate(
-                              payload, client_gone=self._client_gone)}
+                              payload, client_gone=self._client_gone,
+                              trace=trace)}
                 handler = routes.get(self.path)
                 if handler is None:
                     return self._send(404,

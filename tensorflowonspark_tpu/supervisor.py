@@ -75,10 +75,12 @@ reference's whole-job rerun, but choose checkpoint cadence knowing
 which side of the boundary you are on.
 """
 
+import contextlib
 import logging
 import threading
 import time
 
+from tensorflowonspark_tpu import goodput as goodput_mod
 from tensorflowonspark_tpu import tracing
 
 logger = logging.getLogger(__name__)
@@ -354,12 +356,21 @@ class SupervisorConfig(object):
       shutdown_timeout / drain_timeout: bounds on attempt teardown and
         post-abort job drain — a recovery must never hang on the very
         wedge it is recovering from.
+      straggler_skew: step-time skew (executor effective step time /
+        fleet lower-median) at which an OBSERVE-ONLY ``straggler``
+        incident is raised (goodput.StragglerDetector; None disables).
+        Incidents never reach the recovery policy — skew is a capacity
+        signal, not a failure.
+      straggler_min_stall_s: floor below which a frozen step counter
+        is not substituted for the EWMA (checkpoint pauses must not
+        read as stalls).
     """
 
     def __init__(self, policy=None, heartbeat_interval=1.0,
                  heartbeat_timeout=15.0, stall_timeout=120.0,
                  poll_interval=0.5, classify_grace=3.0,
-                 shutdown_timeout=120.0, drain_timeout=60.0):
+                 shutdown_timeout=120.0, drain_timeout=60.0,
+                 straggler_skew=3.0, straggler_min_stall_s=5.0):
         self.policy = policy if policy is not None else RestartFromCheckpoint()
         self.heartbeat_interval = float(heartbeat_interval)
         self.heartbeat_timeout = float(heartbeat_timeout)
@@ -368,6 +379,9 @@ class SupervisorConfig(object):
         self.classify_grace = float(classify_grace)
         self.shutdown_timeout = float(shutdown_timeout)
         self.drain_timeout = float(drain_timeout)
+        self.straggler_skew = None if straggler_skew is None \
+            else float(straggler_skew)
+        self.straggler_min_stall_s = float(straggler_min_stall_s)
 
 
 class Supervisor(object):
@@ -382,12 +396,22 @@ class Supervisor(object):
     """
 
     def __init__(self, server=None, executors=(), config=None, events=None,
-                 attempt=1, alive_fn=None):
+                 attempt=1, alive_fn=None, incidents=None):
         self.server = server
         self.executors = list(executors)
         self.config = config or SupervisorConfig()
         self.events = events if events is not None else tracing.EventLog()
         self.attempt = attempt
+        #: OBSERVE-ONLY incidents (straggler skew): recorded with
+        #: evidence like failures, but NEVER fed to a recovery policy.
+        #: A SupervisedCluster passes one shared list so incidents
+        #: survive across attempts (the EventLog idiom).
+        self._incidents = incidents if incidents is not None else []
+        self._straggler = None
+        if self.config.straggler_skew is not None:
+            self._straggler = goodput_mod.StragglerDetector(
+                skew_threshold=self.config.straggler_skew,
+                min_stall_s=self.config.straggler_min_stall_s)
         #: optional engine liveness view (Context.executors_alive): an
         #: executor whose process the ENGINE has already seen die is
         #: classified executor_lost immediately instead of waiting out
@@ -442,6 +466,7 @@ class Supervisor(object):
             for event in self._classify(leases, now):
                 self._report(event)
             self._track_recovery(leases)
+            self._classify_stragglers(leases, now)
         self._check_watched()
 
     def _classify_engine_liveness(self):
@@ -574,6 +599,76 @@ class Supervisor(object):
                 self._first_step_seen = True
                 self.events.record("first_step", attempt=self.attempt,
                                    step=int(step), executor=eid)
+
+    def _classify_stragglers(self, leases, now):
+        """Observe-only skew detection (goodput plane): an executor
+        whose effective step time (BEAT-carried EWMA, or its frozen
+        step counter's age) exceeds the configured skew vs the fleet
+        median raises a ``straggler`` INCIDENT — recorded with the
+        offender's beat-carried metrics snapshot and the flight tail
+        as evidence, exactly like a failure's, but never handed to a
+        recovery policy: skew asks for an operator (or an autoscaler),
+        not a restart."""
+        if self._straggler is None:
+            return
+        # beats that STOPPED are a liveness problem, not a skew
+        # signal: a dead node's frozen step counter would otherwise
+        # read as a stall and fire a spurious straggler before the
+        # heartbeat-timeout classification reports it lost (and its
+        # inflated stall age would skew the median used to judge
+        # genuinely slow survivors)
+        stale_after = max(3 * self.config.heartbeat_interval, 3.0)
+        views = {}
+        for eid, lease in leases.items():
+            payload = lease["payload"]
+            if payload.get("role") == "serving":
+                continue  # serving replicas have no train steps
+            if lease.get("age", 0.0) > stale_after:
+                continue  # beats stopped: liveness owns this executor
+            if payload.get("state") in ("terminating", "stopped",
+                                        "error") \
+                    or payload.get("trainer_alive") is False:
+                continue  # dying/dead: crash classification owns it
+            if eid in self._reported:
+                continue  # already attributed as a failure
+            views[eid] = {"metrics": payload.get("metrics"),
+                          "train_step": payload.get("train_step")}
+        for finding in self._straggler.observe(views, now=now):
+            eid = finding["executor_id"]
+            payload = leases.get(eid, {}).get("payload", {})
+            event = FailureEvent(
+                "straggler", eid,
+                "step time {}x the fleet median ({:.3f}s vs "
+                "{:.3f}s{})".format(
+                    finding["skew"], finding["effective_s"],
+                    finding["median_s"],
+                    "; step counter frozen" if finding["stalled"]
+                    else ""),
+                dict(payload))
+            self._report_incident(event, finding)
+
+    def _report_incident(self, event, detail=None):
+        """Record an observe-only incident: evidence attached like
+        :meth:`_report`'s, EventLog milestone recorded, but the event
+        goes to :meth:`incidents` — never to the failure list the
+        recovery loop drains."""
+        self.events.record("incident", attempt=self.attempt,
+                           kind=event.kind, executor=event.executor_id,
+                           detail=event.detail)
+        if "flight" not in event.payload:
+            event.payload["flight"] = tracing.flight_recorder().tail(64)
+        incident = event.as_dict()
+        if detail:
+            incident["detail_fields"] = dict(detail)
+        with self._lock:
+            self._incidents.append(incident)
+        logger.warning("supervisor incident (observe-only): %s", event)
+
+    def incidents(self):
+        """Observe-only incidents recorded so far (straggler skew);
+        each carries the same evidence schema as a failure."""
+        with self._lock:
+            return list(self._incidents)
 
     # -- failure access --------------------------------------------------
 
@@ -858,9 +953,18 @@ class TrainerSide(object):
     #: at a step boundary, just up to this much later
     drain_poll_interval = 0.25
 
-    def __init__(self, mgr, restored_step=None):
+    #: seconds between forced metrics flushes in :meth:`step` (goodput
+    #: plane): the step boundary force-publishes the feed registry —
+    #: which carries the process goodput ledger — BEFORE the chaos
+    #: kill site, so a killed trainer's accounting is current to
+    #: within this throttle instead of the feed's 2s heartbeat window
+    metrics_flush_interval = 0.5
+
+    def __init__(self, mgr, restored_step=None, feed=None):
         self.mgr = mgr
+        self.feed = feed
         self._drain_checked = float("-inf")
+        self._flushed = float("-inf")
         if restored_step is not None:
             self.report_restore(restored_step)
 
@@ -871,6 +975,16 @@ class TrainerSide(object):
     def step(self, step):
         from tensorflowonspark_tpu import chaos
         self.mgr.set("train_step", int(step))
+        now = time.monotonic()
+        if self.feed is not None \
+                and now - self._flushed >= self.metrics_flush_interval:
+            # flush BEFORE the kill site: a step-N kill must not lose
+            # step N's goodput charges to the heartbeat throttle
+            self._flushed = now
+            try:
+                self.feed.publish_metrics()
+            except Exception:  # noqa: BLE001 - accounting best-effort
+                pass
         chaos.on_step(int(step))
         # elastic regrow: the step site IS the checkpoint boundary
         # (callers publish AFTER the step's checkpoint committed and
@@ -894,16 +1008,19 @@ class TrainerSide(object):
         return _hook
 
 
-def attach(ctx, restored_step=None):
+def attach(ctx, restored_step=None, feed=None):
     """Supervision-aware map_fun boilerplate::
 
         restored = ckpt.restore(state, fallback=True)
         start = 0 if restored is None else int(restored["step"])
-        sup = supervisor.attach(ctx, restored_step=start)
+        sup = supervisor.attach(ctx, restored_step=start, feed=feed)
         ...
         sup.step(int(state["step"]))   # after each step's checkpoint
-    """
-    return TrainerSide(ctx.mgr, restored_step=restored_step)
+
+    ``feed`` (the map_fun's DataFeed): lets the step boundary
+    force-flush the metrics/goodput snapshot before the chaos kill
+    site — tighter accounting across a kill, optional otherwise."""
+    return TrainerSide(ctx.mgr, restored_step=restored_step, feed=feed)
 
 
 # -- MTTR extraction -------------------------------------------------------
@@ -994,6 +1111,19 @@ class SupervisedCluster(object):
         self._last_probe = 0.0
         self._acked = set()
         self._last_metrics = None   # rollup harvested before teardown
+        #: goodput plane (goodput.py): the DRIVER's ledger charges only
+        #: the windows no trainer exists to measure — reform (detect/
+        #: teardown/backoff/formation) and planned resize-drain
+        #: teardown; everything inside a live attempt is accounted by
+        #: the executors' own ledgers, harvested per attempt below and
+        #: folded by goodput_report()
+        self.goodput = goodput_mod.GoodputLedger()
+        self._goodput_wall_s = None  # frozen at job completion/failure
+        self._attempt_rollups = {}  # formation ordinal -> last rollup
+        self._next_form_category = "reform"
+        #: observe-only incidents (straggler skew), shared across every
+        #: attempt's Supervisor like the EventLog
+        self.incidents = []
         self._tfc = None
         self._supervisor = None
         self._done = False
@@ -1064,6 +1194,7 @@ class SupervisedCluster(object):
                 failure = self._final_shutdown()
                 if failure is None:
                     self._done = True
+                    self._freeze_goodput_wall()
                     self._resize_target = None  # drain raced completion
                     self.events.record("job_complete",
                                        formations=self.formations)
@@ -1125,6 +1256,7 @@ class SupervisedCluster(object):
                 failure = self._final_shutdown(grace_secs=grace_secs)
             if failure is None:
                 self._done = True
+                self._freeze_goodput_wall()
                 self.events.record("job_complete",
                                    formations=self.formations)
                 break
@@ -1133,7 +1265,8 @@ class SupervisedCluster(object):
 
     def report(self):
         """The supervision ledger: formations, failures, exclusions,
-        ack coverage, MTTR stages, and the raw event timeline."""
+        ack coverage, MTTR stages, goodput accounting, observe-only
+        incidents, and the raw event timeline."""
         return {
             "formations": self.formations,
             "failures": [a["failure"] for a in self.attempts],
@@ -1144,8 +1277,40 @@ class SupervisedCluster(object):
             "excluded": sorted(self.excluded),
             "acked_partitions": len(self._acked),
             "recovery": recovery_stages(self.events),
+            "goodput": self.goodput_report(),
+            "incidents": list(self.incidents),
             "events": self.events.events(),
         }
+
+    def goodput_report(self):
+        """Job-level goodput accounting (goodput.job_report): the
+        driver ledger's recovery windows folded with every attempt's
+        merged executor categories, against this job's wall clock.
+        Executor seconds are normalized by the configured width, so
+        ``goodput_ratio`` reads in job wall-clock units (1.0 == every
+        executor productive for the whole wall time); elastic attempts
+        running below the configured width under-count proportionally
+        — honest for a degraded job. ``scripts/goodput_report.py``
+        renders this; ``bench.py``'s goodput leg publishes it."""
+        self._harvest_metrics()
+        merged = []
+        for ordinal in sorted(self._attempt_rollups):
+            rollup = self._attempt_rollups[ordinal] or {}
+            snap = (rollup.get("cluster") or {}).get("merged")
+            if snap:
+                merged.append(snap)
+        # the wall denominator FREEZES when the job completes or fails
+        # — a report read minutes after shutdown must describe the job,
+        # not dilute its ratio with post-job elapsed time as idle
+        wall = self._goodput_wall_s if self._goodput_wall_s is not None \
+            else self.goodput.wall_s()
+        return goodput_mod.job_report(
+            wall, driver_ledger=self.goodput,
+            merged_snapshots=merged, width=self.num_executors)
+
+    def _freeze_goodput_wall(self):
+        if self._goodput_wall_s is None:
+            self._goodput_wall_s = self.goodput.wall_s()
 
     # -- attempt machinery -----------------------------------------------
 
@@ -1153,12 +1318,25 @@ class SupervisedCluster(object):
         width = self.width
         attempt_no = len(self.attempts) + 1
         self.events.record("reform_start", attempt=attempt_no, width=width)
-        tfc = self._cluster_mod.run(
-            self.sc, self.map_fun, self.tf_args, width,
-            exclude_executors=frozenset(self.excluded),
-            beat_interval=self.config.heartbeat_interval,
-            prefer_alive=True,
-            **self.run_kwargs)
+        # the formation window is recovery badput: "reform" normally,
+        # "resize_drain" when this formation completes a planned
+        # boundary drain (elastic regrow). The job's FIRST formation is
+        # startup, not recovery — the taxonomy's reform means the
+        # window BETWEEN attempts, and a clean zero-failure job must
+        # report reform 0 — so it stays uncharged (it lands in the
+        # report's idle residual)
+        category, self._next_form_category = \
+            self._next_form_category, "reform"
+        if self.formations == 0 and category == "reform":
+            category = None
+        with self.goodput.track(category) if category \
+                else contextlib.nullcontext():
+            tfc = self._cluster_mod.run(
+                self.sc, self.map_fun, self.tf_args, width,
+                exclude_executors=frozenset(self.excluded),
+                beat_interval=self.config.heartbeat_interval,
+                prefer_alive=True,
+                **self.run_kwargs)
         self.formations += 1
         self._tfc = tfc
         # width gauge: this formation's width against the job's
@@ -1168,7 +1346,7 @@ class SupervisedCluster(object):
         self._supervisor = Supervisor(
             server=tfc.server, executors=tfc.executor_ids,
             config=self.config, events=self.events,
-            attempt=attempt_no,
+            attempt=attempt_no, incidents=self.incidents,
             alive_fn=getattr(self.sc, "executors_alive", None)).start()
         self.events.record("cluster_formed", attempt=attempt_no,
                            width=width, executors=list(tfc.executor_ids))
@@ -1214,11 +1392,16 @@ class SupervisedCluster(object):
                 # every node's state to 'error', and a still-polling
                 # monitor would attribute those self-inflicted errors to
                 # healthy executors — poisoning failure_counts, which
-                # Blacklist decides exclusions from
-                sup.stop()
-                sup.abort_attempt(self._tfc.cluster_info,
-                                  self._tfc.cluster_meta, str(failure))
-                self._drain_result(result)
+                # Blacklist decides exclusions from. The whole
+                # abort+drain window is recovery badput (goodput
+                # plane): the _recover_or_raise that follows continues
+                # the same reform charge
+                with self.goodput.track("reform"):
+                    sup.stop()
+                    sup.abort_attempt(self._tfc.cluster_info,
+                                      self._tfc.cluster_meta,
+                                      str(failure))
+                    self._drain_result(result)
                 return failure
             err = result.first_error()
             if err is not None:
@@ -1326,8 +1509,12 @@ class SupervisedCluster(object):
         attempt_no = len(self.attempts) + 1
         self.events.record("attempt_teardown", attempt=attempt_no,
                            kind="resize_drain", surfaced=failure.kind)
-        self._teardown("resize drain (regrow to width {})".format(target),
-                       attempt_no=attempt_no)
+        with self.goodput.track("resize_drain"):
+            self._teardown(
+                "resize drain (regrow to width {})".format(target),
+                attempt_no=attempt_no)
+        # the formation that completes the resize is part of its cost
+        self._next_form_category = "resize_drain"
         self._record_width_change(target, "regrow: capacity returned")
         # the next loop iteration reforms at the new width
 
@@ -1350,7 +1537,22 @@ class SupervisedCluster(object):
         if tfc is None:
             return
         try:
-            self._last_metrics = tfc.metrics()
+            rollup = tfc.metrics()
+            self._last_metrics = rollup
+            # per-ATTEMPT accumulation (goodput plane): each attempt's
+            # trainers run fresh process ledgers, so the job's total
+            # accounting is the SUM of attempts' merged snapshots;
+            # within one attempt the counters are cumulative, so
+            # overwriting by formation ordinal keeps only the latest
+            # harvest of each attempt — unless the new harvest is
+            # EMPTY (a final beat whose broker was already gone
+            # carries metrics=None): never regress a rollup that has
+            # data to one that lost it
+            merged = (rollup.get("cluster") or {}).get("merged") or {}
+            if any(merged.get(k) for k in ("counters", "timers",
+                                           "hists")) \
+                    or self.formations not in self._attempt_rollups:
+                self._attempt_rollups[self.formations] = rollup
         except Exception:  # noqa: BLE001 - observability is best-effort
             logger.debug("metrics harvest failed", exc_info=True)
 
@@ -1377,6 +1579,14 @@ class SupervisedCluster(object):
             self._tfc = None
             return failure if failure is not None else FailureEvent(
                 "shutdown_failure", None, str(e))
+        # re-harvest AFTER the shutdown join (goodput plane): the
+        # trainers' FINAL accounting flush rides their last synchronous
+        # beat, which only lands once node.shutdown has joined them —
+        # the pre-shutdown harvest above would miss the last steps'
+        # charges to the publish-throttle window. The lease payloads
+        # stay readable in memory after Server.stop(); a failed
+        # re-harvest keeps the earlier one (best-effort either way).
+        self._harvest_metrics()
         self._stop_monitor()
         self._tfc = None
         return None
@@ -1416,6 +1626,14 @@ class SupervisedCluster(object):
                         attempt_no if attempt_no is not None else "?", e)
 
     def _recover_or_raise(self, failure):
+        # the whole recovery window — teardown, decision, backoff —
+        # is reform badput on the driver ledger (the next _form adds
+        # the formation itself); the context closes on the FAIL raise
+        # path too
+        with self.goodput.track("reform"):
+            self._recover_or_raise_inner(failure)
+
+    def _recover_or_raise_inner(self, failure):
         attempt_no = len(self.attempts) + 1
         restarts = len(self.attempts)  # restarts already performed
         self.attempts.append({"attempt": attempt_no,
@@ -1432,6 +1650,7 @@ class SupervisedCluster(object):
                            reason=decision.reason)
         if decision.action == Decision.FAIL:
             self._done = True
+            self._freeze_goodput_wall()
             self.events.record("job_failed", attempt=attempt_no,
                                kind=failure.kind)
             raise RuntimeError(

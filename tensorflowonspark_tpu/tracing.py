@@ -144,6 +144,13 @@ class Counters(object):
         parallel ledger."""
         return self._counts.get(name, 0)
 
+    def set_count(self, name, value):
+        """Set counter ``name`` absolutely — for MIRRORING an external
+        monotonic source (e.g. a FlightRecorder's ``dropped`` tally)
+        into the exposition; never for resetting. The mirror stays
+        monotonic as long as the source is."""
+        self._counts[name] = value
+
     def snapshot(self):
         """{"counts": {...}, "gauges": {...}} — stable copies."""
         return {"counts": dict(self._counts), "gauges": dict(self._gauges)}
@@ -379,6 +386,44 @@ METRIC_FAMILIES = {
     "tfos_cluster_width_target":
         ("gauge", "", "the job's configured width (width < target means "
                       "running degraded after a shrink)"),
+    # -- goodput plane (goodput.py; rides the feed registry's BEAT
+    # snapshot; rendered per-executor on the driver /metrics) --
+    "tfos_badput_seconds":
+        ("counter", "stage", "non-productive wall seconds per badput "
+                             "category (compile / checkpoint_save / "
+                             "restore / reform / resize_drain / "
+                             "feed_wait / idle)"),
+    "tfos_badput_samples":
+        ("counter", "stage", "samples behind tfos_badput_seconds"),
+    "tfos_goodput_productive_seconds":
+        ("counter", "", "wall seconds spent in productive training "
+                        "steps (the goodput numerator)"),
+    "tfos_goodput_steps":
+        ("counter", "", "productive training steps accounted by the "
+                        "goodput ledger"),
+    "tfos_goodput_ratio":
+        ("gauge", "", "productive_seconds / ledger wall time (per "
+                      "process; derive cluster ratios from the summed "
+                      "seconds, not by summing this gauge)"),
+    "tfos_goodput_step_ewma_seconds":
+        ("gauge", "", "EWMA of recent productive step wall times (the "
+                      "straggler detector's per-executor signal)"),
+    "tfos_goodput_wall_seconds":
+        ("gauge", "", "the ledger's measured wall time, published "
+                      "atomically with its categories — verify "
+                      "sum(categories) == this against one snapshot"),
+    "tfos_train_step_skew":
+        ("gauge", "executor", "executor step-time EWMA / fleet "
+                              "lower-median (driver-computed; the "
+                              "SLOW straggler signature — a STALLED "
+                              "executor's EWMA freezes, so stalls "
+                              "surface via the straggler incident, "
+                              "not this gauge)"),
+    # -- trace plane (FlightRecorder ring saturation) --
+    "tfos_trace_spans_dropped":
+        ("counter", "", "span events evicted from the FlightRecorder "
+                        "ring (capacity overflow — raise capacity or "
+                        "dump more often if this grows)"),
 }
 
 
@@ -522,8 +567,22 @@ class MetricsRegistry(object):
         self._counters = {}   # prefix -> Counters
         self._timers = {}     # family stem -> StageTimers
         self._hists = {}      # family -> Histogram
+        self._hooks = []      # zero-arg callables run before snapshot
 
     # -- registration / lookup -------------------------------------------
+
+    def add_hook(self, fn):
+        """Register a zero-arg callable run before every
+        :meth:`snapshot` (and therefore every :meth:`render`): the
+        sync point for values that live outside the registered objects
+        — a FlightRecorder's ``dropped`` tally mirrored into a
+        counter, a goodput ledger charging its open interval — so a
+        scrape or BEAT-carried snapshot is current, not
+        last-event-stale. Hooks must be cheap and never raise
+        (failures are logged and swallowed). Idempotent per callable."""
+        if fn not in self._hooks:
+            self._hooks.append(fn)
+        return fn
 
     def add_counters(self, prefix, counters):
         """Expose ``counters`` as ``<prefix>_<key>`` families: counts
@@ -564,6 +623,12 @@ class MetricsRegistry(object):
         """Compact JSON-able state: {"counters": {prefix: ...},
         "timers": {stem: {"t": ..., "n": ...}}, "hists": {family: ...}}.
         Safe to ship over the JSON reservation wire (BEAT payloads)."""
+        for hook in self._hooks:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 - exposition must survive
+                logger.debug("registry snapshot hook failed",
+                             exc_info=True)
         return {
             "counters": {p: c.snapshot()
                          for p, c in self._counters.items()},
@@ -754,7 +819,11 @@ def render_cluster(per_executor, cluster_gauges=None):
                                     _fmt(cluster_gauges[family])))
     for name, key in (("tfos_cluster_train_step", "train_step"),
                       ("tfos_cluster_feed_hb_batches", "feed_hb"),
-                      ("tfos_cluster_lease_age_seconds", "age")):
+                      ("tfos_cluster_lease_age_seconds", "age"),
+                      # goodput plane: per-executor step-time skew vs
+                      # the fleet median (goodput.attach_step_skew
+                      # annotates the views before this render)
+                      ("tfos_train_step_skew", "step_skew")):
         samples = [(eid, view.get(key))
                    for eid, view in sorted(per_executor.items())
                    if view.get(key) is not None]
@@ -782,6 +851,22 @@ def next_trace_id():
     return next(_TRACE_IDS)
 
 
+def mint_trace_id():
+    """Fresh trace id for CROSS-PROCESS propagation (the fleet
+    router's ``X-TFOS-Trace`` header): the local counter offset by a
+    pid-derived high field, so a router-minted id adopted by a replica
+    engine is vanishingly unlikely to collide with the replica's own
+    locally-assigned ids (collisions are cosmetic — two requests
+    sharing a Perfetto row — but a router that mints thousands should
+    not alias replica-local rows systematically). The +1 keeps the
+    salt NON-ZERO even when ``pid % 2048 == 0`` — a zero salt would
+    make every minted id collide with the local ``next_trace_id``
+    sequence, exactly the aliasing this exists to prevent. Stays an
+    int: Chrome-trace ``tid`` fields must be numeric."""
+    return (((os.getpid() & 0x7FF) + 1) << 20) \
+        | (next(_TRACE_IDS) & 0xFFFFF)
+
+
 class FlightRecorder(object):
     """Bounded ring of span events — the serving plane's black box.
 
@@ -807,6 +892,11 @@ class FlightRecorder(object):
         self.dropped = 0
         #: trace epoch: ts fields are microseconds since this instant
         self.epoch = time.monotonic()
+        #: the wall-clock time of ``epoch`` — what lets two processes'
+        #: dumps be stitched onto one timeline (:func:`stitch_traces`):
+        #: monotonic clocks have per-process zero points, wall clocks
+        #: share one (to host clock sync)
+        self.epoch_wall = time.time() - (time.monotonic() - self.epoch)
 
     def _append(self, event):
         with self._lock:
@@ -881,7 +971,83 @@ class FlightRecorder(object):
                          "tid": tid, "ts": 0,
                          "args": {"name": "engine" if tid == 0
                                   else "request {}".format(tid)}})
-        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+        # epochWall/dropped: top-level metadata Perfetto ignores but
+        # stitch_traces (cross-process timeline alignment) and the
+        # router's /debug/trace saturation header read
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+                "epochWall": self.epoch_wall, "dropped": self.dropped}
+
+
+def expose_flight_drops(registry, flight):
+    """Surface ``flight.dropped`` — span events the bounded ring
+    evicted — as the ``tfos_trace_spans_dropped`` counter family on
+    ``registry``: a snapshot hook mirrors the live tally, so every
+    scrape (and every BEAT-carried snapshot) reports ring saturation
+    instead of losing spans silently. Returns the backing Counters."""
+    counters = registry.add_counters(
+        "tfos_trace", registry._counters.get("tfos_trace") or Counters())
+    # ONE hook per registry, summing over every ring ever exposed on
+    # it: re-exposure of a known ring is a no-op (a respawned engine
+    # shares registry AND ring, and a fresh closure per respawn would
+    # defeat add_hook's identity check — N restarts would pile up N
+    # dead-engine hooks), while genuinely distinct rings accumulate
+    # instead of last-write-wins clobbering each other's tally
+    sources = getattr(registry, "_flight_drop_sources", None)
+    if sources is None:
+        sources = registry._flight_drop_sources = []
+
+        def _sync():
+            counters.set_count("spans_dropped",
+                               sum(f.dropped for f in sources))
+
+        registry.add_hook(_sync)
+    if not any(f is flight for f in sources):
+        sources.append(flight)
+    return counters
+
+
+def stitch_traces(labeled_docs):
+    """Fold several ``chrome_trace`` documents — typically from
+    DIFFERENT processes (a fleet router + its replicas) — into one
+    Perfetto-loadable timeline.
+
+    ``labeled_docs``: [(label, doc)] pairs. Each source becomes its own
+    Chrome-trace PROCESS (synthetic pid = source index, process_name =
+    label) — in-process fleets share a real pid, and distinct synthetic
+    pids keep each source's rows grouped under its label either way.
+    Timestamps are aligned onto the FIRST doc's epoch via each doc's
+    ``epochWall`` (docs without one pass through unshifted), so a
+    request that failed over between replicas reads as one causal
+    timeline: its spans share a trace id (tid) across sources.
+
+    Returns {"traceEvents": [...], "displayTimeUnit": "ms",
+    "dropped": {label: n}} — ``dropped`` carries each source ring's
+    eviction tally (the saturation signal ``X-TFOS-Trace-Dropped``
+    sums)."""
+    out = []
+    dropped = {}
+    base_wall = None
+    for label, doc in labeled_docs:
+        wall = doc.get("epochWall")
+        if base_wall is None and wall is not None:
+            base_wall = wall
+    for idx, (label, doc) in enumerate(labeled_docs):
+        wall = doc.get("epochWall")
+        shift = 0 if wall is None or base_wall is None \
+            else int((wall - base_wall) * 1e6)
+        dropped[str(label)] = int(doc.get("dropped") or 0)
+        out.append({"name": "process_name", "ph": "M", "pid": idx,
+                    "tid": 0, "ts": 0, "args": {"name": str(label)}})
+        for event in doc.get("traceEvents") or ():
+            event = dict(event)
+            event["pid"] = idx
+            if event.get("ph") != "M":
+                event["ts"] = int(event.get("ts", 0)) + shift
+            elif event.get("name") == "process_name":
+                continue  # replaced by the labeled row above
+            out.append(event)
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "dropped": dropped}
 
 
 _FLIGHT = FlightRecorder()
@@ -994,7 +1160,10 @@ class SummaryWriter(object):
 
 
 def metrics_hook(writer, every_steps=10, examples_per_step=None):
-    """train_loop hook: loss + steps/sec (+ examples/sec) to TensorBoard."""
+    """train_loop hook: loss + steps/sec (+ examples/sec) to
+    TensorBoard — plus the process goodput ratio (goodput.py) whenever
+    the ledger has accounted anything, so existing training logs carry
+    productive-time attribution with zero caller changes."""
     state = {"t0": time.monotonic(), "last": 0}
 
     def _hook(step_no, train_state, metrics):
@@ -1008,6 +1177,14 @@ def metrics_hook(writer, every_steps=10, examples_per_step=None):
         if examples_per_step:
             writer.scalar("train/examples_per_sec",
                           dsteps * examples_per_step / dt, step_no)
+        try:
+            from tensorflowonspark_tpu import goodput
+            report = goodput.ledger().report()
+            if report["productive_s"] > 0:
+                writer.scalar("train/goodput_ratio",
+                              report["goodput_ratio"], step_no)
+        except Exception:  # noqa: BLE001 - accounting is best-effort
+            logger.debug("goodput scalar failed", exc_info=True)
         writer.flush()
         state["t0"], state["last"] = now, step_no
 

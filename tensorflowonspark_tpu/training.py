@@ -203,20 +203,48 @@ class Trainer(object):
             self._build_step()
         return self._jit_step(state, batch)
 
-    def train_loop(self, state, batches, log_every=50, hooks=()):
+    def train_loop(self, state, batches, log_every=50, hooks=(),
+                   ledger=None):
         """Drive steps over an (already device-put) batch iterator.
 
         Returns (state, total_steps, examples/sec). ``hooks``: callables
         ``(step_no, state, metrics) -> None`` (checkpointing, tensorboard).
+
+        Goodput accounting (goodput.py): each step-call window is
+        charged to the ledger as ``productive_step`` — the FIRST of a
+        process's life as ``compile`` (that call traces and compiles;
+        the jitted cache is warm afterwards) — and mirrored into the
+        flight recorder as a ``train_step``/``compile`` span, so
+        ``scripts/trace_dump.py`` renders a training-run timeline.
+        Attribution note for async dispatch: donated buffers make step
+        call N+1 block until step N's device work completes, so
+        successive call windows cover device time without any extra
+        ``block_until_ready`` (which would serialize the pipeline —
+        the accounting must never cost the throughput it measures).
+        ``ledger=None`` charges the process-global ledger (the one the
+        DataFeed's BEAT snapshot carries to the driver); pass
+        ``ledger=False`` to opt out. A CUSTOM ledger receives ONLY this
+        loop's step envelopes — the framework's inner hooks (checkpoint
+        saves/restores, feed waits) always charge ``goodput.ledger()``,
+        so full sum-to-wall accounting holds on the process-global
+        ledger, not a custom one; custom ledgers are for isolated
+        measurement (tests, demos) of the loop itself.
         """
         import jax
 
+        from tensorflowonspark_tpu import goodput
+        if ledger is None:
+            ledger = goodput.ledger()
         n = 0
         examples = 0
         t0 = time.monotonic()
         metrics = None
         for batch in batches:
-            state, metrics = self.step(state, batch)
+            if ledger:
+                with ledger.step_span():
+                    state, metrics = self.step(state, batch)
+            else:
+                state, metrics = self.step(state, batch)
             n += 1
             examples += _batch_size(batch)
             for hook in hooks:

@@ -735,3 +735,114 @@ def test_cluster_serving_fleet_helper(lm):
         assert body["tokens"] == _solo(dec, params, [2, 4], 3)
     finally:
         f.stop()
+
+
+# -- trace-context propagation (PR 10): X-TFOS-Trace + /debug/trace --------
+
+def _get_with_headers(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode(), dict(r.headers)
+
+
+def _stitched_sources(doc):
+    """{label: set of tids with any event} from a stitched document."""
+    labels = {e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+              if e.get("ph") == "M" and e.get("name") == "process_name"}
+    out = {label: set() for label in labels.values()}
+    for e in doc["traceEvents"]:
+        if e.get("ph") in ("X", "i"):
+            out[labels[e["pid"]]].add(e["tid"])
+    return out
+
+
+def test_router_mints_trace_and_debug_trace_stitches_replica(lm):
+    """One routed request: the router mints an X-TFOS-Trace id, the
+    replica engine ADOPTS it, and GET /debug/trace on the router
+    returns ONE stitched Perfetto document where the router's dispatch
+    span and the replica's engine spans share that id — with the ring
+    saturation total in the X-TFOS-Trace-Dropped header."""
+    dec, params = lm
+    with fleet.ServingFleet(dec, params, replicas=1, name="lm",
+                            engine_kw={"slots": 1},
+                            beat_interval=0.05) as f:
+        # ServingFleet gives each replica its OWN ring (one ring per
+        # process in real deployments) — pinned here: the stitch labels
+        # spans by source, which a shared global ring would make vacuous
+        assert f.replicas[0].engine.flight \
+            is not fleet.tracing.flight_recorder()
+        status, body = _post(f.url("/v1/models/lm:generate"),
+                             {"prompt": [3, 1, 4], "max_new_tokens": 3})
+        assert status == 200
+        assert body["tokens"] == _solo(dec, params, [3, 1, 4], 3)
+        status, text, headers = _get_with_headers(f.url("/debug/trace"))
+        assert status == 200
+        assert "X-TFOS-Trace-Dropped" in headers
+        assert int(headers["X-TFOS-Trace-Dropped"]) >= 0
+        doc = json.loads(text)
+        assert doc.get("dropped", {}).keys() == {"router", "replica-0"}
+        dispatches = [e for e in doc["traceEvents"]
+                      if e.get("name") == "dispatch"
+                      and e.get("ph") == "X"]
+        assert len(dispatches) == 1
+        trace_id = dispatches[0]["tid"]
+        assert dispatches[0]["args"]["status"] == 200
+        sources = _stitched_sources(doc)
+        # the minted id joins the router's row to the replica's spans
+        assert trace_id in sources["router"]
+        assert trace_id in sources["replica-0"], sources
+        # the replica actually emitted engine lifecycle spans under it
+        replica_spans = {e["name"] for e in doc["traceEvents"]
+                         if e.get("ph") == "X"
+                         and e["tid"] == trace_id
+                         and e.get("name") != "dispatch"
+                         and e.get("name") != "upstream"}
+        assert {"prefill", "decode"} <= replica_spans, replica_spans
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_failover_request_yields_one_stitched_cross_replica_trace(
+        lm, tmp_path):
+    """Acceptance (PR 10): a fleet request that fails over MID-STREAM
+    produces one stitched trace containing spans from BOTH replicas —
+    the dying replica's partial lifecycle and the survivor's complete
+    one share the single router-minted trace id."""
+    dec, params = lm
+    with fleet.ServingFleet(dec, params, replicas=2, name="lm",
+                            engine_kw={"slots": 2},
+                            beat_interval=0.05) as f:
+        assert f.replicas[0].engine.flight \
+            is not f.replicas[1].engine.flight, \
+            "fleet replicas must own distinct span rings"
+        url = f.url("/v1/models/lm:generate")
+        # UNSCOPED kill + fuse: the decode-step site only fires on an
+        # engine with ACTIVE slots, so the victim is deterministically
+        # whichever replica serves the request — and the single-shot
+        # fuse guarantees the survivor completes the failover
+        chaos.arm("kill_scheduler_at_step=5,fuse={}".format(
+            tmp_path / "kill_fuse"))
+        status, body = _post(url, {"prompt": [2, 3, 4],
+                                   "max_new_tokens": 16}, timeout=180)
+        # the client saw ONE clean answer (the failover is internal)
+        assert status == 200
+        assert body["tokens"] == _solo(dec, params, [2, 3, 4], 16)
+        status, text, headers = _get_with_headers(f.url("/debug/trace"))
+        assert status == 200
+        doc = json.loads(text)
+        # the failed-over dispatch: >1 upstream attempt on one trace id
+        dispatches = [e for e in doc["traceEvents"]
+                      if e.get("name") == "dispatch"
+                      and e.get("ph") == "X"
+                      and e["args"].get("attempts", 1) > 1]
+        assert dispatches, "no failed-over dispatch recorded"
+        trace_id = dispatches[0]["tid"]
+        sources = _stitched_sources(doc)
+        assert trace_id in sources["replica-0"], sources
+        assert trace_id in sources["replica-1"], sources
+        # one upstream span per attempt, both on the request's row
+        upstreams = [e for e in doc["traceEvents"]
+                     if e.get("name") == "upstream"
+                     and e["tid"] == trace_id]
+        assert len(upstreams) == 2
+        assert {u["args"]["replica"] for u in upstreams} == \
+            {"replica-0", "replica-1"}

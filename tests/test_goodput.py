@@ -1,0 +1,973 @@
+"""Goodput plane (goodput.py PR 10), pinned layer by layer.
+
+- :class:`goodput.GoodputLedger` — the charge-stack classifier, driven
+  by a FAKE clock: categories partition wall time exactly (the
+  sum-to-wall invariant), innermost-wins nesting, ``note_step``'s
+  consumed trailing window, compile-first ``step_span``, the EWMA's
+  compile exclusion, and registry exposition (families + the snapshot
+  hook that keeps open intervals current).
+- :class:`goodput.StragglerDetector` — both signatures (slow EWMA,
+  frozen step counter substituting the stall age), the LOWER-median
+  baseline that keeps a 2-executor fleet's straggler from hiding in
+  its own median, one-report-per-episode re-arming, and the
+  ``min_executors`` / ``min_stall_s`` gates.
+- Supervisor integration against a scripted lease server: an injected
+  stall raises an OBSERVE-ONLY ``straggler`` incident with the beat
+  snapshot + flight tail attached, while ``failures()`` stays empty —
+  skew never reaches a recovery policy.
+- Job composition — ``merged_categories`` over real registry merges,
+  ``job_report`` width normalization and driver-ledger folding.
+- Trace plane — ``stitch_traces`` wall-clock alignment and labeling,
+  ``mint_trace_id``, ring-saturation exposure via
+  ``expose_flight_drops``.
+- ``scripts/trace_dump.py --train-demo`` — the training-run timeline
+  (traces were serving-only before this PR).
+- [chaos] the acceptance e2e: a supervised job under an injected
+  consumer stall AND a trainer SIGKILL + recovery — badput categories
+  plus productive time sum to the executor-published wall within 2%,
+  exactly-once survives, and the ledger's measured overhead stays
+  under 1% of step time; plus the 2-executor straggler e2e where the
+  injected stall fires the incident deterministically.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import cloudpickle
+import pytest
+
+from tensorflowonspark_tpu import (chaos, cluster, goodput,
+                                   metrics_report, supervisor, tracing)
+from tensorflowonspark_tpu.engine import Context
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Executor processes cannot import this test module, so its map_funs
+# must ship by value (the engine's cloudpickle serializer honors this).
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(autouse=True)
+def _disarmed(monkeypatch):
+    monkeypatch.delenv(chaos.ENV_VAR, raising=False)
+    chaos.disarm()
+    goodput.reset()
+    yield
+    chaos.disarm()
+    goodput.reset()
+
+
+class _Clock(object):
+    """Deterministic monotonic clock for ledger/detector units."""
+
+    def __init__(self, t=100.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# -- GoodputLedger: the charge stack ---------------------------------------
+
+def test_ledger_categories_partition_wall_exactly():
+    """The pinned invariant, in its pure form: whatever sequence of
+    enter/exit the hooks produce, sum(categories) == wall EXACTLY —
+    every instant belongs to exactly one category."""
+    clk = _Clock()
+    led = goodput.GoodputLedger(clock=clk, flight=False)
+    clk.advance(1.0)                      # idle
+    led.enter("checkpoint_save")
+    clk.advance(2.0)
+    led.enter("feed_wait")                # nested: innermost wins
+    clk.advance(0.5)
+    led.exit()
+    clk.advance(1.5)                      # back to checkpoint_save
+    led.exit()
+    clk.advance(0.25)                     # idle again
+    cats = led.categories()
+    assert cats["idle"] == pytest.approx(1.25)
+    assert cats["checkpoint_save"] == pytest.approx(3.5)
+    assert cats["feed_wait"] == pytest.approx(0.5)
+    assert sum(cats.values()) == pytest.approx(led.wall_s())
+    rep = led.report()
+    assert rep["unaccounted_s"] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_ledger_note_step_consumes_trailing_window():
+    """note_step(s) claims the trailing ``s`` seconds as productive;
+    the gap before it stays with the then-current category (idle), so
+    the step cannot be double-claimed as idle."""
+    clk = _Clock()
+    led = goodput.GoodputLedger(clock=clk, flight=False)
+    clk.advance(5.0)
+    led.note_step(3.0)                    # [t+2, t+5] productive
+    cats = led.categories()
+    assert cats["idle"] == pytest.approx(2.0)
+    assert cats[goodput.PRODUCTIVE] == pytest.approx(3.0)
+    rep = led.report()
+    assert rep["steps"] == 1
+    assert rep["step_ewma_s"] == pytest.approx(3.0)
+    assert rep["goodput_ratio"] == pytest.approx(3.0 / 5.0)
+
+
+def test_ledger_note_step_respects_inner_claims():
+    """A feed wait charged INSIDE the step window stays feed_wait:
+    note_step only claims the portion no inner hook already took —
+    innermost wins across the charge boundary too."""
+    clk = _Clock()
+    led = goodput.GoodputLedger(clock=clk, flight=False)
+    led.enter("feed_wait")
+    clk.advance(2.0)
+    led.exit()                            # feed_wait == 2
+    clk.advance(1.0)
+    # the step CLAIMS 3s (wrapping the feed wait), but 2s are already
+    # charged: only the uncharged 1s becomes productive
+    led.note_step(3.0)
+    cats = led.categories()
+    assert cats["feed_wait"] == pytest.approx(2.0)
+    assert cats[goodput.PRODUCTIVE] == pytest.approx(1.0)
+    # the EWMA still advances by the CLAIMED step time (the step took
+    # 3s of wall — that is the skew signal, charges notwithstanding)
+    assert led.step_ewma_s == pytest.approx(3.0)
+    assert led.report()["unaccounted_s"] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_ledger_first_step_span_is_compile_and_ewma_excludes_it():
+    """The first step of a process's life traces+compiles: step_span
+    charges it as ``compile`` badput and keeps it OUT of the EWMA (a
+    one-off 30s trace must not dominate the skew signal)."""
+    clk = _Clock()
+    led = goodput.GoodputLedger(clock=clk, flight=False)
+    with led.step_span():
+        clk.advance(30.0)                 # the compile step
+    with led.step_span():
+        clk.advance(0.5)
+    with led.step_span():
+        clk.advance(0.5)
+    cats = led.categories()
+    assert cats["compile"] == pytest.approx(30.0)
+    assert cats[goodput.PRODUCTIVE] == pytest.approx(1.0)
+    rep = led.report()
+    assert rep["steps"] == 2              # compile step not counted
+    assert rep["step_ewma_s"] == pytest.approx(0.5)
+    # opting out: first_is_compile=False charges straight to productive
+    led2 = goodput.GoodputLedger(clock=clk, flight=False)
+    with led2.step_span(first_is_compile=False):
+        clk.advance(0.25)
+    assert led2.categories()["compile"] == 0.0
+    assert led2.report()["steps"] == 1
+
+
+def test_ledger_register_exposes_families_and_snapshot_hook():
+    """register() wires the ledger into a MetricsRegistry: tfos_badput
+    stage timers + tfos_goodput counters/gauges, with a snapshot hook
+    charging the OPEN interval — a scrape mid-checkpoint sees the
+    checkpoint time so far, and the wall gauge published atomically
+    with the categories satisfies sum(categories) == wall."""
+    clk = _Clock()
+    led = goodput.GoodputLedger(clock=clk, flight=False)
+    reg = tracing.MetricsRegistry()
+    led.register(reg)
+    led.note_step(0.0)
+    clk.advance(2.0)
+    led.note_step(2.0)
+    led.enter("checkpoint_save")
+    clk.advance(4.0)                      # interval still OPEN
+    snap = reg.snapshot()
+    timers = snap["timers"]["tfos_badput"]["t"]
+    assert timers["checkpoint_save"] == pytest.approx(4.0)
+    counters = snap["counters"]["tfos_goodput"]
+    assert counters["counts"]["productive_seconds"] == pytest.approx(2.0)
+    assert counters["counts"]["steps"] == 2
+    gauges = counters["gauges"]
+    assert gauges["step_ewma_seconds"] > 0
+    accounted = sum(timers.values()) \
+        + counters["counts"]["productive_seconds"]
+    assert accounted == pytest.approx(gauges["wall_seconds"], rel=1e-6)
+    assert gauges["ratio"] == pytest.approx(2.0 / 6.0, rel=1e-4)
+    # the rendered families are all cataloged (the metrics-lint gate)
+    text = reg.render()
+    for family in ("tfos_badput_seconds_total",
+                   "tfos_goodput_productive_seconds_total",
+                   "tfos_goodput_ratio",
+                   "tfos_goodput_step_ewma_seconds"):
+        assert family in text, family
+    assert 'stage="checkpoint_save"' in text
+
+
+def test_ledger_track_is_exception_safe():
+    clk = _Clock()
+    led = goodput.GoodputLedger(clock=clk, flight=False)
+    with pytest.raises(RuntimeError):
+        with led.track("restore"):
+            clk.advance(1.0)
+            raise RuntimeError("restore blew up")
+    clk.advance(1.0)
+    cats = led.categories()
+    assert cats["restore"] == pytest.approx(1.0)
+    assert cats["idle"] == pytest.approx(1.0)
+
+
+def test_ledger_mirrors_spans_into_flight_recorder():
+    """Closed intervals >= MIN_SPAN_S and every step land in the ring
+    as named spans — the training-run timeline trace_dump renders."""
+    flight = tracing.FlightRecorder()
+    led = goodput.GoodputLedger(flight=flight)
+    with led.track("checkpoint_save"):
+        time.sleep(goodput.MIN_SPAN_S * 2)
+    with led.track("feed_wait"):
+        pass                              # << MIN_SPAN_S: filtered
+    with led.step_span():                 # first step: the compile
+        time.sleep(0.001)
+    names = [e["name"] for e in flight.events() if e["ph"] == "X"]
+    assert "checkpoint_save" in names
+    assert "feed_wait" not in names
+    assert "compile" in names             # first step of this ledger
+    led.note_step(0.001)
+    names = [e["name"] for e in flight.events() if e["ph"] == "X"]
+    assert "train_step" in names
+
+
+# -- straggler detection ----------------------------------------------------
+
+def _view(ewma=None, step=None):
+    view = {}
+    if ewma is not None:
+        view["metrics"] = {"counters": {"tfos_goodput": {
+            "gauges": {"step_ewma_seconds": ewma}}}}
+    if step is not None:
+        view["train_step"] = step
+    return view
+
+
+def test_step_skew_uses_lower_median():
+    """With an even executor count the baseline is the LOWER median:
+    in a 2-executor fleet the upper median IS the straggler, and skew
+    against itself would never fire."""
+    skews = goodput.step_skew({0: _view(ewma=0.1), 1: _view(ewma=0.4)})
+    assert skews == {0: 1.0, 1: 4.0}
+    # no EWMAs at all: no skew to report
+    assert goodput.step_skew({0: _view(), 1: _view()}) == {}
+
+
+def test_attach_step_skew_annotates_views_in_place():
+    views = {0: _view(ewma=0.1), 1: _view(ewma=0.3)}
+    out = goodput.attach_step_skew(views)
+    assert out is views
+    assert views[1]["step_skew"] == pytest.approx(3.0)
+
+
+def test_straggler_detector_flags_slow_executor_once_and_rearms():
+    clk = _Clock()
+    det = goodput.StragglerDetector(skew_threshold=3.0, clock=clk)
+    views = {0: _view(ewma=0.1, step=5), 1: _view(ewma=0.1, step=5),
+             2: _view(ewma=0.45, step=5)}
+    found = det.observe(views)
+    assert [f["executor_id"] for f in found] == [2]
+    assert found[0]["skew"] == pytest.approx(4.5)
+    assert found[0]["stalled"] is False
+    # one report per episode
+    assert det.observe(views) == []
+    # recovery below threshold re-arms; a relapse reports again
+    views[2] = _view(ewma=0.1, step=6)
+    assert det.observe(views) == []
+    views[2] = _view(ewma=0.5, step=7)
+    assert [f["executor_id"] for f in det.observe(views)] == [2]
+
+
+def test_straggler_detector_substitutes_stall_age_for_frozen_step():
+    """A stalled executor's EWMA freezes at its last HEALTHY value —
+    the detector substitutes the age of its frozen step counter once
+    that exceeds max(ewma, min_stall_s), which is what makes an
+    injected feed stall fire deterministically."""
+    clk = _Clock()
+    det = goodput.StragglerDetector(skew_threshold=3.0, min_stall_s=1.0,
+                                    clock=clk)
+    views = {0: _view(ewma=0.1, step=1), 1: _view(ewma=0.1, step=1)}
+    assert det.observe(views) == []       # both healthy
+    clk.advance(0.5)                      # below min_stall_s: nothing
+    views[0] = _view(ewma=0.1, step=2)    # 0 progresses
+    assert det.observe(views) == []
+    clk.advance(2.0)                      # executor 1 frozen 2.5s
+    views[0] = _view(ewma=0.1, step=3)
+    found = det.observe(views)
+    assert [f["executor_id"] for f in found] == [1]
+    assert found[0]["stalled"] is True
+    assert found[0]["effective_s"] == pytest.approx(2.5)
+    assert found[0]["skew"] == pytest.approx(25.0)
+
+
+def test_straggler_detector_gates():
+    clk = _Clock()
+    det = goodput.StragglerDetector(skew_threshold=3.0, clock=clk)
+    # below min_executors: a lone executor never skews against itself
+    assert det.observe({0: _view(ewma=9.0, step=1)}) == []
+    # executors without an EWMA (no steps yet) are not counted toward
+    # the fleet, and never flagged
+    assert det.observe({0: _view(ewma=0.1, step=1),
+                        1: _view(step=0)}) == []
+
+
+# -- Supervisor integration: observe-only incidents ------------------------
+
+class _FakeLeaseServer(object):
+    def __init__(self):
+        self.leases = {}  # eid -> (age, payload)
+
+    def set(self, eid, age=0.0, **payload):
+        self.leases[eid] = (age, payload)
+
+    def lease_snapshot(self):
+        return {eid: {"age": age, "payload": dict(p)}
+                for eid, (age, p) in self.leases.items()}
+
+    def acked_partitions(self):
+        return set()
+
+
+def test_supervisor_raises_straggler_incident_observe_only():
+    """An injected stall (scripted here: executor 1's step counter
+    freezes while its lease keeps beating) must raise a ``straggler``
+    incident with the offender's beat-carried metrics snapshot
+    attached as evidence — and must NEVER appear in ``failures()``,
+    the list recovery policies drain."""
+    srv = _FakeLeaseServer()
+    cfg = supervisor.SupervisorConfig(
+        heartbeat_timeout=60.0, stall_timeout=600.0,
+        straggler_skew=3.0, straggler_min_stall_s=1.0)
+    sup = supervisor.Supervisor(server=srv, executors=[0, 1], config=cfg)
+    now = time.monotonic()
+
+    def beat(step1):
+        srv.set(0, state="running", trainer_alive=True, feed_hb=1,
+                train_step=step1[0],
+                metrics=_view(ewma=0.05)["metrics"])
+        srv.set(1, state="running", trainer_alive=True, feed_hb=1,
+                train_step=3,
+                metrics=_view(ewma=0.05)["metrics"])
+
+    step0 = [1]
+    beat(step0)
+    sup.poll_once(now=now)                # registers progress markers
+    assert sup.incidents() == []
+    step0[0] = 2
+    beat(step0)
+    sup.poll_once(now=now + 4.0)          # executor 1 frozen 4s
+    incidents = sup.incidents()
+    assert len(incidents) == 1, incidents
+    inc = incidents[0]
+    assert inc["kind"] == "straggler" and inc["executor_id"] == 1
+    assert inc["evidence"]["metrics"] is not None
+    assert inc["evidence"]["flight"] is not None
+    assert inc["detail_fields"]["stalled"] is True
+    assert "median" in inc["detail"] or "fleet" in inc["detail"]
+    # observe-only: no failure, nothing for a recovery policy
+    assert sup.failures() == []
+    # one report per episode, even as the stall continues
+    step0[0] = 3
+    beat(step0)
+    sup.poll_once(now=now + 8.0)
+    assert len(sup.incidents()) == 1
+    # the EventLog carries the milestone
+    kinds = [e for e in sup.events.events() if e["name"] == "incident"]
+    assert kinds and kinds[0]["kind"] == "straggler"
+
+
+def test_supervisor_straggler_ignores_serving_leases():
+    srv = _FakeLeaseServer()
+    cfg = supervisor.SupervisorConfig(straggler_skew=3.0,
+                                      straggler_min_stall_s=0.1)
+    sup = supervisor.Supervisor(server=srv, executors=[0], config=cfg)
+    srv.set(0, state="running", trainer_alive=True, feed_hb=1,
+            train_step=1, metrics=_view(ewma=0.05)["metrics"])
+    srv.set("replica-0", state="running", role="serving",
+            metrics=_view(ewma=99.0)["metrics"], train_step=0)
+    now = time.monotonic()
+    sup.poll_once(now=now)
+    sup.poll_once(now=now + 30.0)
+    assert sup.incidents() == []          # serving lease never counted
+
+
+def test_supervisor_config_can_disable_straggler_detection():
+    cfg = supervisor.SupervisorConfig(straggler_skew=None)
+    sup = supervisor.Supervisor(server=_FakeLeaseServer(),
+                                executors=[0], config=cfg)
+    assert sup._straggler is None
+    sup.poll_once()                       # must not blow up
+
+
+# -- job-level composition --------------------------------------------------
+
+def _exec_snapshot(productive=0.0, **badput):
+    """A registry snapshot as one executor's ledger would publish."""
+    clk = _Clock()
+    led = goodput.GoodputLedger(clock=clk, flight=False)
+    reg = tracing.MetricsRegistry()
+    led.register(reg)
+    for category, seconds in badput.items():
+        led.enter(category)
+        clk.advance(seconds)
+        led.exit()
+    if productive:
+        clk.advance(productive)
+        led.note_step(productive)
+    return reg.snapshot()
+
+
+def test_merged_categories_sums_executors():
+    merged = tracing.merge_snapshots([
+        _exec_snapshot(productive=6.0, feed_wait=2.0),
+        _exec_snapshot(productive=4.0, checkpoint_save=1.0)])
+    cats = goodput.merged_categories(merged)
+    assert cats[goodput.PRODUCTIVE] == pytest.approx(10.0)
+    assert cats["feed_wait"] == pytest.approx(2.0)
+    assert cats["checkpoint_save"] == pytest.approx(1.0)
+    assert goodput.merged_categories(None)[goodput.PRODUCTIVE] == 0.0
+
+
+def test_job_report_width_normalization_and_driver_fold():
+    """N executors each fully productive for the window == ratio 1.0
+    (not N); the driver ledger contributes ONLY the windows no trainer
+    exists to measure (reform), so nothing double-counts."""
+    merged = tracing.merge_snapshots([
+        _exec_snapshot(productive=8.0, feed_wait=2.0),
+        _exec_snapshot(productive=8.0, feed_wait=2.0)])
+    clk = _Clock()
+    driver = goodput.GoodputLedger(clock=clk, flight=False)
+    driver.enter("reform")
+    clk.advance(3.0)
+    driver.exit()
+    report = goodput.job_report(13.0, driver_ledger=driver,
+                                merged_snapshots=[merged], width=2)
+    assert report["productive_s"] == pytest.approx(8.0)
+    assert report["badput"]["feed_wait"] == pytest.approx(2.0)
+    assert report["badput"]["reform"] == pytest.approx(3.0)
+    # 8 + 2 + 3 == 13: fully accounted
+    assert report["unaccounted_s"] == pytest.approx(0.0, abs=1e-6)
+    total = report["productive_s"] + sum(report["badput"].values())
+    assert total == pytest.approx(report["wall_s"], rel=0.001)
+    assert report["goodput_ratio"] == pytest.approx(8.0 / 13.0)
+    # width=1 with the same snapshots would read 16s productive
+    wide = goodput.job_report(29.0, merged_snapshots=[merged], width=1)
+    assert wide["productive_s"] == pytest.approx(16.0)
+
+
+def test_job_report_residual_lands_in_idle():
+    report = goodput.job_report(
+        10.0, merged_snapshots=[_exec_snapshot(productive=4.0)], width=1)
+    assert report["badput"]["idle"] == pytest.approx(6.0)
+    total = report["productive_s"] + sum(report["badput"].values())
+    assert total == pytest.approx(10.0)
+
+
+def test_format_goodput_and_straggler_table_render():
+    report = goodput.job_report(
+        10.0, merged_snapshots=[_exec_snapshot(productive=4.0,
+                                               feed_wait=1.0)], width=1)
+    text = metrics_report.format_goodput(report)
+    assert "goodput" in text and "feed_wait" in text
+    table = metrics_report.format_straggler_table({0: 1.0, 1: 4.2})
+    assert "executor" in table and "4.20" in table
+    assert "no step-time skew" in metrics_report.format_straggler_table([])
+
+
+# -- trace plane ------------------------------------------------------------
+
+def test_mint_trace_id_numeric_and_distinct():
+    a, b = tracing.mint_trace_id(), tracing.mint_trace_id()
+    assert isinstance(a, int) and isinstance(b, int)
+    assert a != b
+
+
+def test_stitch_traces_aligns_epochs_and_labels_sources():
+    """Docs from different processes align onto the FIRST doc's epoch
+    via epochWall, each source becomes its own labeled Chrome-trace
+    process, and per-source ring drops travel with the stitch."""
+    router_doc = {"traceEvents": [
+        {"name": "dispatch", "ph": "X", "ts": 1000, "dur": 5000,
+         "pid": 10, "tid": 7, "args": {}}],
+        "epochWall": 1000.0, "dropped": 2}
+    replica_doc = {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 20, "tid": 0,
+         "ts": 0, "args": {"name": "old"}},
+        {"name": "prefill", "ph": "X", "ts": 500, "dur": 100,
+         "pid": 20, "tid": 7, "args": {}}],
+        "epochWall": 1002.0, "dropped": 1}
+    out = tracing.stitch_traces([("router", router_doc),
+                                 ("replica-0", replica_doc)])
+    assert out["dropped"] == {"router": 2, "replica-0": 1}
+    labels = {e["pid"]: e["args"]["name"]
+              for e in out["traceEvents"]
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert labels == {0: "router", 1: "replica-0"}
+    prefill = [e for e in out["traceEvents"]
+               if e.get("name") == "prefill"][0]
+    # replica epoch is 2s later than the router's: +2e6 us shift
+    assert prefill["ts"] == 500 + 2_000_000
+    assert prefill["pid"] == 1            # synthetic source pid
+    dispatch = [e for e in out["traceEvents"]
+                if e.get("name") == "dispatch"][0]
+    assert dispatch["ts"] == 1000         # first doc: unshifted
+    # the adopted trace id is the cross-source join key
+    assert dispatch["tid"] == prefill["tid"] == 7
+
+
+def test_expose_flight_drops_mirrors_ring_eviction():
+    flight = tracing.FlightRecorder(capacity=4)
+    reg = tracing.MetricsRegistry()
+    tracing.expose_flight_drops(reg, flight)
+    snap = reg.snapshot()
+    assert snap["counters"]["tfos_trace"]["counts"] \
+        .get("spans_dropped", 0) == 0
+    for i in range(10):
+        flight.instant("tick", i=i)
+    snap = reg.snapshot()                 # hook syncs at snapshot time
+    assert snap["counters"]["tfos_trace"]["counts"]["spans_dropped"] == 6
+    assert "tfos_trace_spans_dropped_total 6" in reg.render()
+    # chrome_trace carries the tally for /debug/trace headers
+    assert flight.chrome_trace()["dropped"] == 6
+
+
+def test_step_span_claims_compile_exactly_once_under_concurrency():
+    # two first spans racing on a fresh ledger: exactly ONE may read
+    # as the compile step (the claim flag, checked-and-set under the
+    # ledger lock) — the other is a productive step that advances the
+    # steps counter and the EWMA
+    ledger = goodput.GoodputLedger(flight=False)
+    barrier = threading.Barrier(2)
+
+    def run():
+        barrier.wait()
+        with ledger.step_span():
+            time.sleep(0.01)
+
+    threads = [threading.Thread(target=run) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    report = ledger.report()
+    assert report["steps"] == 1, report
+    assert report["badput"]["compile"] > 0, report
+    assert ledger.step_ewma_s is not None
+
+
+def test_expose_flight_drops_is_idempotent_and_sums_rings():
+    # respawn shape: the SAME (registry, ring) re-exposed N times must
+    # keep ONE hook (a fresh closure per respawn would pile up N
+    # dead-engine hooks on a long-lived supervised server)
+    flight = tracing.FlightRecorder(capacity=4)
+    reg = tracing.MetricsRegistry()
+    for _ in range(5):
+        tracing.expose_flight_drops(reg, flight)
+    assert len(reg._hooks) == 1
+    # a genuinely DISTINCT ring on the same registry accumulates
+    # instead of last-write-wins clobbering the tally
+    other = tracing.FlightRecorder(capacity=4)
+    tracing.expose_flight_drops(reg, other)
+    assert len(reg._hooks) == 1
+    for i in range(10):
+        flight.instant("a", i=i)
+        other.instant("b", i=i)
+    counts = reg.snapshot()["counters"]["tfos_trace"]["counts"]
+    assert counts["spans_dropped"] == flight.dropped + other.dropped == 12
+
+
+def test_chrome_trace_carries_epoch_wall():
+    flight = tracing.FlightRecorder()
+    doc = flight.chrome_trace()
+    assert isinstance(doc["epochWall"], float)
+    # epochWall must locate the monotonic epoch on the wall clock
+    assert abs(doc["epochWall"] - time.time()) < 60.0
+
+
+def test_trace_dump_train_demo_renders_training_timeline(tmp_path):
+    """scripts/trace_dump.py --train-demo: a real (tiny) Trainer run
+    yields a Perfetto-loadable timeline with compile, train_step, and
+    feed_wait spans — traces were serving-only before the goodput
+    plane."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import trace_dump
+    finally:
+        sys.path.pop(0)
+    out = str(tmp_path / "train_trace.json")
+    assert trace_dump.main(["--train-demo", "--steps", "4",
+                            "-o", out]) == 0
+    trace = json.load(open(out))
+    assert "epochWall" in trace and "dropped" in trace
+    spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    names = [e["name"] for e in spans]
+    assert names.count("compile") == 1    # exactly the first step
+    assert names.count("train_step") == 3
+    assert "feed_wait" in names
+    for e in spans:
+        assert {"name", "ph", "pid", "tid", "ts", "dur"} <= set(e), e
+    # steps do not overlap: successive windows on one timeline
+    steps = sorted((e for e in spans
+                    if e["name"] in ("compile", "train_step")),
+                   key=lambda e: e["ts"])
+    for a, b in zip(steps, steps[1:]):
+        assert a["ts"] + a["dur"] <= b["ts"] + 1000, (a, b)
+
+
+# -- ledger overhead (the <1%-of-step acceptance bound) ---------------------
+
+def test_ledger_overhead_under_one_percent_of_step():
+    """The accounting must never cost the throughput it measures: one
+    note_step + two track cycles (feed wait + checkpoint — what the
+    framework pays per step) must stay under 1% of even a FAST 10ms
+    step."""
+    led = goodput.GoodputLedger(flight=False)
+    reps = 20000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        with led.track("feed_wait"):
+            pass
+    track_s = (time.perf_counter() - t0) / reps
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        led.note_step(1e-7)
+    note_s = (time.perf_counter() - t0) / reps
+    per_step = note_s + 2 * track_s
+    assert per_step < 0.01 * 0.010, \
+        "ledger overhead {:.1f}us per step".format(per_step * 1e6)
+
+
+# -- chaos e2e: the acceptance run -----------------------------------------
+
+#: one feed partition == one device batch == one checkpointed step
+BATCH, PARTS = 4, 6
+
+
+def _goodput_train_fun(args, ctx):
+    """Supervision-aware trainer with REAL productive work: each batch
+    runs one synthetic device step of ``step_s`` inside
+    ``ledger.step_span()``; checkpoint saves/restores and feed waits
+    charge through the framework hooks untouched. ``attach(feed=...)``
+    flushes accounting at the step boundary BEFORE the chaos kill
+    site, so a killed trainer's ledger is current."""
+    import json as _json
+    import os as _os
+    import time as _time
+
+    import numpy as _np
+
+    from tensorflowonspark_tpu import chaos as _chaos
+    from tensorflowonspark_tpu import checkpoint as _checkpoint
+    from tensorflowonspark_tpu import goodput as _goodput
+    from tensorflowonspark_tpu import reservation as _reservation
+    from tensorflowonspark_tpu import supervisor as _supervisor
+
+    ledger = _goodput.ledger()
+    ckpt = _checkpoint.Checkpointer(args["dir"], chief=True)
+    like = {"step": _np.array(0, _np.int32),
+            "seen": _np.array(0.0, _np.float64)}
+    restored = ckpt.restore(like, fallback=True)
+    state = restored if restored is not None else like
+    step = int(state["step"])
+    start = step
+    feed = ctx.get_data_feed(train_mode=True)
+    sup = _supervisor.attach(
+        ctx, restored_step=step if restored is not None else None,
+        feed=feed)
+
+    def _acked_up_to(n):
+        client = _reservation.Client(ctx.cluster_meta["server_addr"])
+        try:
+            return _chaos.poll_until(lambda: len(client.acked()) >= n,
+                                     timeout=60)
+        finally:
+            client.close()
+
+    while not feed.should_stop():
+        batch = feed.next_batch(args["batch"])
+        if not batch:
+            continue
+        step += 1
+        with ledger.step_span(first_is_compile=False):
+            _time.sleep(args["step_s"])   # the synthetic device step
+            state = {"step": _np.array(step, _np.int32),
+                     "seen": _np.array(float(state["seen"]) + sum(batch),
+                                       _np.float64)}
+        ckpt.save(step, state, force=True)
+        ckpt.wait()
+        _acked_up_to(step - start)
+        sup.step(step)                    # chaos kill site fires HERE
+    ckpt.close()
+    with open(_os.path.join(args["dir"], "final.json"), "w") as f:
+        _json.dump({"step": step, "seen": float(state["seen"])}, f)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_goodput_ledger_sums_to_wall_under_stall_kill_recovery(tmp_path):
+    """The acceptance e2e: one supervised job under an injected
+    consumer stall (batch 1) AND a trainer SIGKILL after step 3's
+    checkpoint, recovery included. Pins: (1) each executor snapshot's
+    categories sum to the wall gauge it published ATOMICALLY with them
+    within 2%; (2) the job report's productive + badput sum to its
+    wall within 2% (no double-counting between driver and executor
+    ledgers); (3) the stall is VISIBLE as feed_wait, the kill as
+    reform + restore; (4) exactly-once still holds."""
+    ckpt_dir = str(tmp_path / "ckpt")
+    os.makedirs(ckpt_dir)
+    kill_fuse = str(tmp_path / "kill_fuse")
+    stall_fuse = str(tmp_path / "stall_fuse")
+    stall_s, step_s = 1.5, 0.05
+    records = list(range(BATCH * PARTS))
+    spec = ("kill_trainer_at_step=3,fuse={};"
+            "stall_consumer_for={},fuse={}").format(
+                kill_fuse, stall_s, stall_fuse)
+    sc = Context(num_executors=1, work_root=str(tmp_path / "engine"),
+                 executor_env={"TFOS_FEED_TRANSPORT": "queue",
+                               chaos.ENV_VAR: spec})
+    cfg = supervisor.SupervisorConfig(
+        policy=supervisor.RestartFromCheckpoint(max_restarts=2,
+                                                backoff=0.1),
+        heartbeat_interval=0.25, heartbeat_timeout=20.0,
+        poll_interval=0.1, classify_grace=10.0)
+    try:
+        tfc = cluster.run(sc, _goodput_train_fun,
+                          {"dir": ckpt_dir, "batch": BATCH,
+                           "step_s": step_s},
+                          num_executors=1,
+                          input_mode=cluster.InputMode.SPARK,
+                          supervise=cfg)
+        tfc.train(sc.parallelize(records, PARTS), feed_timeout=120)
+        report = tfc.goodput_report()
+        merged = (tfc.metrics() or {}).get("cluster", {}).get("merged")
+        rep = tfc.report()
+    finally:
+        sc.stop()
+
+    assert os.path.exists(kill_fuse), "the kill never fired"
+    assert os.path.exists(stall_fuse), "the stall never fired"
+    # exactly-once survives with the ledger in the loop
+    final = json.load(open(os.path.join(ckpt_dir, "final.json")))
+    assert final["step"] == PARTS and final["seen"] == float(sum(records))
+    assert rep["formations"] == 2
+    assert [f["kind"] for f in rep["failures"]] == ["trainer_crash"]
+
+    # (1) snapshot-internal invariant: the final attempt's categories
+    # vs the wall gauge published atomically with them
+    cats = goodput.merged_categories(merged)
+    wall_gauge = (((merged or {}).get("counters") or {})
+                  .get("tfos_goodput") or {}).get("gauges", {}) \
+        .get("wall_seconds")
+    assert wall_gauge and wall_gauge > 0, merged
+    accounted = sum(cats.values())
+    assert abs(accounted - wall_gauge) <= 0.02 * wall_gauge, \
+        (accounted, wall_gauge, cats)
+
+    # (2) job-level: productive + badput sum to the job wall within 2%
+    wall = report["wall_s"]
+    total = report["productive_s"] + sum(report["badput"].values())
+    assert 0.98 * wall <= total <= 1.02 * wall, report
+    assert report["unaccounted_s"] >= -0.02 * wall, report
+    # the wall denominator FROZE at job completion: a report read
+    # later must describe the job, not dilute its ratio with
+    # post-job elapsed time as idle
+    time.sleep(0.25)
+    late = tfc.goodput_report()
+    assert late["wall_s"] == wall, (late["wall_s"], wall)
+    assert late["goodput_ratio"] == report["goodput_ratio"]
+
+    # (3) every injected cost is attributed to its category
+    badput = report["badput"]
+    assert badput["feed_wait"] >= stall_s * 0.9, badput
+    assert badput["checkpoint_save"] > 0, badput
+    assert badput["restore"] > 0, badput          # attempt 2 restored
+    assert badput["reform"] > 0, badput           # the recovery window
+    # attempt 2's steps are FULLY accounted (the post-shutdown harvest
+    # reads the final beat); attempt 1 may lose up to one
+    # publish-throttle window of steps to the SIGKILL — the documented
+    # "at most the publish-to-beat gap" bound, so require the restored
+    # attempt's three steps plus at least one pre-kill step
+    assert report["productive_s"] >= step_s * (PARTS - 2), report
+    assert 0.0 < report["goodput_ratio"] < 1.0, report
+    # the report block rides the supervision ledger too
+    assert rep["goodput"]["wall_s"] > 0
+
+
+def _straggler_train_fun(args, ctx):
+    """2-executor straggler e2e trainer: seeds the step-time EWMA and
+    publishes step 0 BEFORE the first feed read, because the injected
+    stall (``stall_consumer_for`` scoped ``only=1``) fires inside the
+    FIRST ``next_batch`` — the detector needs a published EWMA and a
+    frozen step counter to substitute the stall age for."""
+    import time as _time
+
+    from tensorflowonspark_tpu import goodput as _goodput
+    from tensorflowonspark_tpu import supervisor as _supervisor
+
+    ledger = _goodput.ledger()
+    feed = ctx.get_data_feed(train_mode=True)
+    sup = _supervisor.attach(ctx, feed=feed)
+    # two healthy steps' worth of EWMA, published with train_step=0
+    ledger.note_step(args["step_s"])
+    ledger.note_step(args["step_s"])
+    sup.step(0)
+    step = 0
+    while not feed.should_stop():
+        batch = feed.next_batch(args["batch"])
+        if not batch:
+            continue
+        step += 1
+        with ledger.step_span(first_is_compile=False):
+            _time.sleep(args["step_s"])
+        sup.step(step)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_straggler_incident_fires_under_injected_stall(tmp_path):
+    """Acceptance: an injected consumer stall on ONE executor of two
+    raises the observe-only ``straggler`` incident deterministically —
+    with the offender's beat-carried metrics snapshot attached — while
+    the job completes with NO failure and NO recovery attempt."""
+    stall_fuse = str(tmp_path / "stall_fuse")
+    stall_s, step_s = 6.0, 0.02
+    records = list(range(BATCH * PARTS))
+    spec = "stall_consumer_for={},only=1,fuse={}".format(
+        stall_s, stall_fuse)
+    sc = Context(num_executors=2, work_root=str(tmp_path / "engine"),
+                 executor_env={"TFOS_FEED_TRANSPORT": "queue",
+                               chaos.ENV_VAR: spec})
+    cfg = supervisor.SupervisorConfig(
+        policy=supervisor.RestartFromCheckpoint(max_restarts=1,
+                                                backoff=0.1),
+        heartbeat_interval=0.25, heartbeat_timeout=20.0,
+        stall_timeout=120.0, poll_interval=0.1, classify_grace=10.0,
+        straggler_skew=3.0, straggler_min_stall_s=1.0)
+    try:
+        tfc = cluster.run(sc, _straggler_train_fun,
+                          {"batch": BATCH, "step_s": step_s},
+                          num_executors=2,
+                          input_mode=cluster.InputMode.SPARK,
+                          supervise=cfg)
+        tfc.train(sc.parallelize(records, PARTS), feed_timeout=120)
+        rep = tfc.report()
+    finally:
+        sc.stop()
+
+    assert os.path.exists(stall_fuse), "the stall never fired"
+    # the incident fired, carries evidence, and names the stalled
+    # executor
+    incidents = [i for i in rep["incidents"] if i["kind"] == "straggler"]
+    assert incidents, rep["events"]
+    inc = incidents[0]
+    assert inc["executor_id"] == 1, incidents
+    assert inc["evidence"]["metrics"] is not None
+    assert inc["detail_fields"]["skew"] >= 3.0
+    # observe-only: the job completed on formation 1 with no failures
+    assert rep["failures"] == [], rep["failures"]
+    assert rep["formations"] == 1
+    events = [e for e in rep["events"] if e["name"] == "incident"]
+    assert events and events[0]["kind"] == "straggler"
+
+
+# -- satellite: training logs carry the ratio with zero caller changes -----
+
+def test_metrics_hook_emits_goodput_ratio_alongside_throughput():
+    """tracing.metrics_hook must publish train/goodput_ratio whenever
+    the process ledger has accounted productive time — existing
+    training loops get the ratio in their logs without any change."""
+    class _Writer(object):
+        def __init__(self):
+            self.scalars = {}
+
+        def scalar(self, tag, value, step):
+            self.scalars[tag] = (value, step)
+
+        def flush(self):
+            pass
+
+    writer = _Writer()
+    hook = tracing.metrics_hook(writer, every_steps=1)
+    hook(1, None, {"loss": 0.5})
+    # no productive time yet: throughput only, no ratio
+    assert "train/steps_per_sec" in writer.scalars
+    assert "train/goodput_ratio" not in writer.scalars
+    goodput.ledger().note_step(0.01)
+    hook(2, None, {"loss": 0.4})
+    value, step = writer.scalars["train/goodput_ratio"]
+    assert 0.0 < value <= 1.0 and step == 2
+
+
+# -- review-hardening regressions ------------------------------------------
+
+def test_step_span_keeps_leading_compute_productive_around_inner_hook():
+    """An inner hook opening MID-step (a checkpoint save from
+    Checkpointer, a feed wait) must find the step category underneath
+    it: the compute before AND after the inner interval stays
+    productive — a detached step window used to charge the leading
+    compute to idle at the inner enter()'s transition."""
+    clk = _Clock()
+    led = goodput.GoodputLedger(clock=clk, flight=False)
+    with led.step_span(first_is_compile=False):
+        clk.advance(2.0)                  # compute before the save
+        with led.track("checkpoint_save"):
+            clk.advance(1.0)
+        clk.advance(2.0)                  # compute after the save
+    cats = led.categories()
+    assert cats[goodput.PRODUCTIVE] == pytest.approx(4.0)
+    assert cats["checkpoint_save"] == pytest.approx(1.0)
+    assert cats["idle"] == pytest.approx(0.0)
+    rep = led.report()
+    # the EWMA advances by the WHOLE span (the step took 5s of wall)
+    assert rep["step_ewma_s"] == pytest.approx(5.0)
+    assert rep["unaccounted_s"] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_straggler_pass_skips_dead_and_stale_executors():
+    """An executor whose beats stopped (dead node) or whose trainer is
+    known dead must never read as a straggler — its frozen step
+    counter is a liveness problem the crash taxonomy owns, and its
+    inflated stall age must not skew the fleet median either."""
+    srv = _FakeLeaseServer()
+    cfg = supervisor.SupervisorConfig(
+        heartbeat_interval=1.0, heartbeat_timeout=60.0,
+        stall_timeout=600.0, straggler_skew=3.0,
+        straggler_min_stall_s=1.0)
+    sup = supervisor.Supervisor(server=srv, executors=[0, 1],
+                                config=cfg)
+    now = time.monotonic()
+    srv.set(0, state="running", trainer_alive=True, feed_hb=1,
+            train_step=1, metrics=_view(ewma=0.05)["metrics"])
+    srv.set(1, state="running", trainer_alive=True, feed_hb=1,
+            train_step=3, metrics=_view(ewma=0.05)["metrics"])
+    sup.poll_once(now=now)
+    # executor 1's beats STOP (lease age grows) with its step frozen;
+    # executor 0 keeps progressing
+    srv.set(0, state="running", trainer_alive=True, feed_hb=2,
+            train_step=2, metrics=_view(ewma=0.05)["metrics"])
+    srv.set(1, age=10.0, state="running", trainer_alive=True,
+            feed_hb=1, train_step=3,
+            metrics=_view(ewma=0.05)["metrics"])
+    sup.poll_once(now=now + 10.0)
+    assert sup.incidents() == [], sup.incidents()
+    # a dead trainer on a FRESH lease is the crash taxonomy's, too
+    srv.set(1, state="running", trainer_alive=False, trainer_exit=-9,
+            train_step=3, metrics=_view(ewma=0.05)["metrics"])
+    sup.poll_once(now=now + 20.0)
+    assert all(i["kind"] != "straggler" for i in sup.incidents()), \
+        sup.incidents()
+
+
+def test_mint_trace_id_never_aliases_local_sequence(monkeypatch):
+    """Even a pid that is a multiple of 2048 (salt bits all zero) must
+    mint ids disjoint from the replica-local next_trace_id sequence —
+    a zero salt would merge unrelated requests onto one Perfetto row
+    for every request that router handles."""
+    monkeypatch.setattr(tracing.os, "getpid", lambda: 4096)
+    minted = tracing.mint_trace_id()
+    assert minted >> 20 != 0
+    assert minted != tracing.next_trace_id()

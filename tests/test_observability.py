@@ -620,3 +620,127 @@ def test_metrics_report_helpers():
     timers.add("gather", 0.1)
     line = metrics_report.format_stage_ms(timers)
     assert line.startswith("decode=")  # sorted by cost, descending
+
+
+# -- snapshot algebra (PR 10): the laws the goodput rollup leans on --------
+
+def _random_registry(seed, families=("tfos_serving_ttft_seconds",
+                                     "tfos_serving_queue_wait_seconds")):
+    """A registry with randomized counters, gauges, timers, and
+    histogram observations — one simulated executor's snapshot."""
+    rng = np.random.RandomState(seed)
+    reg = tracing.MetricsRegistry()
+    counters = tracing.Counters()
+    for key in ("alpha", "beta"):
+        counters.inc(key, int(rng.randint(0, 50)))
+    counters.gauge("depth", float(rng.uniform(0, 4)))
+    reg.add_counters("tfos_prop", counters)
+    timers = tracing.StageTimers()
+    for stage in ("read", "decode"):
+        for _ in range(int(rng.randint(1, 5))):
+            timers.add(stage, float(rng.uniform(0.001, 0.2)))
+    reg.add_timers("tfos_prop_stage", timers)
+    samples = {}
+    for family in families:
+        hist = reg.histogram(family)
+        vals = rng.lognormal(mean=-3, sigma=1.2,
+                             size=int(rng.randint(10, 80)))
+        for v in vals:
+            hist.observe(float(v))
+        samples[family] = list(vals)
+    return reg, samples
+
+
+def _approx_same(a, b, rel=1e-9):
+    """Recursive structural equality with float tolerance (sums taken
+    in different orders may differ in the last ulp)."""
+    if isinstance(a, dict):
+        return isinstance(b, dict) and a.keys() == b.keys() and \
+            all(_approx_same(a[k], b[k], rel) for k in a)
+    if isinstance(a, (list, tuple)):
+        return len(a) == len(b) and \
+            all(_approx_same(x, y, rel) for x, y in zip(a, b))
+    if isinstance(a, float) or isinstance(b, float):
+        if a is None or b is None:
+            return a == b
+        return a == pytest.approx(b, rel=rel, abs=1e-12)
+    return a == b
+
+
+def test_merge_snapshots_is_commutative_and_associative():
+    """The rollup laws: any grouping and any order of executor
+    snapshots merges to the same cluster view — what lets BEAT-carried
+    snapshots fold incrementally (and the goodput job report sum
+    attempts) without coordination."""
+    snaps = [_random_registry(seed)[0].snapshot() for seed in (1, 2, 3)]
+    a, b, c = snaps
+    merged = tracing.merge_snapshots([a, b, c])
+    # commutativity: every permutation agrees
+    for perm in ((a, c, b), (b, a, c), (b, c, a), (c, a, b), (c, b, a)):
+        assert _approx_same(tracing.merge_snapshots(list(perm)), merged)
+    # associativity: merge of merges == flat merge
+    left = tracing.merge_snapshots(
+        [tracing.merge_snapshots([a, b]), c])
+    right = tracing.merge_snapshots(
+        [a, tracing.merge_snapshots([b, c])])
+    assert _approx_same(left, merged)
+    assert _approx_same(right, merged)
+    # identity: empty snapshots change nothing
+    assert _approx_same(
+        tracing.merge_snapshots([a, {}, b, None, c]), merged)
+
+
+def _hist_from_snapshot(snap):
+    hist = tracing.Histogram(lo=snap["lo"], growth=snap["growth"])
+    assert len(hist._counts) == len(snap["counts"]), \
+        "layout mismatch: cannot reconstruct"
+    hist._counts = list(snap["counts"])
+    hist._n = snap["n"]
+    hist._sum = snap["sum"]
+    hist._min = snap["min"]
+    hist._max = snap["max"]
+    return hist
+
+
+def test_merged_quantile_matches_concatenated_observations():
+    """The quantile of a MERGED histogram equals the quantile of a
+    single histogram fed every executor's observations (same buckets,
+    same ranks) — merged percentiles are not an approximation of the
+    per-executor ones but the true fleet percentile, within one bucket
+    of exact."""
+    family = "tfos_serving_ttft_seconds"
+    regs_samples = [_random_registry(seed) for seed in (11, 12, 13)]
+    merged = tracing.merge_snapshots(
+        [reg.snapshot() for reg, _ in regs_samples])
+    concat = tracing.Histogram()
+    all_samples = []
+    for _, samples in regs_samples:
+        for v in samples[family]:
+            concat.observe(float(v))
+            all_samples.append(float(v))
+    remade = _hist_from_snapshot(merged["hists"][family])
+    assert remade.count == concat.count == len(all_samples)
+    for q in (0.5, 0.9, 0.99):
+        assert remade.quantile(q) == pytest.approx(concat.quantile(q))
+        # and both land within one bucket of the exact percentile
+        exact = float(np.percentile(all_samples, q * 100,
+                                    method="inverted_cdf"))
+        ratio = remade.quantile(q) / exact
+        assert 1.0 / remade.growth <= ratio <= remade.growth, (q, ratio)
+
+
+def test_cluster_rollup_order_invariant():
+    """cluster_rollup's merged view must not depend on executor
+    iteration order (dict order differs across beat arrival orders)."""
+    snaps = {eid: _random_registry(20 + eid)[0].snapshot()
+             for eid in range(3)}
+    views_fwd = {eid: {"metrics": snaps[eid], "train_step": eid}
+                 for eid in (0, 1, 2)}
+    views_rev = {eid: {"metrics": snaps[eid], "train_step": eid}
+                 for eid in (2, 1, 0)}
+    fwd = tracing.cluster_rollup(views_fwd)
+    rev = tracing.cluster_rollup(views_rev)
+    assert _approx_same(fwd["cluster"]["merged"],
+                        rev["cluster"]["merged"])
+    assert fwd["cluster"]["executors"] == 3
+    assert fwd["cluster"]["train_step"] == rev["cluster"]["train_step"]

@@ -414,26 +414,33 @@ def _batcher_leg(dec, params, reqs):
     return tokens / wall, metrics_report.quantiles_ms(hist), len(groups)
 
 
-def _engine_leg(dec, params, reqs, slots):
+def _engine_leg(dec, params, reqs, slots, **engine_kw):
     """The NEW serving shape: continuous batching through
     serving.DecodeEngine. Returns (tokens/sec, latency quantiles,
     stats) — THE engine-measurement harness; scripts/profile_serving.py
     imports it so bench numbers and profile attributions describe the
-    same run shape.
+    same run shape. ``engine_kw`` passes through to the engine
+    (``attn_impl="gather"`` runs the PR 8 reference formulation for
+    kernel-delta comparisons).
 
     All percentiles are read from the engine's OWN MetricsRegistry
     histograms (PR 5) — the exact objects ``GET /metrics`` renders —
     so the published p50/p95/p99 and a scraped series are two views of
-    one distribution, never parallel sample lists."""
+    one distribution, never parallel sample lists. The ``attn`` stage
+    is the engine's standalone attention probe at its live shapes
+    (``measure_attn`` — one layer's worth per call), recorded through
+    the same StageTimers as every other stage so the fused-vs-gather
+    delta reads out of one table."""
     from tensorflowonspark_tpu import metrics_report, serving
 
-    eng = serving.DecodeEngine(dec, params, slots=slots)
+    eng = serving.DecodeEngine(dec, params, slots=slots, **engine_kw)
     try:
         t0 = time.monotonic()
         handles = [eng.submit(p, mn) for p, mn in reqs]
         for h in handles:
             h.result(1800)
         wall = time.monotonic() - t0
+        eng.measure_attn()  # the 'attn' stage sample (idle engine)
         counts = eng.counters.snapshot()["counts"]
         quantiles = metrics_report.serving_quantiles(eng.metrics)
         stats = {"compile": eng.compile_stats(),
@@ -456,6 +463,7 @@ def _engine_leg(dec, params, reqs, slots):
                  "stage_ms": metrics_report.stage_ms(eng.timers),
                  "stage_s_total": metrics_report.stage_totals_s(
                      eng.timers)}
+        stats["attn_impl"] = eng.attn_impl
         if eng._paged:
             # block-pool view (PR 8): resident KV bytes, pool headroom,
             # and the prefix-cache tallies for this run shape
@@ -465,6 +473,10 @@ def _engine_leg(dec, params, reqs, slots):
                 "blocks_total": load["kv_blocks_total"],
                 "blocks_free": load["kv_blocks_free"],
                 "prefix_hit_rate": load["prefix_hit_rate"],
+                "generated_prefix_hit_blocks":
+                    load["generated_prefix_hit_blocks"],
+                "generated_prefix_registered":
+                    load["generated_prefix_registered"],
                 "cache_bytes": eng.kv_cache_bytes(),
                 "preemptions": counts.get("preemptions", 0)}
         return (counts.get("tokens", 0) / wall, quantiles["latency"],
@@ -598,6 +610,134 @@ def _prefix_reuse_leg(on_tpu):
     return out
 
 
+def _multi_turn_leg(on_tpu, turns=4):
+    """Multi-turn chat: the workload generated-prefix registration
+    (PR 11) exists for. One conversation runs ``turns`` rounds; each
+    round's prompt is the FULL history (prior prompt + prior reply) +
+    a short new user message. WARM (prefix cache on, the default) the
+    prior turns' blocks — including the DECODE-generated reply blocks
+    — are resident, so turn 2+ admission is a table write plus a
+    short-tail prefill; COLD (prefix cache off) every turn re-prefills
+    its whole history. Warm turn-2 TTFT >= 5x faster than cold is the
+    acceptance floor.
+
+    Also publishes ``decode_step_vs_pool``: per-step decode time at a
+    FIXED live-token workload while total_len (and the default pool
+    with it) scales — the fused path's curve must stay flat (it visits
+    live blocks only) while the gather path's grows with the logical
+    view it materializes each step. TTFTs are measured client-side
+    with programs prewarmed on a throwaway conversation, so the ratio
+    is prefill economics, not compile skew."""
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu import metrics_report, serving
+    from tensorflowonspark_tpu.models.decoder import DecoderLM
+
+    kw = dict(vocab=256, hidden=256 if on_tpu else 64,
+              num_heads=8 if on_tpu else 4,
+              num_layers=4 if on_tpu else 2, max_len=1024)
+    train = DecoderLM(decode=False, **kw)
+    dec = DecoderLM(decode=True, **kw)
+    params = train.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 64), np.int32))["params"]
+    rng = np.random.RandomState(13)
+    sys_len, user_len, max_new = 448, 8, 48
+
+    def conversation(seed_off):
+        r = np.random.RandomState(100 + seed_off)
+        return (r.randint(0, dec.vocab, size=sys_len).tolist(),
+                [r.randint(0, dec.vocab, size=user_len).tolist()
+                 for _ in range(turns)])
+
+    def chat_ttfts(eng, seed_off):
+        """Run one conversation; per-turn client-side TTFT. Each
+        turn's reply (handle.result = prompt + generated) becomes the
+        next turn's history, exactly the agent-chat traffic shape."""
+        sys_prompt, users = conversation(seed_off)
+        history = list(sys_prompt)
+        ttfts = []
+        for u in users:
+            prompt = history + u
+            t0 = time.monotonic()
+            handle = eng.submit(prompt, max_new)
+            stream = handle.stream(timeout=1800)
+            next(stream)
+            ttfts.append((time.monotonic() - t0) * 1000.0)
+            for _ in stream:
+                pass
+            history = handle.result(10)
+        return ttfts
+
+    out = {"workload": {"turns": turns, "system_prompt": sys_len,
+                        "user_msg": user_len, "max_new": max_new,
+                        "total_len": dec.max_len}}
+    for label, cache_on in (("cold", False), ("warm", True)):
+        eng = serving.DecodeEngine(dec, params, slots=2,
+                                   kv_block_size=16,
+                                   prefix_cache=cache_on)
+        try:
+            chat_ttfts(eng, seed_off=9)      # prewarm compiles only
+            ttfts = chat_ttfts(eng, seed_off=0)
+            load = eng.load_stats()
+            out[label] = {
+                "ttft_ms_per_turn": [round(t, 3) for t in ttfts],
+                "ttft_ms_turn2": round(ttfts[1], 3),
+                "ttft_ms_turns2plus_p50": round(
+                    metrics_report.median(ttfts[1:]), 3),
+                "prefix_hit_rate": load["prefix_hit_rate"],
+                "generated_prefix_hit_blocks":
+                    load["generated_prefix_hit_blocks"],
+                "generated_prefix_registered":
+                    load["generated_prefix_registered"]}
+        finally:
+            eng.stop()
+    if out["warm"]["ttft_ms_turn2"]:
+        out["ttft_speedup_turn2"] = round(
+            out["cold"]["ttft_ms_turn2"] / out["warm"]["ttft_ms_turn2"],
+            2)
+        out["ttft_speedup_turns2plus_p50"] = round(
+            out["cold"]["ttft_ms_turns2plus_p50"]
+            / out["warm"]["ttft_ms_turns2plus_p50"], 2)
+
+    # per-step decode time vs pool size at FIXED live tokens: 4 short
+    # sequences (16-token prompts, 32 new) decode on engines whose
+    # total_len — and default pool — scales 256 -> 1024. The fused
+    # kernel's per-step cost tracks the ~3 live blocks per row; the
+    # gather formulation re-materializes the total_len-long logical
+    # view every step, so its curve grows with the pool it pages.
+    curve = []
+    for total_len in (256, 512, 1024):
+        point = {"total_len": total_len,
+                 "kv_blocks": 4 * total_len // 16}
+        for impl in ("fused", "gather"):
+            eng = serving.DecodeEngine(
+                dec, params, slots=4, total_len=total_len,
+                kv_block_size=16, attn_impl=impl, prefix_cache=False)
+            try:
+                reqs = [(rng.randint(0, dec.vocab, size=16).tolist(),
+                         32) for _ in range(4)]
+                for h in [eng.submit(p, mn) for p, mn in reqs]:
+                    h.result(1800)
+                hist = eng.metrics.get_histogram(
+                    "tfos_serving_decode_step_seconds")
+                point["{}_step_ms_p50".format(impl)] = \
+                    metrics_report.quantiles_ms(hist)["p50_ms"]
+                # probe at the workload's live depth (48 tokens/row),
+                # not the default half-table, so the attn attribution
+                # describes the benched shapes
+                point["{}_attn_ms".format(impl)] = \
+                    eng.measure_attn(depth=48)
+            finally:
+                eng.stop()
+        curve.append(point)
+    out["decode_step_vs_pool"] = {
+        "workload": {"sequences": 4, "prompt_len": 16, "max_new": 32,
+                     "live_tokens_per_seq": 48},
+        "points": curve}
+    return out
+
+
 def _serving_decode_bench(on_tpu):
     """Mixed-length serving comparison: continuous-batching engine vs
     the run-to-completion window batcher, both from COLD jit caches (a
@@ -648,6 +788,9 @@ def _serving_decode_bench(on_tpu):
     # vs cold TTFT under shared-system-prompt traffic
     block["paged"] = _paged_capacity_leg(dec, params)
     block["prefix_reuse"] = _prefix_reuse_leg(on_tpu)
+    # PR 11 leg: multi-turn chat (generated-prefix reuse) + per-step
+    # decode time vs pool size for the fused vs gather formulations
+    block["multi_turn"] = _multi_turn_leg(on_tpu)
     return block
 
 

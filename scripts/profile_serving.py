@@ -15,6 +15,11 @@ attribution the engine's own tracing hooks collect:
                       admission (the TTFT attribution for warm hits)
 - ``block_alloc``   — paged KV: free-list allocation + LRU eviction at
                       admission and at decode-time block growth
+- ``attn``          — fused paged attention (PR 11): the engine's
+                      standalone attention probe at its live shapes
+                      (one layer per decode step; multiply by layers),
+                      so ``--attn-impl gather`` vs the fused default
+                      attributes the kernel-vs-gather delta per step
 
 plus the engine's counters (tokens/step = effective slot occupancy,
 prefills, steps), compile stats (programs vs buckets), the request-
@@ -27,7 +32,8 @@ Usage (CPU, hermetic):
 
     JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
     python scripts/profile_serving.py [--requests 32] [--slots 8] \
-        [--total-len 256] [--hidden 64] [--layers 2] [--seed 0] [--json]
+        [--total-len 256] [--hidden 64] [--layers 2] [--seed 0] \
+        [--attn-impl fused|gather] [--json]
 """
 
 import argparse
@@ -38,7 +44,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _run(dec, params, reqs, slots, label, out):
+def _run(dec, params, reqs, slots, label, out, **engine_kw):
     # bench.py's harness — ONE engine-measurement implementation, so
     # the profiler's stage attribution describes the benched run shape.
     # Latency quantiles arrive already read from the engine's
@@ -46,7 +52,7 @@ def _run(dec, params, reqs, slots, label, out):
     # — the same distributions GET /metrics exposes.
     from bench import _engine_leg
 
-    tps, lat, stats = _engine_leg(dec, params, reqs, slots)
+    tps, lat, stats = _engine_leg(dec, params, reqs, slots, **engine_kw)
     out[label] = dict(tokens_per_sec=round(tps, 1), **dict(lat, **stats))
 
 
@@ -59,6 +65,11 @@ def main(argv=None):
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--attn-impl", choices=("fused", "gather"),
+                    default=None,
+                    help="paged attention formulation (default: the "
+                         "engine's fused kernel; 'gather' runs the "
+                         "PR 8 reference for a per-stage comparison)")
     ap.add_argument("--json", action="store_true",
                     help="print one JSON blob instead of the table")
     args = ap.parse_args(argv)
@@ -90,9 +101,14 @@ def main(argv=None):
                       "total_len": args.total_len, "hidden": args.hidden,
                       "layers": args.layers,
                       "total_new_tokens": sum(mn for _, mn in reqs)}}
+    engine_kw = {}
+    if args.attn_impl is not None:
+        engine_kw["attn_impl"] = args.attn_impl
     jax.clear_caches()
-    _run(dec, params, reqs, args.slots, "cold", out)   # includes compiles
-    _run(dec, params, reqs, args.slots, "warm", out)   # steady state
+    _run(dec, params, reqs, args.slots, "cold", out,
+         **engine_kw)                                  # includes compiles
+    _run(dec, params, reqs, args.slots, "warm", out,
+         **engine_kw)                                  # steady state
 
     if args.json:
         print(json.dumps(out))
@@ -114,6 +130,7 @@ def main(argv=None):
             print("    {:<12} {}".format(key, r["hist"][key]))
         print("  compile: {}".format(r["compile"]))
         print("  lifecycle: {}".format(r["lifecycle"]))
+        print("  attn_impl: {}".format(r["attn_impl"]))
         if "kv" in r:
             print("  kv blocks: {}".format(r["kv"]))
 

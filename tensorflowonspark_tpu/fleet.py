@@ -509,6 +509,14 @@ class FleetRouter(object):
                 "queue_depth": gauges.get("queue_depth", 0),
                 "slot_occupancy": gauges.get("slot_occupancy", 0),
                 "queue_wait_ewma_s": gauges.get("queue_wait_ewma_s", 0.0),
+                # kernel config (PR 11): which attention formulation
+                # each replica runs, so a heterogeneous fleet (e.g. a
+                # staged fused-kernel rollout) is legible from the
+                # router's health view; plus the generated-prefix hit
+                # tally, the multi-turn-reuse signal
+                "attn_impl": gauges.get("attn_impl"),
+                "generated_prefix_hit_blocks": gauges.get(
+                    "generated_prefix_hit_blocks", 0),
                 "inflight": inflight.get(rid, 0),
                 "state": self.health.state(rid, now),
             })
@@ -755,6 +763,9 @@ class FleetRouter(object):
                     "alive": v["alive"], "draining": v["draining"],
                     "queue_depth": v["queue_depth"],
                     "slot_occupancy": v["slot_occupancy"],
+                    "attn_impl": v["attn_impl"],
+                    "generated_prefix_hit_blocks":
+                        v["generated_prefix_hit_blocks"],
                     "inflight": v["inflight"]} for v in views}}
         return (200 if order else 503), body
 

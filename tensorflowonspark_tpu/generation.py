@@ -348,7 +348,11 @@ def slot_step_fns(model, temperature=0.0, top_k=None, top_p=None):
 # The paged siblings of ``prefill_into_slot``/``decode_step`` above,
 # for models built with ``kv_block_size > 0`` (models/decoder.py): K/V
 # lives in a shared block pool and each slot reaches its sequence
-# through a block-table row. Because the POOL is batch-independent
+# through a block-table row. The model's ``attn_impl`` field selects
+# the attention formulation (fused block-table kernel vs PR 8's gather
+# reference — ops/paged_attention.py); since flax Modules hash by
+# their fields, ``paged_step_fns``'s lru_cache keys distinct programs
+# per formulation automatically. Because the POOL is batch-independent
 # (only tables and cursors are per-row), prefill needs no mini cache +
 # scatter-merge at all: a batch-1 apply with the slot's table row and a
 # start cursor writes the tail's K/V straight into the slot's blocks —

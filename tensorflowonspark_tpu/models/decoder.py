@@ -30,18 +30,29 @@ K/V in a shared BLOCK POOL ``[kv_blocks, kv_block_size, N, D]``
 instead of per-row contiguous ``[B, max_len, N, D]`` regions, plus a
 per-row ``block_table`` mapping logical block index -> pool row.
 Writes scatter through the table (position ``p`` lands in pool row
-``table[b, p // bs]`` at offset ``p % bs``); reads gather the row's
-blocks back into logical order and attend exactly as the contiguous
-path does — same shapes, same mask, same einsums — so paged outputs
-are bitwise-identical to contiguous ones whenever
-``kv_block_size * table_width == the contiguous cache length``
-(serving.DecodeEngine enforces this). Block allocation, sharing, and
-reclamation are HOST decisions (paging.BlockPool via the engine); the
-module just writes and gathers where the table says. The gather
-materializes the logical ``[B, L, N, D]`` view transiently during the
-step (the XLA formulation of paged attention — resident KV is the
-pool; a fused kernel that skips the materialization is a TPU follow-up
-noted in docs/serving.md).
+``table[b, p // bs]`` at offset ``p % bs``); attention then runs one
+of two formulations selected by ``attn_impl`` (PR 11, both in
+ops/paged_attention.py):
+
+- ``"fused"`` (the default) — paged attention consumes the pool and
+  the block table DIRECTLY: a Pallas kernel on TPU whose K/V index
+  maps read the table (per-step traffic scales with LIVE tokens), a
+  blockwise ``fori_loop`` online-softmax formulation elsewhere. No
+  transient ``[B, L, N, D]`` materialization.
+- ``"gather"`` — PR 8's XLA formulation, kept verbatim as the
+  reference oracle: gather the row's blocks back into logical order
+  and attend exactly as the contiguous path does (same shapes, same
+  mask, same einsums), so gather outputs are bitwise-identical to
+  contiguous ones whenever ``kv_block_size * table_width == the
+  contiguous cache length`` (serving.DecodeEngine enforces this).
+
+The two formulations compute the same visible set under the same
+scale; they differ only in float accumulation order (one softmax over
+the logical row vs the online recurrence), so the serving parity pin
+fused == gather == solo is TOKEN-level at temperature=0
+(tests/test_paged_kv.py). Block allocation, sharing, and reclamation
+are HOST decisions (paging.BlockPool via the engine); the module just
+writes and attends where the table says.
 """
 
 import functools
@@ -75,6 +86,10 @@ class CausalSelfAttention(nn.Module):
     #: pool rows when paged (INCLUDING the scratch block row 0 that
     #: absorbs pad-position writes — see paging.py)
     kv_blocks: int = 0
+    #: paged attention formulation (PR 11): "fused" consumes the block
+    #: table directly (Pallas on TPU, blockwise lax elsewhere);
+    #: "gather" materializes the logical view (PR 8's reference path)
+    attn_impl: str = "fused"
 
     @nn.compact
     def __call__(self, x):
@@ -136,13 +151,20 @@ class CausalSelfAttention(nn.Module):
             if is_initialized and paged:
                 # PAGED step/prefill, any s: write K/V for logical
                 # positions [idx, idx+s) through the block table, then
-                # gather each row's blocks back into logical order and
-                # attend exactly like the contiguous branches below —
-                # same [B, L] view, same mask, same einsums, so outputs
-                # are bitwise-identical whenever L matches the
-                # contiguous cache length (the engine sizes tables so
-                # it does). s==1 is a decode step; s>1 a fused
+                # attend through the table via ops/paged_attention.py —
+                # the fused formulation (default) streams the row's
+                # LIVE blocks through an online softmax; the gather
+                # formulation materializes the logical [B, L] view and
+                # attends exactly like the contiguous branches below
+                # (same mask, same einsums — the PR 8 reference
+                # oracle). s==1 is a decode step; s>1 a fused
                 # (possibly mid-sequence, prefix-cached) prefill.
+                if self.attn_impl not in ("fused", "gather"):
+                    raise ValueError(
+                        "attn_impl must be 'fused' or 'gather', got "
+                        "{!r}".format(self.attn_impl))
+                pa = importlib.import_module(
+                    "tensorflowonspark_tpu.ops.paged_attention")
                 idx = cache_index.value                    # [B]
                 table = block_table.value                  # [B, MB]
                 mb = table.shape[1]
@@ -162,19 +184,10 @@ class CausalSelfAttention(nn.Module):
                 cached_key.value = pk
                 cached_value.value = pv
                 cache_index.value = idx + s
-                L = mb * bs_blk
-                ck = pk[table].reshape((b, L) + k.shape[2:])
-                cv = pv[table].reshape((b, L) + v.shape[2:])
-                scale = head_dim ** -0.5
-                logits = jnp.einsum("bqnd,bknd->bnqk", q, ck,
-                                    preferred_element_type=jnp.float32)
-                logits = logits * scale
-                visible = (jnp.arange(L)[None, None, :]
-                           <= pos[:, :, None])             # [B, s, L]
-                logits = jnp.where(visible[:, None, :, :], logits,
-                                   jnp.finfo(jnp.float32).min)
-                probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
-                ctx = jnp.einsum("bnqk,bknd->bqnd", probs, cv)
+                ctx = pa.paged_attention(
+                    q, pk, pv, table, pos, scale=head_dim ** -0.5,
+                    impl=None if self.attn_impl == "fused"
+                    else "gather")
             elif is_initialized and s == 1:
                 # one token per step against the cache prefix
                 idx = cache_index.value
@@ -246,6 +259,7 @@ class DecoderBlock(nn.Module):
     decode: bool = False
     kv_block_size: int = 0
     kv_blocks: int = 0
+    attn_impl: str = "fused"
 
     @nn.compact
     def __call__(self, x):
@@ -253,6 +267,7 @@ class DecoderBlock(nn.Module):
         y = CausalSelfAttention(self.num_heads, decode=self.decode,
                                 kv_block_size=self.kv_block_size,
                                 kv_blocks=self.kv_blocks,
+                                attn_impl=self.attn_impl,
                                 name="attn")(y)
         x = x + y
         y = nn.LayerNorm(name="ln2")(x)
@@ -284,6 +299,11 @@ class DecoderLM(nn.Module):
     #: CausalSelfAttention and docs/serving.md.
     kv_block_size: int = 0
     kv_blocks: int = 0
+    #: paged attention formulation (PR 11): "fused" (block-table
+    #: kernel) or "gather" (PR 8's materialized-view reference);
+    #: ignored unless kv_block_size > 0. The engine's ``attn_impl``
+    #: knob clones the model with this set.
+    attn_impl: str = "fused"
 
     @nn.compact
     def __call__(self, tokens):
@@ -310,9 +330,19 @@ class DecoderLM(nn.Module):
                 pos_idx.value = pos_idx.value + s
             else:
                 # fused prefill: positions continue from each row's own
-                # cursor (see CausalSelfAttention's prefill branch)
+                # cursor (see CausalSelfAttention's prefill branch).
+                # mode="clip": bucket-pad rows can sit PAST max_len
+                # (paged prefill whose tail bucket overshoots the
+                # logical capacity), and jnp.take's default fill mode
+                # would hand them NaN embeddings — NaN K/V that, even
+                # written to the scratch block and fully masked, still
+                # poisons attention (0 * NaN = NaN in the probs @ V
+                # contraction). Clipped pad rows get a wrong-but-
+                # FINITE embedding; their K/V is invisible by the
+                # cursor discipline, which only zero-weights finite
+                # values.
                 pos = pos_idx.value[:, None] + jnp.arange(s)[None, :]
-                x = x + jnp.take(pos_embed, pos, axis=0)
+                x = x + jnp.take(pos_embed, pos, axis=0, mode="clip")
                 pos_idx.value = pos_idx.value + s
         else:
             x = x + pos_embed[:s][None]
@@ -322,6 +352,7 @@ class DecoderLM(nn.Module):
             x = DecoderBlock(self.num_heads, decode=self.decode,
                              kv_block_size=self.kv_block_size,
                              kv_blocks=self.kv_blocks,
+                             attn_impl=self.attn_impl,
                              name="block_%d" % i)(x)
         x = nn.LayerNorm(name="ln_f")(x)
         return nn.Dense(self.vocab, name="head")(x)

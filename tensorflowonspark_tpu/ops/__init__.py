@@ -7,3 +7,4 @@ both fallback (non-TPU platforms) and correctness oracles in tests.
 """
 
 from tensorflowonspark_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from tensorflowonspark_tpu.ops.paged_attention import paged_attention  # noqa: F401

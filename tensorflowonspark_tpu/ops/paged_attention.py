@@ -1,0 +1,289 @@
+"""Fused paged attention: attend through a block table, no gather.
+
+The device half of the paged KV cache (PR 8) stores K/V in a shared
+pool ``[pool_rows, block_size, heads, head_dim]`` per layer, with each
+batch row reaching its sequence through a ``block_table`` row. PR 8's
+attention was the XLA *gather* formulation: materialize the logical
+``[B, L, heads, dim]`` view (``pool[table]``) every step, then attend —
+resident memory is paged, but transient compute memory is not, so
+per-step bandwidth scales with the table width (max context), not with
+the tokens actually live.
+
+This module is the fused formulation (PR 11): attention consumes the
+pool and the block table DIRECTLY, streaming one block at a time
+through the online-softmax recurrence (the flash pattern,
+ops/flash_attention.py), and visiting only the blocks a row actually
+occupies — per-step traffic scales with LIVE tokens. Three
+implementations share one contract:
+
+- ``impl="pallas"`` — the TPU kernel. Grid ``(B * heads, table_width)``
+  under a ``PrefetchScalarGridSpec``: the block table rides scalar
+  prefetch and the K/V BlockSpec *index maps* read it, so the pipeline
+  DMAs exactly the pool block each grid step attends — paged
+  attention as an index-mapping problem, no gather materialization.
+  Dead table slots (past a row's live length) clamp their index map to
+  the row's last live block: consecutive equal indices make Pallas
+  skip the copy, so DMA traffic tracks live blocks, and a ``pl.when``
+  guard skips their compute.
+- ``impl="blockwise"`` — the same recurrence in pure ``lax`` for
+  non-TPU backends (CPU tier-1): ONE ``fori_loop`` with a *traced*
+  bound (the batch's deepest live block count) whose body visits one
+  block per row as a whole-batch gather + matmul; rows already past
+  their own depth are frozen by the mask (their update is an exact
+  no-op). Never materializes the logical view; per-step transient
+  work is O(B × max live blocks), not O(B × table width).
+- ``impl="gather"`` — PR 8's formulation, verbatim (moved here from
+  models/decoder.py so both paths live in one module). The reference
+  oracle the fused paths are pinned against, and the contrast curve
+  ``bench.py serving_decode.multi_turn`` publishes.
+
+Numerics: the gather path takes one softmax over the full logical row;
+the fused paths take the online (rescaled-accumulator) recurrence over
+the same visible set. Identical math, different float accumulation
+order — last-ulp differences, which is why the serving parity pins are
+TOKEN-level at temperature=0 (tests/test_paged_kv.py, same contract as
+the fused-prefill branch in models/decoder.py).
+
+Masking contract (identical across impls): query ``i`` of row ``b``
+sits at logical position ``pos[b, i]`` and attends every key position
+``<= pos[b, i]``. Callers write the step's K/V through the table
+BEFORE attending (models/decoder.py), so the current token sees
+itself. Layout: ``q [B, S_q, N, D]``, pools
+``[P, block_size, N, D]``, ``block_table [B, MB]`` int32,
+``pos [B, S_q]`` int32; returns ``[B, S_q, N, D]``.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _nblocks(pos, block_size, table_width):
+    """Blocks a row actually occupies: enough to cover its highest
+    visible position, clamped to the table (bucket-padded prefill rows
+    can carry ``pos`` past the logical capacity; the gather view ends
+    at the table too, so the clamp preserves parity)."""
+    return jnp.minimum((jnp.max(pos, axis=-1) + block_size)
+                       // block_size, table_width)
+
+
+def _gather(q, k_pool, v_pool, block_table, pos, scale):
+    """PR 8's XLA formulation, verbatim: materialize the logical
+    ``[B, L, N, D]`` view through the table, one softmax over it."""
+    b, s, n, d = q.shape
+    bs_blk = k_pool.shape[1]
+    mb = block_table.shape[1]
+    L = mb * bs_blk
+    ck = k_pool[block_table].reshape((b, L) + k_pool.shape[2:])
+    cv = v_pool[block_table].reshape((b, L) + v_pool.shape[2:])
+    logits = jnp.einsum("bqnd,bknd->bnqk", q, ck,
+                        preferred_element_type=jnp.float32)
+    logits = logits * scale
+    visible = (jnp.arange(L)[None, None, :]
+               <= pos[:, :, None])                   # [B, s, L]
+    logits = jnp.where(visible[:, None, :, :], logits,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
+    return jnp.einsum("bnqk,bknd->bqnd", probs, cv)
+
+
+#: table width at or below which the blockwise loop uses a STATIC
+#: trip count (visit every table slot, masked): XLA compiles a
+#: known-trip-count loop markedly faster than a dynamic-bound while,
+#: and at <= 8 blocks the masked extra iterations cost about what the
+#: bound bookkeeping would. Wider tables — where per-step work
+#: tracking LIVE blocks instead of table width is the whole point —
+#: take the traced bound. Trace-time dispatch: outputs are identical
+#: either way (a masked iteration is an exact no-op).
+_STATIC_TRIP_MAX_BLOCKS = 8
+
+
+def _blockwise(q, k_pool, v_pool, block_table, pos, scale):
+    """Online-softmax over each row's live blocks, pure ``lax``: the
+    CPU tier-1 formulation of the fused kernel (and the fallback for
+    any non-TPU backend). ONE ``fori_loop`` — iteration ``j`` gathers
+    block ``j`` of every row at once ([B, bs, N, D], a
+    live-block-sized transient) and folds it into the recurrence;
+    rows whose own depth is < j mask to -inf, which makes their
+    update an EXACT no-op (p = 0, correction = 1). The trip count is
+    the batch's deepest live block count (traced), so mixed-depth
+    batches cost the deepest row, never the table width — except on
+    narrow tables (see :data:`_STATIC_TRIP_MAX_BLOCKS`), where a
+    static count compiles faster and costs the same."""
+    b, s, n, d = q.shape
+    bs_blk = k_pool.shape[1]
+    mb = block_table.shape[1]
+    nblk = _nblocks(pos, bs_blk, mb)             # [B]
+
+    m0 = jnp.full((b, s, n), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, s, n), jnp.float32)
+    a0 = jnp.zeros((b, s, n, d), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        # clamp keeps the gather in-bounds for frozen rows; the
+        # (j < nblk) mask below is what actually freezes them
+        jj = jnp.minimum(j, nblk - 1)            # [B]
+        bid = jnp.take_along_axis(block_table, jj[:, None],
+                                  axis=1)[:, 0]  # [B]
+        kb = k_pool[bid]                         # [B, bs, N, D]
+        vb = v_pool[bid]
+        sc = jnp.einsum("bqnd,btnd->bqnt", q, kb,
+                        preferred_element_type=jnp.float32)
+        sc = sc * scale                          # [B, s, N, bs]
+        kpos = jj[:, None] * bs_blk + jnp.arange(bs_blk)[None, :]
+        vis = (kpos[:, None, :] <= pos[:, :, None]) \
+            & (j < nblk)[:, None, None]          # [B, s, bs]
+        sc = jnp.where(vis[:, :, None, :], sc, -jnp.inf)
+        m_blk = jnp.max(sc, axis=-1)             # [B, s, N]
+        m_new = jnp.maximum(m, m_blk)
+        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.where(jnp.isneginf(sc), 0.0,
+                      jnp.exp(sc - safe_m[..., None]))
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bqnt,btnd->bqnd", p.astype(vb.dtype), vb,
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    trips = mb if mb <= _STATIC_TRIP_MAX_BLOCKS else jnp.max(nblk)
+    m, l, acc = jax.lax.fori_loop(0, trips, body, (m0, l0, a0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l_safe[..., None]).astype(q.dtype)
+
+
+def _paged_kernel(table_ref, nblk_ref, q_ref, pos_ref, k_ref, v_ref,
+                  o_ref, acc_ref, m_ref, l_ref, *, scale, block_size,
+                  num_heads):
+    """One (batch*head, block_j) program: fold this block into the
+    online-softmax accumulators; emit on the last table slot. The K/V
+    BlockSpec index maps already routed the RIGHT pool block here (and
+    clamped dead slots to the last live block, skipping their copy), so
+    the kernel only guards compute."""
+    from jax.experimental import pallas as pl
+
+    bn = pl.program_id(0)
+    j = pl.program_id(1)
+    b = bn // num_heads
+    nblk = nblk_ref[b]
+    s_q = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j < nblk)
+    def _accumulate():
+        q = q_ref[0, :, 0, :].astype(jnp.float32)       # [s_q, D]
+        kb = k_ref[0, :, 0, :].astype(jnp.float32)      # [bs, D]
+        vb = v_ref[0, :, 0, :].astype(jnp.float32)
+        sc = jax.lax.dot_general(
+            q, kb, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [s_q, bs]
+        kpos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (s_q, block_size), 1)
+        vis = kpos <= pos_ref[0][:, None]
+        sc = jnp.where(vis, sc, -jnp.inf)
+        m = m_ref[0]
+        l = l_ref[0]
+        m_blk = jnp.max(sc, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.where(jnp.isneginf(sc), 0.0,
+                      jnp.exp(sc - safe_m[:, None]))
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        m_ref[0] = m_new
+        l_ref[0] = l * corr + jnp.sum(p, axis=-1)
+        acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
+            p, vb, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _emit():
+        l = l_ref[0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, :, 0, :] = (acc_ref[...] / l_safe[:, None]) \
+            .astype(o_ref.dtype)
+
+
+def _pallas(q, k_pool, v_pool, block_table, pos, scale, interpret):
+    """The TPU kernel: block table as scalar prefetch, K/V index maps
+    read it, dead slots clamp to the last live block (copy skipped)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s_q, n, d = q.shape
+    bs_blk = k_pool.shape[1]
+    mb = block_table.shape[1]
+    table = block_table.astype(jnp.int32)
+    nblk = _nblocks(pos.astype(jnp.int32), bs_blk, mb)      # [B]
+
+    def kv_index(bn, j, table_ref, nblk_ref):
+        row = bn // n
+        live = jnp.minimum(j, nblk_ref[row] - 1)
+        return (table_ref[row, live], 0, bn % n, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b * n, mb),
+        in_specs=[
+            pl.BlockSpec((1, s_q, 1, d),
+                         lambda bn, j, t, nb: (bn // n, 0, bn % n, 0)),
+            pl.BlockSpec((1, s_q),
+                         lambda bn, j, t, nb: (bn // n, 0)),
+            pl.BlockSpec((1, bs_blk, 1, d), kv_index),
+            pl.BlockSpec((1, bs_blk, 1, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, s_q, 1, d), lambda bn, j, t, nb: (bn // n, 0, bn % n, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((s_q, d), jnp.float32),   # acc
+            pltpu.VMEM((1, s_q), jnp.float32),   # running max
+            pltpu.VMEM((1, s_q), jnp.float32),   # running denominator
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, scale=scale,
+                               block_size=bs_blk, num_heads=n)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(table, nblk, q, pos.astype(jnp.int32), k_pool, v_pool)
+
+
+def paged_attention(q, k_pool, v_pool, block_table, pos, scale=None,
+                    impl=None, interpret=None, force_pallas=False):
+    """Attend ``q`` against paged K/V through ``block_table``.
+
+    ``pos [B, S_q]`` is each query's logical position (it sees key
+    positions ``<= pos``; the caller wrote this call's K/V through the
+    table already). ``impl``: None/"auto" picks the Pallas kernel on
+    TPU backends and the blockwise ``lax`` formulation elsewhere
+    (same allowlist policy as :func:`ops.flash_attention`);
+    "gather" is PR 8's materialize-the-view reference oracle;
+    "blockwise"/"pallas" force a specific fused formulation
+    (``interpret``/``force_pallas`` route the kernel through the
+    Pallas interpreter for CPU tests)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    pos = jnp.asarray(pos, jnp.int32)
+    block_table = jnp.asarray(block_table, jnp.int32)
+    if impl in (None, "auto"):
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+        impl = "pallas" if (on_tpu or force_pallas) else "blockwise"
+    if impl == "gather":
+        return _gather(q, k_pool, v_pool, block_table, pos, scale)
+    if impl == "blockwise":
+        return _blockwise(q, k_pool, v_pool, block_table, pos, scale)
+    if impl == "pallas":
+        if interpret is None:
+            interpret = jax.default_backend() not in ("tpu", "axon")
+        return _pallas(q, k_pool, v_pool, block_table, pos, scale,
+                       interpret)
+    raise ValueError(
+        "unknown paged-attention impl {!r}; expected one of "
+        "None/'auto', 'pallas', 'blockwise', 'gather'".format(impl))

@@ -10,17 +10,22 @@ module owns which block holds what:
   contiguous ``max_len`` region up front (the PagedAttention insight:
   KV fragmentation drops to at most one partial block per sequence, so
   memory — not compute — stops capping concurrency).
-- **ref-counted prefix sharing** — full blocks of a prompt are
+- **ref-counted prefix sharing** — full blocks of a sequence are
   registered under their token chain (the key for block ``j`` is the
-  EXACT token tuple ``prompt[:(j+1)*block_size]``, so a hit guarantees
-  the whole prefix matches — content-addressed, no hash collisions to
-  reason about). A later request whose prompt starts with the same
-  tokens points its block table at the shared blocks and prefills only
-  the tail. Shared blocks are read-only by construction: only COMPLETE
-  blocks are ever shared, and a sharer's write cursor starts at the
-  first position past them — so "copy-on-write on the first divergent
-  block" degenerates to allocating a fresh private block (there is
-  nothing to copy; divergent content simply prefills into it).
+  EXACT token tuple ``sequence[:(j+1)*block_size]``, so a hit
+  guarantees the whole prefix matches — content-addressed, no hash
+  collisions to reason about). A later request whose prompt starts
+  with the same tokens points its block table at the shared blocks and
+  prefills only the tail. Shared blocks are read-only by construction:
+  only COMPLETE blocks are ever shared, and a sharer's write cursor
+  starts at the first position past them — so "copy-on-write on the
+  first divergent block" degenerates to allocating a fresh private
+  block (there is nothing to copy; divergent content simply prefills
+  into it). Registrations carry an ``origin`` ("prompt" at admission,
+  "generated" when the engine publishes a block DECODE filled — PR
+  11), so multi-turn reuse — a follow-up turn whose prompt IS the
+  prior turn's prompt + reply — is separately countable from repeated
+  system prompts.
 - **LRU retention** — a released block that is registered in the prefix
   cache is RETAINED (refcount 0, evictable) rather than freed, so the
   next same-prefix request still hits; under allocation pressure the
@@ -78,12 +83,18 @@ class BlockPool(object):
         self._ref = {}                # id -> refcount (> 0: live)
         self._by_key = {}             # token-chain key -> block id
         self._key_of = {}             # block id -> its registered key
+        self._origin = {}             # block id -> "prompt"/"generated"
         # refcount-0 blocks still registered in the prefix cache, in
         # least-recently-released-first order (the eviction order)
         self._lru = collections.OrderedDict()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # generated-prefix accounting (PR 11): registrations of
+        # decode-filled blocks, and the subset of hits that landed on
+        # one — the multi-turn reuse signal load_stats surfaces
+        self.generated_registered = 0
+        self.generated_hits = 0
         # mutation epoch: bumped by every state change that could alter
         # an admission verdict (alloc/release/acquire/register/
         # drop_cache). The engine's blocked-head memo keys on it — a
@@ -123,6 +134,8 @@ class BlockPool(object):
                 "misses": self.misses,
                 "evictions": self.evictions,
                 "hit_rate": (self.hits / lookups) if lookups else 0.0,
+                "generated_registered": self.generated_registered,
+                "generated_hits": self.generated_hits,
             }
 
     def epoch(self):
@@ -168,15 +181,24 @@ class BlockPool(object):
             ids.append(bid)
         return ids, shareable
 
-    def match_prefix(self, tokens):
+    def match_prefix(self, tokens, count_generated=True):
         """Resident shared-prefix block ids for ``tokens``, in chain
         order. Does NOT take references — call :meth:`acquire` before
-        using them. Tallies hits/misses."""
+        using them. Tallies hits/misses; generated-origin hits tally
+        separately unless ``count_generated=False`` — the engine
+        passes False for a preemption continuation's re-admission,
+        whose walk lands back on the blocks the SAME request
+        registered before being preempted (counting those would read
+        as multi-turn reuse during a pure pool-pressure storm)."""
         tokens = list(tokens)
         with self._lock:
             ids, shareable = self._walk_locked(tokens)
             self.hits += len(ids)
             self.misses += shareable - len(ids)
+            if count_generated:
+                self.generated_hits += sum(
+                    1 for bid in ids
+                    if self._origin.get(bid) == "generated")
         return ids
 
     def plan(self, tokens):
@@ -194,12 +216,15 @@ class BlockPool(object):
             lru_resident = sum(1 for bid in ids if bid in self._lru)
         return ids, self.blocks_for(len(tokens)) - len(ids), lru_resident
 
-    def register(self, tokens, n_tokens, block_id):
+    def register(self, tokens, n_tokens, block_id, origin="prompt"):
         """Publish ``block_id`` as holding the K/V of the FULL block
         ending at ``n_tokens`` (``tokens[:n_tokens]`` is its chain
         key; ``n_tokens`` must be a block multiple). First writer
         wins: if the chain is already registered to another block the
-        existing entry stands and this one stays private."""
+        existing entry stands and this one stays private. ``origin``
+        ("prompt" / "generated") tags where the block's content came
+        from — the engine registers decode-filled blocks as
+        "generated" so multi-turn reuse is separately countable."""
         if n_tokens % self.block_size:
             raise ValueError(
                 "register at {} tokens: not a multiple of block_size {}"
@@ -214,6 +239,9 @@ class BlockPool(object):
                     "register of unreferenced block {}".format(bid))
             self._by_key[key] = bid
             self._key_of[bid] = key
+            self._origin[bid] = str(origin)
+            if origin == "generated":
+                self.generated_registered += 1
             self._epoch += 1
 
     def drop_cache(self):
@@ -229,6 +257,7 @@ class BlockPool(object):
                 self._lru.pop(bid)
                 key = self._key_of.pop(bid)
                 self._by_key.pop(key)
+                self._origin.pop(bid, None)
                 self._free.append(bid)
             return len(dropped)
 
@@ -268,6 +297,7 @@ class BlockPool(object):
                 bid, _ = self._lru.popitem(last=False)  # oldest first
                 key = self._key_of.pop(bid)
                 self._by_key.pop(key)
+                self._origin.pop(bid, None)
                 self.evictions += 1
                 ids.append(bid)
             for bid in ids:

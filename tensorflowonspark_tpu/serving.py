@@ -392,13 +392,28 @@ class DecodeEngine(object):
         same KV budget (admission gates on block availability, and a
         sequence outgrowing the pool preempts the youngest admission,
         which resumes seamlessly when blocks free).
-      prefix_cache: share resident prompt-prefix blocks across
-        requests (paged only; default True). Full blocks of every
-        prompt are registered under their exact token chain; a request
+      prefix_cache: share resident prefix blocks across requests
+        (paged only; default True). Full blocks of every prompt are
+        registered under their exact token chain at admission, and
+        full blocks DECODE fills are registered as the sequence grows
+        (PR 11: generated-prefix registration) — so a multi-turn
+        conversation's follow-up turn, whose prompt IS the prior
+        prompt + reply, admits by pointing at the whole resident
+        history and prefills only the new user message. A request
         whose prefix is resident admits by pointing its block table at
         the shared ref-counted blocks and prefills only the tail.
         Released registered blocks are RETAINED (LRU-evicted under
-        pressure), so repeat system prompts keep hitting.
+        pressure), so repeat system prompts — and conversation
+        histories — keep hitting.
+      attn_impl: paged attention formulation (PR 11; paged only).
+        None (the default) selects ``"fused"`` — attention consumes
+        the block table directly (Pallas kernel on TPU, blockwise
+        ``lax`` elsewhere; per-step bandwidth scales with LIVE tokens,
+        not table width). ``"gather"`` keeps PR 8's materialize-the-
+        logical-view formulation as the reference oracle; the two are
+        pinned token-identical at temperature=0. Surfaced through
+        ``load_stats()`` / ``/healthz`` / the fleet BEAT payload so
+        routers can tell kernel configs apart across a fleet.
 
     Request lifecycle (PR 4): ``submit(..., deadline_s=T)`` attaches a
     completion deadline. Admission SHEDS the request
@@ -420,7 +435,7 @@ class DecodeEngine(object):
                  eos_token=None, rng=None, counters=None, timers=None,
                  max_queue=1024, metrics=None, flight=None,
                  replica_id=None, kv_block_size=None, kv_blocks=None,
-                 prefix_cache=True):
+                 prefix_cache=True, attn_impl=None):
         import jax
 
         from tensorflowonspark_tpu import generation
@@ -442,7 +457,7 @@ class DecodeEngine(object):
             top_p=top_p, eos_token=eos_token, rng=rng,
             max_queue=max_queue, replica_id=self.replica_id,
             kv_block_size=kv_block_size, kv_blocks=kv_blocks,
-            prefix_cache=prefix_cache)
+            prefix_cache=prefix_cache, attn_impl=attn_impl)
         self._generation = generation
         total_len = int(total_len or model.max_len)
         if total_len > model.max_len:
@@ -543,11 +558,24 @@ class DecodeEngine(object):
                 raise ValueError("kv_blocks must be >= 1, got {}".format(
                     self.kv_blocks))
             self.prefix_cache = bool(prefix_cache)
+            # attention formulation (PR 11): fused by default — the
+            # block-table kernel whose per-step bandwidth scales with
+            # live tokens; "gather" keeps PR 8's materialized-view
+            # code as the reference oracle (pinned token-identical)
+            if attn_impl is None:
+                attn_impl = "fused"
+            if attn_impl not in ("fused", "gather"):
+                raise ValueError(
+                    "attn_impl must be 'fused' or 'gather', got "
+                    "{!r}".format(attn_impl))
+            self.attn_impl = attn_impl
             self._pool = paging.BlockPool(self.kv_blocks,
                                           self.kv_block_size)
             self._last_prefix_evictions = 0
             self._last_prefix_hits = 0
             self._last_prefix_misses = 0
+            self._last_generated_registered = 0
+            self._last_generated_hits = 0
             #: (head handle, available) when the queue head last failed
             #: the block gate — skips re-planning it until the pool
             #: changes (see the admission scan)
@@ -558,11 +586,12 @@ class DecodeEngine(object):
                 # land in). Params are layout-identical — only the
                 # cache collection's structure changes.
                 model = model.clone(kv_block_size=self.kv_block_size,
-                                    kv_blocks=self.kv_blocks + 1)
+                                    kv_blocks=self.kv_blocks + 1,
+                                    attn_impl=self.attn_impl)
             except TypeError:
                 raise ValueError(
                     "model {} does not support paged KV (no "
-                    "kv_block_size/kv_blocks fields); pass "
+                    "kv_block_size/kv_blocks/attn_impl fields); pass "
                     "kv_block_size=0 for the contiguous cache".format(
                         type(model).__name__))
             self._model = model
@@ -572,8 +601,12 @@ class DecodeEngine(object):
             if kv_blocks is not None:
                 raise ValueError(
                     "kv_blocks needs a paged engine (kv_block_size>0)")
+            if attn_impl is not None:
+                raise ValueError(
+                    "attn_impl needs a paged engine (kv_block_size>0)")
             self.kv_blocks = 0
             self.prefix_cache = False
+            self.attn_impl = "contiguous"
             self._pool = None
             self._model = model
             self._prefill_fn, self._decode_fn = generation.slot_step_fns(
@@ -610,6 +643,12 @@ class DecodeEngine(object):
                 (self.slots, self._blocks_per_slot), np.int32)
             self._admit_seq = itertools.count()
             self._slot_seq = [0] * self.slots
+            # generated-prefix registration cursor (PR 11): how many
+            # leading FULL blocks of each slot's sequence have been
+            # published to the prefix registry — admission seeds it,
+            # boundary crossings and completion advance it
+            self._slot_registered = [0] * self.slots
+            self._attn_probe = None  # measure_attn's cached jit
         self._cache = generation.init_cache(model, self.slots, total_len)
         self._publish_kv_gauges()
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -869,20 +908,28 @@ class DecodeEngine(object):
                  if qwait is not None else 0.0,
                  "alive": health["alive"],
                  "draining": health["draining"]}
-        # block-pool view (PR 8): rides the fleet BEAT payload and
-        # /healthz so routers and operators see memory headroom, not
-        # just slot occupancy (a paged engine can be slot-free but
-        # block-bound, or the reverse). Contiguous engines report the
-        # zero schema so consumers need no presence checks.
+        # block-pool view (PR 8) + kernel config (PR 11): rides the
+        # fleet BEAT payload and /healthz so routers and operators see
+        # memory headroom and which attention formulation serves each
+        # replica, not just slot occupancy (a paged engine can be
+        # slot-free but block-bound, or the reverse). Contiguous
+        # engines report the zero schema (attn_impl "contiguous") so
+        # consumers need no presence checks.
+        stats["attn_impl"] = self.attn_impl
         if self._paged:
             ps = self._pool.stats()
             stats["kv_blocks_total"] = ps["total"]
             stats["kv_blocks_free"] = ps["free"]
             stats["prefix_hit_rate"] = round(ps["hit_rate"], 4)
+            stats["generated_prefix_hit_blocks"] = ps["generated_hits"]
+            stats["generated_prefix_registered"] = \
+                ps["generated_registered"]
         else:
             stats["kv_blocks_total"] = 0
             stats["kv_blocks_free"] = 0
             stats["prefix_hit_rate"] = 0.0
+            stats["generated_prefix_hit_blocks"] = 0
+            stats["generated_prefix_registered"] = 0
         return stats
 
     def kv_cache_bytes(self):
@@ -899,6 +946,70 @@ class DecodeEngine(object):
                     "cached_key", "cached_value"):
                 total += leaf.size * leaf.dtype.itemsize
         return total
+
+    def measure_attn(self, reps=3, depth=None):
+        """Time ONE decode-shaped call of this engine's attention
+        formulation (fused kernel or gather reference) at its pool
+        shapes with every slot ``depth`` tokens deep (default
+        ``total_len // 2``), and record the samples as the ``attn``
+        stage in ``self.timers`` — so the bench and profile stage
+        tables can attribute the kernel-vs-gather delta per step
+        through the same ``metrics_report`` helpers as every other
+        stage.
+
+        This is a standalone probe, not an in-jit split: the decode
+        step is one compiled program and XLA exposes no per-op timing,
+        so the honest attribution is to run the step's attention op by
+        itself (one layer's worth — multiply by ``num_layers`` for the
+        per-step total). ``depth`` is SYNTHETIC and stated rather than
+        read from the live cursors: an idle engine's released slots
+        park at cursor 0, which would time the fused path at its
+        1-block floor while the gather path still pays full table
+        width — a systematically skewed comparison. Pass the
+        workload's live depth for workload-matched numbers. The
+        compile is excluded (one unmeasured warm-up call). Returns
+        mean ms per call, or None on a contiguous engine (its
+        attention is not a paged op). Call while the engine is idle
+        — it reads the live pool leaves."""
+        if not self._paged:
+            return None
+        import importlib
+
+        import jax
+        import jax.numpy as jnp
+
+        pa = importlib.import_module(
+            "tensorflowonspark_tpu.ops.paged_attention")
+        kp = vp = None
+        for path, leaf in jax.tree_util.tree_leaves_with_path(
+                self._cache):
+            name = self._generation._leaf_name(path)
+            if name == "cached_key" and kp is None:
+                kp = leaf
+            elif name == "cached_value" and vp is None:
+                vp = leaf
+        n, d = kp.shape[2], kp.shape[3]
+        depth = int(depth) if depth is not None else self.total_len // 2
+        depth = max(1, min(depth, self.total_len))
+        q = jnp.zeros((self.slots, 1, n, d), kp.dtype)
+        # synthetic-but-valid block mapping: each slot's table cycles
+        # the real pool rows (1..kv_blocks), every slot at ``depth``
+        bps = self._blocks_per_slot
+        tables = (np.arange(self.slots)[:, None] * bps
+                  + np.arange(bps)[None, :]) % self.kv_blocks + 1
+        tables = jnp.asarray(tables, jnp.int32)
+        pos = jnp.full((self.slots, 1), depth - 1, jnp.int32)
+        if self._attn_probe is None:
+            impl = "gather" if self.attn_impl == "gather" else None
+            self._attn_probe = jax.jit(
+                lambda q, k, v, t, p: pa.paged_attention(
+                    q, k, v, t, p, impl=impl))
+        self._attn_probe(q, kp, vp, tables, pos).block_until_ready()
+        for _ in range(max(1, int(reps))):
+            with self.timers.timed("attn"):
+                self._attn_probe(q, kp, vp, tables,
+                                 pos).block_until_ready()
+        return self.timers.per_ms().get("attn")
 
     def outstanding(self):
         """Queued + in-flight request count (the number drain waits on)."""
@@ -1277,7 +1388,13 @@ class DecodeEngine(object):
                 ("prefix_hit_blocks", stats["hits"],
                  "_last_prefix_hits"),
                 ("prefix_miss_blocks", stats["misses"],
-                 "_last_prefix_misses")):
+                 "_last_prefix_misses"),
+                ("generated_prefix_registered",
+                 stats["generated_registered"],
+                 "_last_generated_registered"),
+                ("generated_prefix_hit_blocks",
+                 stats["generated_hits"],
+                 "_last_generated_hits")):
             delta = tally - getattr(self, attr)
             if delta > 0:
                 self.counters.inc(counter, delta)
@@ -1297,6 +1414,36 @@ class DecodeEngine(object):
             self._slot_blocks[slot] = []
         self._tables[slot][:] = 0
         self._idx[slot] = 0
+        self._slot_registered[slot] = 0
+        self._publish_kv_gauges()
+
+    def _register_generated(self, slot, handle):
+        """Publish every not-yet-registered FULL block of ``slot``'s
+        sequence into the prefix registry — the generated-prefix half
+        of PR 11: a block DECODE filled (cursor crossed its end) holds
+        the K/V of ``(prompt + emitted)[:block_end]``, exactly the
+        chain a follow-up conversation turn's prompt starts with.
+        Called at block-boundary crossings (_grow_active_blocks) and
+        at completion (_deliver) — together those cover every fill,
+        since admission registers the prompt's own full blocks.
+        Origin-tagged so multi-turn reuse is countable apart from
+        repeated system prompts. Scheduler thread only; must run while
+        the slot still holds its block references (before release)."""
+        if not self.prefix_cache:
+            return
+        bs = self.kv_block_size
+        full = min(int(self._idx[slot]) // bs,
+                   len(self._slot_blocks[slot]))
+        if full <= self._slot_registered[slot]:
+            return
+        chain = handle.prompt + handle._tokens
+        n_prompt = len(handle.prompt)
+        for j in range(self._slot_registered[slot], full):
+            end = (j + 1) * bs
+            self._pool.register(
+                chain, end, self._slot_blocks[slot][j],
+                origin="prompt" if end <= n_prompt else "generated")
+        self._slot_registered[slot] = full
         self._publish_kv_gauges()
 
     def _preempt(self, slot):
@@ -1337,6 +1484,11 @@ class DecodeEngine(object):
             bi = int(self._idx[s]) // bs
             if bi < len(self._slot_blocks[s]):
                 continue
+            # the crossing means every block before ``bi`` is fully
+            # written: publish the newly-completed one(s) into the
+            # prefix registry (generated-prefix registration, PR 11)
+            # while the slot still references them
+            self._register_generated(s, self._slot_req[s])
             while True:
                 try:
                     with self.timers.timed("block_alloc"):
@@ -1368,7 +1520,12 @@ class DecodeEngine(object):
         shared = []
         if self.prefix_cache:
             with self.timers.timed("prefix_lookup"):
-                shared = self._pool.match_prefix(full)
+                # a preemption continuation (the handle already
+                # decoded) re-walks onto its OWN registered blocks:
+                # real prefill savings, but not multi-turn reuse —
+                # keep it out of the generated-hit signal
+                shared = self._pool.match_prefix(
+                    full, count_generated=handle._decode_t0 is None)
             # hit/miss counters roll from the pool's own tallies in
             # _publish_kv_gauges — one formula, no desync
         start = len(shared) * bs
@@ -1423,12 +1580,22 @@ class DecodeEngine(object):
         handle._decode_t0 = t1
         self.counters.inc("prefills")
         if self.prefix_cache:
-            # publish every FULL prompt block (now holding valid K/V)
-            # under its token-chain key; re-registration of shared
-            # blocks is a no-op, and a losing racer of two identical
-            # cold prompts just keeps its blocks private
+            # publish every FULL block of the admitted sequence (now
+            # holding valid K/V) under its token-chain key;
+            # re-registration of shared blocks is a no-op, and a
+            # losing racer of two identical cold prompts just keeps
+            # its blocks private. Blocks past the ORIGINAL prompt
+            # exist only on preemption re-entry (``full`` includes
+            # emitted tokens there) — tag those "generated"
             for j in range(n // bs):
-                self._pool.register(full, (j + 1) * bs, ids[j])
+                end = (j + 1) * bs
+                self._pool.register(
+                    full, end, ids[j],
+                    origin="prompt" if end <= len(handle.prompt)
+                    else "generated")
+            self._slot_registered[slot] = n // bs
+        else:
+            self._slot_registered[slot] = 0
         self._publish_kv_gauges()
         self._idx[slot] = n
         self._last[slot] = first
@@ -1489,6 +1656,11 @@ class DecodeEngine(object):
         done = (self.eos_token is not None and token == self.eos_token) \
             or len(handle._tokens) >= handle.max_new_tokens
         if done:
+            if self._paged:
+                # a sequence can finish with its last decode-filled
+                # block complete but never crossing another boundary —
+                # publish it before the slot releases its references
+                self._register_generated(slot, handle)
             handle._finish()
             self._slot_req[slot] = None
             self._release_slot(slot)
@@ -2010,7 +2182,9 @@ class ModelServer(object):
             if callable(load_stats):
                 load = load_stats()
                 for key in ("kv_blocks_total", "kv_blocks_free",
-                            "prefix_hit_rate"):
+                            "prefix_hit_rate", "attn_impl",
+                            "generated_prefix_hit_blocks",
+                            "generated_prefix_registered"):
                     body[key] = load[key]
             if self._draining:
                 # draining outranks the liveness checks below: mid-
@@ -2055,15 +2229,25 @@ class ModelServer(object):
         registry = getattr(engine, "metrics", None)
         text = tracing.MetricsRegistry().render() if registry is None \
             else registry.render()
+        info = ""
         rid = self.replica_id
         if rid is not None:
             # info-pattern gauge: a constant-1 sample whose label IS the
             # payload, so every scraped tfos_serving_* series from this
             # replica joins to its stable identity (group_left in
             # PromQL) without re-labeling the whole exposition
-            info = ('# TYPE tfos_serving_replica_info gauge\n'
-                    'tfos_serving_replica_info{{replica_id="{}"}} 1\n'
-                    .format(rid))
+            info += ('# TYPE tfos_serving_replica_info gauge\n'
+                     'tfos_serving_replica_info{{replica_id="{}"}} 1\n'
+                     .format(rid))
+        impl = getattr(engine, "attn_impl", None)
+        if impl is not None:
+            # same info pattern for the attention formulation (PR 11):
+            # which kernel serves this replica, joinable against its
+            # latency series during a fused-kernel rollout
+            info += ('# TYPE tfos_serving_attn_impl gauge\n'
+                     'tfos_serving_attn_impl{{impl="{}"}} 1\n'
+                     .format(impl))
+        if info:
             text = text.replace("# EOF\n", info + "# EOF\n")
         return text
 

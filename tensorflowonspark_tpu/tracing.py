@@ -302,6 +302,22 @@ METRIC_FAMILIES = {
         ("counter", "", "in-flight requests preempted (blocks freed, "
                         "requeued for continuation) under pool "
                         "exhaustion"),
+    # -- fused paged attention + generated-prefix registration (PR 11) --
+    "tfos_serving_attn_impl":
+        ("gauge", "impl", "constant 1 carrying the engine's attention "
+                          "formulation (fused / gather / contiguous) — "
+                          "info-pattern join key for kernel-config "
+                          "rollouts across a fleet"),
+    "tfos_serving_generated_prefix_registered":
+        ("counter", "", "decode-GENERATED full blocks published into "
+                        "the prefix registry (multi-turn conversation "
+                        "reuse; prompt-block registrations excluded)"),
+    "tfos_serving_generated_prefix_hit_blocks":
+        ("counter", "", "prefix-cache block hits that landed on a "
+                        "decode-generated registration (subset of "
+                        "tfos_serving_prefix_hit_blocks; preemption "
+                        "continuations re-hitting their own blocks "
+                        "excluded)"),
     "tfos_serving_queue_depth":
         ("gauge", "", "requests waiting for a slot"),
     "tfos_serving_slot_occupancy":

@@ -1,0 +1,152 @@
+"""Fused paged-attention op invariants (PR 11).
+
+The op-level half of the fused-kernel contract (the engine-level
+token pins live in tests/test_paged_kv.py): the blockwise ``lax``
+formulation and the Pallas kernel (interpreter mode — the tier-1
+path; real Mosaic compile is the TPU-marked test at the bottom) must
+match the gather reference to float accumulation noise on every query
+shape the engine produces (decode s=1, fused prefill s>1, ragged
+per-row positions, bucket-padded rows whose positions overshoot the
+logical capacity) — and, the bandwidth claim itself, must provably
+never READ a block outside a row's live set: pool rows no live block
+maps to are poisoned with NaN and the fused outputs must not change.
+(The gather reference deliberately fails that poison test — it reads
+everything and masks, which is the formulation this kernel exists to
+replace.)
+"""
+
+import importlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pa = importlib.import_module(
+    "tensorflowonspark_tpu.ops.paged_attention")
+
+
+def _case(seed, b=3, s_q=1, n=4, d=16, pool=11, bs=8, mb=4):
+    """Random pools + per-row tables and positions; every row's table
+    entries are distinct allocated rows (no scratch aliasing) so the
+    live-set accounting in the poison test is exact."""
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(b, s_q, n, d), jnp.float32)
+    kp = jnp.asarray(rng.randn(pool, bs, n, d), jnp.float32)
+    vp = jnp.asarray(rng.randn(pool, bs, n, d), jnp.float32)
+    table = np.stack([rng.choice(np.arange(1, pool), size=mb,
+                                 replace=False) for _ in range(b)])
+    # each row at its own depth; positions cover first/mid/last block
+    base = rng.randint(0, mb * bs - s_q, size=b)
+    pos = base[:, None] + np.arange(s_q)[None, :]
+    return q, kp, vp, jnp.asarray(table, jnp.int32), \
+        jnp.asarray(pos, jnp.int32)
+
+
+@pytest.mark.parametrize("s_q", [1, 8])
+def test_blockwise_matches_gather_reference(s_q):
+    for seed in range(3):
+        q, kp, vp, table, pos = _case(seed, s_q=s_q)
+        ref = pa.paged_attention(q, kp, vp, table, pos, impl="gather")
+        blk = pa.paged_attention(q, kp, vp, table, pos,
+                                 impl="blockwise")
+        np.testing.assert_allclose(np.asarray(blk), np.asarray(ref),
+                                   atol=2e-6, rtol=2e-6)
+
+
+@pytest.mark.parametrize("s_q", [1, 8])
+def test_pallas_interpret_matches_gather_reference(s_q):
+    q, kp, vp, table, pos = _case(7, s_q=s_q)
+    ref = pa.paged_attention(q, kp, vp, table, pos, impl="gather")
+    pal = pa.paged_attention(q, kp, vp, table, pos, impl="pallas",
+                             interpret=True)
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+
+
+def test_overshooting_pad_rows_match_reference():
+    """Bucket-padded prefill rows carry positions PAST the logical
+    capacity (their writes went to scratch); the fused formulations
+    must clamp to the table width exactly like the gather view does —
+    same (garbage, discarded) outputs for pad rows, same (real)
+    outputs for live rows."""
+    q, kp, vp, table, pos = _case(11, s_q=8, mb=3)
+    pos = pos.at[2].set(20 + jnp.arange(8))  # rows 20..27 > L-1 = 23
+    ref = pa.paged_attention(q, kp, vp, table, pos, impl="gather")
+    blk = pa.paged_attention(q, kp, vp, table, pos, impl="blockwise")
+    pal = pa.paged_attention(q, kp, vp, table, pos, impl="pallas",
+                             interpret=True)
+    np.testing.assert_allclose(np.asarray(blk), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+
+
+@pytest.mark.parametrize("impl", ["blockwise", "pallas"])
+def test_fused_never_reads_dead_blocks(impl):
+    """THE bandwidth claim, falsifiably: poison every pool row outside
+    the rows' live block sets with NaN — one read of a dead block
+    would turn the whole output NaN (0 * NaN is NaN, so even a fully
+    masked read poisons). Fused outputs must be bitwise-unchanged.
+    The gather reference reads everything and masks, so it cannot
+    pass this — which is exactly the transient-traffic difference the
+    fused kernel exists for."""
+    q, kp, vp, table, pos = _case(3)
+    bs = kp.shape[1]
+    kw = {"interpret": True} if impl == "pallas" else {}
+    clean = pa.paged_attention(q, kp, vp, table, pos, impl=impl, **kw)
+    live = set()
+    for bi in range(q.shape[0]):
+        nblk = (int(np.max(np.asarray(pos)[bi])) + bs) // bs
+        live |= set(int(x) for x in np.asarray(table)[bi, :nblk])
+    kpo = np.asarray(kp).copy()
+    vpo = np.asarray(vp).copy()
+    for row in range(kp.shape[0]):
+        if row not in live:
+            kpo[row] = np.nan
+            vpo[row] = np.nan
+    assert len(live) < kp.shape[0], "case must leave dead rows"
+    out = pa.paged_attention(q, jnp.asarray(kpo), jnp.asarray(vpo),
+                             table, pos, impl=impl, **kw)
+    assert bool(jnp.all(jnp.isfinite(out)))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(clean))
+
+
+def test_auto_dispatch_and_bad_impl():
+    """Off-TPU the auto path IS the blockwise formulation (bitwise);
+    unknown impls fail loudly."""
+    q, kp, vp, table, pos = _case(5)
+    auto = pa.paged_attention(q, kp, vp, table, pos)
+    blk = pa.paged_attention(q, kp, vp, table, pos, impl="blockwise")
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(blk))
+    with pytest.raises(ValueError, match="impl"):
+        pa.paged_attention(q, kp, vp, table, pos, impl="banana")
+
+
+def test_jit_and_traced_operands():
+    """The engine calls the op inside jitted step fns with traced
+    tables/positions — pin that the blockwise formulation (a
+    fori_loop whose trip count is traced on wide tables) traces and
+    compiles clean."""
+    q, kp, vp, table, pos = _case(6, s_q=1)
+    fn = jax.jit(lambda *a: pa.paged_attention(*a, impl="blockwise"))
+    ref = pa.paged_attention(q, kp, vp, table, pos, impl="gather")
+    np.testing.assert_allclose(np.asarray(fn(q, kp, vp, table, pos)),
+                               np.asarray(ref), atol=2e-6, rtol=2e-6)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(jax.default_backend() not in ("tpu", "axon"),
+                    reason="real Mosaic compile needs a TPU backend "
+                           "(tier-1 covers the kernel via interpret "
+                           "mode; see make onchip)")
+def test_pallas_tpu_compiles_and_matches():
+    """On-chip record: the kernel must compile on real Mosaic and
+    match the gather reference there too (the interpreter validates
+    logic, not Mosaic lowering)."""
+    q, kp, vp, table, pos = _case(8, s_q=1)
+    ref = pa.paged_attention(q, kp, vp, table, pos, impl="gather")
+    pal = pa.paged_attention(q, kp, vp, table, pos, impl="pallas",
+                             interpret=False)
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                               atol=5e-6, rtol=5e-6)

@@ -346,6 +346,157 @@ def test_healthz_and_load_stats_carry_block_pool(lm):
         server.engine = None  # the engine is this test's to stop
 
 
+# -- fused paged-attention kernel + attn_impl knob (PR 11) --------------
+
+
+def test_fused_equals_gather_equals_solo_under_pressure(lm):
+    """THE PR 11 parity pin: the same workload — mixed lengths, a
+    shared prefix (prefix-cached admissions), and a pool small enough
+    to force preemption-continuation — through a FUSED engine and a
+    GATHER engine emits exactly the tokens solo ``generate`` does at
+    temperature=0. The two formulations differ only in float
+    accumulation order, so the token streams must be identical."""
+    dec, params = lm
+    rng = np.random.RandomState(21)
+    shared = rng.randint(0, V, size=16).tolist()  # 2 full 8-blocks
+    reqs = [(shared + rng.randint(0, V, size=3).tolist(), 13),
+            (rng.randint(0, V, size=9).tolist(), 16),
+            (shared + rng.randint(0, V, size=5).tolist(), 11),
+            (rng.randint(0, V, size=5).tolist(), 10)]
+    want = [_solo(dec, params, p, mn) for p, mn in reqs]
+    got = {}
+    for impl in ("fused", "gather"):
+        # 5 blocks cannot hold two grown sequences: preemption fires
+        # (the same engine config as the preemption-continuation test,
+        # so the fused leg reuses its compiled programs)
+        with serving.DecodeEngine(dec, params, slots=2, kv_block_size=8,
+                                  kv_blocks=5, attn_impl=impl) as eng:
+            assert eng.attn_impl == impl
+            assert eng.load_stats()["attn_impl"] == impl
+            got[impl] = [h.result(300) for h in
+                         [eng.submit(p, mn) for p, mn in reqs]]
+            counts = _counts(eng)
+        assert counts.get("prefix_hit_blocks", 0) >= 2, impl
+    assert got["fused"] == want
+    assert got["gather"] == want
+
+
+def test_scratch_isolation_through_fused_path(lm):
+    """Bucket-padded prefill pad writes can never corrupt a visible
+    offset through the fused path: a warm-prefix admission whose tail
+    bucket OVERSHOOTS the logical capacity (start 16 + bucket 64 > L
+    64 routes 16 pad writes to the scratch block) runs while a
+    neighbor decodes — both outputs must stay bitwise-solo."""
+    dec, params = lm
+    rng = np.random.RandomState(22)
+    pre = rng.randint(0, V, size=16).tolist()
+    warm_p = pre + rng.randint(0, V, size=33).tolist()  # 49 tokens
+    other_p = rng.randint(0, V, size=7).tolist()
+    want_warm = _solo(dec, params, warm_p, 6)
+    want_other = _solo(dec, params, other_p, 22)
+    with serving.DecodeEngine(dec, params, slots=2,
+                              kv_block_size=8) as eng:
+        # register the 2-block prefix (17 tokens -> blocks at 8, 16)
+        eng.submit(pre + [1], 2).result(300)
+        other = eng.submit(other_p, 22)
+        deadline = time.monotonic() + 60
+        while not other.generated:  # neighbor is mid-decode
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        warm = eng.submit(warm_p, 6)
+        assert warm.result(300) == want_warm
+        assert other.result(300) == want_other
+        # the admission really was warm (tail-only prefill)
+        assert _counts(eng).get("prefix_hit_blocks", 0) >= 2
+
+
+def test_generated_prefix_multi_turn_bitwise_and_counters(lm):
+    """Generated-prefix registration (PR 11): a follow-up turn whose
+    prompt is the prior turn's prompt + reply admits against the
+    RESIDENT history — bitwise-identical to solo, with the decode-
+    filled block provably registered and hit. Full blocks only: 23
+    written tokens of turn 1 register exactly 2 blocks (one prompt-
+    origin, one generated)."""
+    dec, params = lm
+    rng = np.random.RandomState(23)
+    p1 = rng.randint(0, V, size=11).tolist()
+    with serving.DecodeEngine(dec, params, slots=2,
+                              kv_block_size=8) as eng:
+        t1 = eng.submit(p1, 13).result(300)  # 24 tokens, 23 written
+        stats = eng._pool.stats()
+        # blocks at 8 (prompt) and 16 (contains generated content);
+        # the partial tail block (16..23) must NOT be registered
+        assert stats["generated_registered"] == 1
+        p2 = t1 + [3]
+        want = _solo(dec, params, p2, 5)
+        assert eng.submit(p2, 5).result(300) == want
+        counts = _counts(eng)
+        assert counts.get("generated_prefix_hit_blocks", 0) == 1
+        assert counts.get("prefix_hit_blocks", 0) == 2
+        load = eng.load_stats()
+        assert load["generated_prefix_hit_blocks"] == 1
+        assert load["generated_prefix_registered"] >= 1
+        # LRU interaction: the registered history is retention (cache),
+        # not leak — flushing it fills the literal free list
+        assert eng._pool.live_refs() == {}
+        eng._pool.drop_cache()
+        stats = eng._pool.stats()
+        assert stats["cached"] == 0 and stats["free"] == stats["total"]
+
+
+def test_generated_registration_gated_by_prefix_cache(lm):
+    dec, params = lm
+    with serving.DecodeEngine(dec, params, slots=2, kv_block_size=8,
+                              prefix_cache=False) as eng:
+        eng.submit(list(range(1, 12)), 13).result(300)
+        assert eng._pool.stats()["generated_registered"] == 0
+        assert eng.load_stats()["generated_prefix_registered"] == 0
+
+
+def test_attn_impl_knob_validation_and_schema(lm):
+    """The knob's contract: paged engines accept fused/gather and
+    reject junk; contiguous engines reject the knob and report the
+    'contiguous' schema; /healthz and /metrics carry the config."""
+    dec, params = lm
+    with pytest.raises(ValueError, match="attn_impl"):
+        serving.DecodeEngine(dec, params, slots=2, attn_impl="banana")
+    with pytest.raises(ValueError, match="paged"):
+        serving.DecodeEngine(dec, params, slots=2, kv_block_size=0,
+                             attn_impl="fused")
+    with serving.DecodeEngine(dec, params, slots=1,
+                              kv_block_size=0) as eng:
+        assert eng.load_stats()["attn_impl"] == "contiguous"
+        assert eng.measure_attn() is None
+    with serving.DecodeEngine(dec, params, slots=2) as eng:
+        assert eng.attn_impl == "fused"  # the paged default
+        server = serving.ModelServer(None, engine=eng, name="m")
+        code, body = server.healthz()
+        assert code == 200 and body["attn_impl"] == "fused"
+        assert body["generated_prefix_hit_blocks"] == 0
+        text = server.metrics_text()
+        assert 'tfos_serving_attn_impl{impl="fused"} 1' in text
+        # the attn stage probe records through the shared timers
+        assert eng.measure_attn() is not None
+        assert "attn" in eng.timers.per_ms()
+        server.engine = None  # the engine is this test's to stop
+
+
+def test_respawn_preserves_attn_impl(lm):
+    dec, params = lm
+    eng = serving.DecodeEngine(dec, params, slots=1,
+                               attn_impl="gather")
+    try:
+        eng.stop()
+        fresh = eng.respawn()
+        try:
+            assert fresh.attn_impl == "gather"
+            assert fresh.load_stats()["attn_impl"] == "gather"
+        finally:
+            fresh.stop()
+    finally:
+        eng.stop()
+
+
 @pytest.mark.chaos
 @pytest.mark.slow
 def test_leak_churn_cancel_disconnect_evict_drain(lm):

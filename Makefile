@@ -64,17 +64,26 @@ test-fast:
 # tier-1 (`-m "not slow"`) never runs these under concurrent load —
 # the VERDICT-r5 flake regime.
 CHAOS_TEST_TIMEOUT ?= 300
+# The suite runs CHAOS_REPS times (PR 12): fault schedules are
+# deterministic (fixed netchaos seeds in the specs, -p no:randomly for
+# collection order), so a pass that only holds under one lucky timing
+# interleaving fails here instead of on a user. CHAOS_REPS=1 for a
+# quick local run.
+CHAOS_REPS ?= 3
 chaos:
 	@set -e; \
 	tests=$$($(PYTHON) -m pytest tests/ -q -m chaos --collect-only \
 	  -p no:randomly 2>/dev/null | grep '::' || true); \
 	test -n "$$tests" || { echo "no chaos tests collected"; exit 1; }; \
-	for t in $$tests; do \
-	  echo "== chaos: $$t"; \
-	  timeout -k 30 $(CHAOS_TEST_TIMEOUT) \
-	    $(PYTHON) -m pytest "$$t" -q -p no:randomly || exit 1; \
+	for rep in $$(seq 1 $(CHAOS_REPS)); do \
+	  echo "== chaos pass $$rep/$(CHAOS_REPS)"; \
+	  for t in $$tests; do \
+	    echo "== chaos: $$t"; \
+	    timeout -k 30 $(CHAOS_TEST_TIMEOUT) \
+	      $(PYTHON) -m pytest "$$t" -q -p no:randomly || exit 1; \
+	  done; \
 	done; \
-	echo "chaos suite: all tests passed"
+	echo "chaos suite: all tests passed ($(CHAOS_REPS) passes)"
 
 # one-line JSON benchmark (real chip when present; CPU smoke elsewhere)
 bench:

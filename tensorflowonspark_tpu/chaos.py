@@ -57,6 +57,47 @@ Injection points (each checked at an instrumented framework site):
 - ``corrupt_checkpoint=N`` — after ``Checkpointer.save`` commits step N,
   garble every file of that step on disk (fired by checkpoint.py); the
   restore-with-fallback path is the recovery under test.
+
+Network fault plane (PR 12 — fired at the transport sites
+``fleet._http_request`` wraps around every router<->replica exchange and
+``reservation.MessageSocket.send`` wraps around every reservation
+message, via :func:`on_net`). Process faults kill things; these break
+the WIRES between healthy processes, which is where ambiguous timeouts
+— "did the request execute before the response was lost?" — come from.
+Endpoint scoping uses ``SRC:DST`` pairs (either side ``*``): the router
+dispatches as ``router:<replica_id>``, a replica/executor beats as
+``<id>:reservation``. Sites that pass no identity at all match only
+fully-wildcarded (``*:*`` / unscoped) injections.
+
+- ``net_drop=P[,only=SRC:DST][,seed=N][,for=T]`` — each matching
+  exchange independently fails with probability P (a seeded
+  ``random.Random(seed)`` draw — the k-th matching exchange consumes
+  the k-th draw, so a given seed yields the same drop schedule every
+  run; ``P=1`` is the deterministic always-drop). The failure is
+  :class:`NetPartitioned` (a ``ConnectionError``): the caller cannot
+  tell whether the peer saw the request — exactly the ambiguity
+  idempotent dispatch exists for.
+- ``net_delay=T[,only=SRC:DST][,for=W]`` — every matching exchange is
+  delayed T seconds before it starts: the gray-replica signature
+  (alive, beating, SLOW) hedged requests exist for.
+- ``net_dup=P[,only=SRC:DST][,seed=N][,for=T]`` — each matching
+  exchange is DUPLICATED with seeded probability P: the transport
+  delivers the same request twice (the duplicate's response is
+  discarded). The replica-side dedup window is the behavior under
+  test — without it a duplicated ``:generate`` decodes twice. HTTP
+  transport only: ``MessageSocket`` is a framed request/response TCP
+  stream, where the transport cannot duplicate a frame (and injecting
+  one would desynchronize the protocol, not model a network fault) —
+  that site ignores the dup action.
+- ``net_partition=SRC:DST,for=T`` — from the first matched exchange,
+  the SRC->DST link is DOWN for T seconds (every matching exchange
+  raises :class:`NetPartitioned`); after T the partition HEALS and the
+  injection is spent — re-arm for another flap. ``for=`` is mandatory:
+  a partition that never heals is just a drop, and the heal is the
+  moment split-brain fencing and retry dedup get tested. The OPENING
+  exchange (in flight when the link died) loses only its RESPONSE on
+  transports that can tell the difference — the request was delivered
+  and executed, the caller just never learns it (see :func:`on_net`).
 - ``drop_executor_then_return_after=T`` — EXECUTOR loss, not trainer
   crash: at the scoped trainer's first :func:`on_step` site, SIGKILL
   the whole executor process (the trainer's parent) and then this
@@ -95,6 +136,7 @@ nothing is armed, so instrumented sites cost nothing in production.
 
 import logging
 import os
+import random
 import signal
 import threading
 import time
@@ -103,12 +145,16 @@ logger = logging.getLogger(__name__)
 
 ENV_VAR = "TFOS_CHAOS"
 
+#: transport-level points (the network fault plane, PR 12)
+NET_POINTS = ("net_drop", "net_delay", "net_dup", "net_partition")
+
 #: spec keys that accept the generic grammar above
 POINTS = ("kill_trainer_at_step", "kill_trainer_at_batch",
           "kill_trainer_when_queued", "stall_consumer_for",
           "stall_ring_slot", "drop_heartbeats_for", "corrupt_checkpoint",
           "kill_scheduler_at_step", "stall_decode_for",
-          "disconnect_client_at_token", "drop_executor_then_return_after")
+          "disconnect_client_at_token", "drop_executor_then_return_after"
+          ) + NET_POINTS
 
 
 class SchedulerKilled(RuntimeError):
@@ -118,18 +164,80 @@ class SchedulerKilled(RuntimeError):
     same way a real device error does)."""
 
 
+class NetPartitioned(ConnectionError):
+    """A net_drop / net_partition injection ate this transport
+    exchange. Deliberately a ``ConnectionError``: every caller's
+    existing connection-failure handling (beat retry, router failover,
+    lease expiry) must treat an injected network fault EXACTLY like a
+    real one — no chaos-aware special cases to go stale in."""
+
+
 class Injection(object):
     """One armed injection point."""
 
-    __slots__ = ("point", "value", "only", "fuse", "fired", "started")
+    __slots__ = ("point", "value", "only", "fuse", "fired", "started",
+                 "window", "seed", "endpoints", "_rng")
 
-    def __init__(self, point, value, only=None, fuse=None):
+    def __init__(self, point, value, only=None, fuse=None, window=None,
+                 seed=None, endpoints=None):
         self.point = point
         self.value = value
         self.only = only
         self.fuse = fuse
         self.fired = False
         self.started = None  # for duration-window points
+        #: ``for=T`` — seconds the effect lasts from its first matched
+        #: check; None = no window (single-shot points keep their own
+        #: semantics, net drop/delay/dup apply until disarm)
+        self.window = window
+        #: ``seed=N`` — the probability schedule's RNG seed (net_drop /
+        #: net_dup); a fixed seed makes the k-th matching exchange's
+        #: draw identical across runs
+        self.seed = seed
+        #: (src, dst) endpoint pattern for net points (either may be
+        #: ``"*"``); None matches every instrumented site
+        self.endpoints = endpoints
+        self._rng = None
+
+    @property
+    def rng(self):
+        """Seeded per-injection RNG (lazily built): the deterministic
+        draw schedule behind probabilistic net points."""
+        if self._rng is None:
+            self._rng = random.Random(0 if self.seed is None
+                                      else self.seed)
+        return self._rng
+
+    def matches_net(self, src, dst):
+        """Endpoint scoping for transport sites: ``only=SRC:DST`` (or
+        net_partition's value) against the site's identities. A site
+        that passes None for a side only matches ``*`` on that side —
+        an unlabeled transport can never be caught by a scoped spec."""
+        if self.endpoints is None:
+            return True
+        esrc, edst = self.endpoints
+        src_ok = esrc == "*" or (src is not None and str(src) == esrc)
+        dst_ok = edst == "*" or (dst is not None and str(dst) == edst)
+        return src_ok and dst_ok
+
+    def in_window(self):
+        """True while inside the ``[first match, +for)`` effect window
+        (no ``for=`` means always, once matched). The window opens at
+        the FIRST matched check and the injection is marked spent at
+        expiry — how ``net_partition`` heals deterministically."""
+        if self.window is None:
+            return True
+        now = time.monotonic()
+        if self.started is None:
+            self.started = now
+            logger.warning("CHAOS %s window open for %gs", self.point,
+                           self.window)
+        if now - self.started < self.window:
+            return True
+        if not self.fired:
+            self.mark_fired()
+            logger.warning("CHAOS %s window expired (healed)", self.point)
+        return False
 
     def ready(self, ident=None):
         """Armed, not yet fired, fuse intact, and scoped to this process
@@ -186,13 +294,17 @@ def parse_spec(spec):
                              % (point, ", ".join(POINTS)))
         if point == "stall_ring_slot":  # alias
             point = "stall_consumer_for"
-        only = fuse = None
+        only = fuse = window = seed = endpoints = None
         for field in fields[1:]:
             if "=" not in field:
                 raise ValueError("chaos field %r needs key=value" % field)
             k, v = field.split("=", 1)
             k = k.strip()
             if k == "only":
+                if point in NET_POINTS:
+                    # net scoping is an endpoint pair, not a process id
+                    endpoints = _parse_endpoints(point, v)
+                    continue
                 # numeric executor ids stay ints (the TFOS_TRAINER_
                 # EXECUTOR_ID scoping); anything else is a replica
                 # ident matched against the site's caller-supplied id
@@ -202,8 +314,40 @@ def parse_spec(spec):
                     only = v.strip()
             elif k == "fuse":
                 fuse = v
+            elif k == "for":
+                try:
+                    window = float(v)
+                except ValueError:
+                    raise ValueError(
+                        "chaos field for=%r must be seconds" % v)
+            elif k == "seed":
+                try:
+                    seed = int(v)
+                except ValueError:
+                    raise ValueError(
+                        "chaos field seed=%r must be an integer" % v)
             else:
                 raise ValueError("unknown chaos field %r" % k)
+        if point == "net_partition":
+            # the VALUE is the partitioned link (src:dst); for= is the
+            # outage duration and is mandatory — a partition that never
+            # heals is just net_drop, and the HEAL is the moment the
+            # fencing/dedup behavior under test actually runs
+            endpoints = _parse_endpoints(point, value)
+            if window is None:
+                raise ValueError(
+                    "net_partition requires for=T (the heal time); "
+                    "use net_drop for a permanent fault")
+            value = "0"
+        if point in NET_POINTS:
+            out[point] = Injection(point, float(value), only=only,
+                                   fuse=fuse, window=window, seed=seed,
+                                   endpoints=endpoints)
+            continue
+        if window is not None or seed is not None:
+            raise ValueError(
+                "chaos fields for=/seed= only apply to net points "
+                "({}), not {}".format(", ".join(NET_POINTS), point))
         if point == "drop_executor_then_return_after" and not fuse:
             # the fuse is load-bearing here, not just single-shot
             # bookkeeping: the spec rides executor_env into every
@@ -217,6 +361,16 @@ def parse_spec(spec):
                 "scheduler needs)")
         out[point] = Injection(point, float(value), only=only, fuse=fuse)
     return out
+
+
+def _parse_endpoints(point, raw):
+    """``SRC:DST`` -> (src, dst); either side may be ``*``."""
+    parts = str(raw).strip().split(":")
+    if len(parts) != 2 or not parts[0] or not parts[1]:
+        raise ValueError(
+            "{} endpoints must be SRC:DST (either side may be '*'), "
+            "got {!r}".format(point, raw))
+    return parts[0], parts[1]
 
 
 def arm(spec):
@@ -396,6 +550,78 @@ def on_heartbeat():
         return True
     inj.mark_fired()
     return False
+
+
+def on_net(src=None, dst=None, response_capable=False):
+    """Transport-exchange site (the network fault plane). Called once
+    per exchange by the instrumented transports — ``fleet.
+    _http_request`` (router<->replica HTTP) and ``reservation.
+    MessageSocket.send`` (reservation messages, beats included) — with
+    the exchange's endpoint identities.
+
+    Effects, in precedence order: an ACTIVE ``net_partition`` window or
+    a ``net_drop`` draw loses the exchange; ``net_delay`` sleeps before
+    the exchange runs; ``net_dup`` returns ``"dup"``, telling the
+    transport to deliver the exchange TWICE (the caller discards the
+    duplicate's response). Returns None when nothing fires. O(1) dict
+    lookups when no net point is armed.
+
+    A LOST exchange has two faces, and the difference is the whole
+    point of idempotent dispatch: request-side loss (the peer never saw
+    it) raises :class:`NetPartitioned` before any bytes move;
+    response-side loss — the peer EXECUTED the request, only the answer
+    died on the wire — returns ``"drop_response"``, telling a
+    ``response_capable`` transport to run the exchange, discard the
+    response, and raise. Sites that can't separate the two (a one-way
+    message send) pass ``response_capable=False`` and get request-side
+    loss only. Deterministic choreography: a ``net_partition``'s
+    OPENING exchange is response-side (it was in flight when the link
+    died — the classic ambiguous timeout), the rest of the window is
+    request-side (the link is known down); ``net_drop`` draws the side
+    from the same seeded RNG as the drop itself (50/50), so a fixed
+    seed fixes the whole schedule."""
+    cur = _current()
+    inj = cur.get("net_partition")
+    if inj is not None and not inj.fired and inj.matches_net(src, dst):
+        opening = inj.started is None
+        if inj.in_window():
+            if opening and response_capable:
+                logger.warning(
+                    "CHAOS net_partition: %s -> %s opening exchange "
+                    "loses its RESPONSE (request delivered)", src, dst)
+                return "drop_response"
+            raise NetPartitioned(
+                "chaos net_partition: {} -> {} is partitioned".format(
+                    src, dst))
+    inj = cur.get("net_drop")
+    if inj is not None and not inj.fired and inj.matches_net(src, dst) \
+            and inj.in_window() and inj.rng.random() < inj.value:
+        if response_capable and inj.rng.random() < 0.5:
+            logger.warning("CHAOS net_drop: %s -> %s loses its "
+                           "RESPONSE (request delivered)", src, dst)
+            return "drop_response"
+        logger.warning("CHAOS net_drop: dropping %s -> %s exchange",
+                       src, dst)
+        raise NetPartitioned(
+            "chaos net_drop: {} -> {} exchange lost".format(src, dst))
+    inj = cur.get("net_delay")
+    if inj is not None and not inj.fired and inj.matches_net(src, dst) \
+            and inj.in_window():
+        time.sleep(inj.value)
+    inj = cur.get("net_dup")
+    if inj is not None and not inj.fired and inj.matches_net(src, dst) \
+            and inj.in_window() and inj.rng.random() < inj.value:
+        logger.warning("CHAOS net_dup: duplicating %s -> %s exchange",
+                       src, dst)
+        return "dup"
+    return None
+
+
+def net_armed():
+    """True when any net point is armed (transports use this to skip
+    per-exchange bookkeeping entirely in production)."""
+    cur = _current()
+    return any(p in cur for p in NET_POINTS)
 
 
 def on_checkpoint_saved(step, directory, wait=None):

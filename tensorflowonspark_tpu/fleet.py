@@ -56,14 +56,20 @@ import logging
 import socket
 import threading
 import time
+import uuid
 
-from tensorflowonspark_tpu import reservation, serving, tracing
+from tensorflowonspark_tpu import chaos, reservation, serving, tracing
 
 logger = logging.getLogger(__name__)
 
 #: lease age (seconds) past which a replica's gauges are too stale to
 #: route on — the router's default; a beat interval fits ~8x inside it
 DEFAULT_STALE_AFTER = 2.0
+
+#: default TCP connect bound for upstream exchanges (seconds): a
+#: black-holed SYN (partitioned replica) must fail over in this long,
+#: not the full read timeout a long generation legitimately needs
+DEFAULT_CONNECT_TIMEOUT = 5.0
 
 
 class NoReplicaAvailable(serving.Retriable):
@@ -175,15 +181,29 @@ class ReplicaHealth(object):
                 return self.UP
             return self.DOWN if now < rec["down_until"] else self.PROBE
 
-    def note_success(self, rid):
+    def note_success(self, rid, now=None):
         """A request (or probe) against ``rid`` succeeded: full reset —
         consecutive-failure count, down state, AND the cooldown
         escalation (a replica that proved itself healthy starts its
-        next incident from the base cooldown)."""
+        next incident from the base cooldown).
+
+        EXCEPT during an active cooldown (now < down_until): a success
+        landing there is STALE evidence — a long request admitted
+        before the replica went down, completing after (nothing is
+        routed to a DOWN replica, so no fresh evidence can exist).
+        Honoring it would re-open a just-downed replica and let one
+        straggler completion defeat the geometric escalation a
+        flapping replica earns; recovery from DOWN goes through the
+        half-open probe, never through leftovers."""
         with self._lock:
             rec = self._r.get(str(rid))
-            if rec is not None and not rec["quiesced"]:
-                rec.update(fails=0, downs=0, down_until=None)
+            if rec is None or rec["quiesced"]:
+                return
+            if rec["down_until"] is not None:
+                now = now if now is not None else time.monotonic()
+                if now < rec["down_until"]:
+                    return
+            rec.update(fails=0, downs=0, down_until=None)
 
     def note_failure(self, rid, now, reason=""):
         """A request (or probe) against ``rid`` failed for a
@@ -268,6 +288,15 @@ class Replica(object):
                 "engine (its replica_id is the default) or pass "
                 "ModelServer(replica_id=...)")
         self.addr = None
+        #: lease fencing (PR 12): the epoch minted by the reservation
+        #: server for THIS incarnation of the identity; every beat
+        #: carries it. None until the first successful lease call.
+        self.epoch = None
+        #: set once a beat came back FENCED (another holder registered
+        #: for this identity — typically a replacement spawned while
+        #: this replica was partitioned away): beating stops and the
+        #: server refuses to serve until :meth:`re_register`
+        self.fenced = False
         self._client = None
         self._stop = threading.Event()
         self._thread = None
@@ -309,7 +338,28 @@ class Replica(object):
                 if self._client is None:
                     self._client = reservation.Client(
                         self.reservation_addr)
-                self._client.beat(self.replica_id, self._payload())
+                if self.epoch is None:
+                    # acquire the fencing epoch before the first beat
+                    # (and after any reconnect that lost it); the
+                    # epoch belongs to the IDENTITY's incarnation, not
+                    # the TCP connection, so a mere reconnect reuses it
+                    self.epoch = self._client.lease(self.replica_id)
+                self._client.beat(self.replica_id, self._payload(),
+                                  epoch=self.epoch)
+            except reservation.Fenced as e:
+                # NON-retriable by design: someone else holds a newer
+                # epoch for this identity. Serving on would be the
+                # split-brain double-serve this plane exists to close —
+                # stop beating, refuse requests, await re_register()
+                logger.error(
+                    "replica %s FENCED (stale epoch %s): %s — serving "
+                    "refused until re_register()",
+                    self.replica_id, self.epoch, e)
+                self.fenced = True
+                self.server.fence(
+                    "lease epoch {} superseded by {}".format(
+                        self.epoch, e.epoch))
+                return
             except Exception as e:  # noqa: BLE001 - beats must survive
                 logger.warning("replica %s beat failed: %s",
                                self.replica_id, e)
@@ -320,6 +370,25 @@ class Replica(object):
                         pass
                     self._client = None
             self._stop.wait(self.beat_interval)
+
+    def re_register(self):
+        """Deliberately rejoin the fleet after being fenced: mint a
+        FRESH lease epoch (superseding whoever fenced us — the caller
+        asserts this replica is the one that should serve), clear the
+        server's fenced latch, and restart the beat loop. The operator/
+        supervisor decision the ``Fenced`` taxonomy demands — never an
+        automatic retry."""
+        self.epoch = None  # re-acquired by the loop's lease call
+        self.fenced = False
+        self.server.unfence()
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._beat_loop, daemon=True,
+                name="tfos-fleet-beat-{}".format(self.replica_id))
+            self._thread.start()
+        logger.info("replica %s re-registering (fresh lease epoch)",
+                    self.replica_id)
 
     def stop(self):
         self._stop.set()
@@ -344,8 +413,16 @@ class _ClientGone(RuntimeError):
     turn a vanished client back into a slot decoding to max_new."""
 
 
+class _HedgeLost(RuntimeError):
+    """Internal to hedged dispatch: this attempt was aborted because
+    its rival already produced the winning response (or the hedge had
+    no alternative replica to go to). Never surfaces to clients and
+    never counts as a disconnect or a failover."""
+
+
 def _http_request(addr, method, path, body=None, timeout=600.0,
-                  abort=None, extra_headers=None):
+                  abort=None, extra_headers=None, connect_timeout=None,
+                  net_src=None, net_dst=None):
     """One plain HTTP exchange -> (status, raw body bytes, headers).
 
     ``abort`` (zero-arg callable): polled while the exchange runs;
@@ -354,12 +431,69 @@ def _http_request(addr, method, path, body=None, timeout=600.0,
     for a directly-connected client — and :class:`_ClientGone` is
     raised. Without ``abort`` the exchange is a plain blocking call.
     ``extra_headers``: request headers to add (the trace-propagation
-    ``X-TFOS-Trace`` rides this)."""
-    conn = http.client.HTTPConnection(addr[0], int(addr[1]),
-                                      timeout=timeout)
+    ``X-TFOS-Trace`` rides this).
+
+    Timeouts are SPLIT: ``connect_timeout`` bounds the TCP connect
+    (default: min(``timeout``, 5s)) while ``timeout`` bounds the
+    response read. One shared number was wrong in both directions — a
+    black-holed SYN against a partitioned replica deserves seconds
+    before failover, a long generation legitimately needs minutes of
+    read patience, and a single knob can't say both.
+
+    ``net_src``/``net_dst`` label the exchange for the chaos network
+    fault plane (``chaos.on_net``): a drop/partition injection raises
+    ``chaos.NetPartitioned`` (an OSError — the caller's existing
+    unreachable-replica handling fires), ``net_delay`` stalls the
+    exchange, and ``net_dup`` delivers the request a second time (the
+    duplicate's response is discarded — the replica-side dedup window
+    is what makes it harmless)."""
+    if connect_timeout is None:
+        connect_timeout = min(float(timeout), DEFAULT_CONNECT_TIMEOUT)
+    action = None
+    if chaos.net_armed():
+        # request-side loss raises NetPartitioned here, before any
+        # bytes move; "drop_response" means the peer EXECUTES the
+        # request and only the answer is lost — the ambiguous-timeout
+        # shape idempotent dispatch exists to absorb
+        action = chaos.on_net(net_src, net_dst, response_capable=True)
     headers = {"Content-Type": "application/json"} if body else {}
     if extra_headers:
         headers.update(extra_headers)
+    out = _http_exchange(addr, method, path, body, headers, timeout,
+                         connect_timeout, abort)
+    if action == "drop_response":
+        # the exchange ran to completion on the peer; its response
+        # dies here. The caller sees the same ConnectionError a real
+        # mid-exchange partition yields — it CANNOT know the work
+        # happened, and must rely on the idempotency key when it
+        # retries
+        raise chaos.NetPartitioned(
+            "chaos: response from {} lost after the request was "
+            "delivered and executed".format(net_dst))
+    if action == "dup":
+        # duplicate delivery (net_dup): the transport hands the peer
+        # the SAME request again — sequentially, so tests observe a
+        # deterministic order — and discards the second response
+        try:
+            _http_exchange(addr, method, path, body, headers, timeout,
+                           connect_timeout, None)
+        except (OSError, http.client.HTTPException):
+            pass
+    return out
+
+
+def _http_exchange(addr, method, path, body, headers, timeout,
+                   connect_timeout, abort):
+    conn = http.client.HTTPConnection(addr[0], int(addr[1]),
+                                      timeout=connect_timeout)
+    # connect under the CONNECT bound, then widen the socket deadline
+    # to the read timeout for the exchange itself
+    try:
+        conn.connect()
+        conn.sock.settimeout(float(timeout))
+    except BaseException:
+        conn.close()
+        raise
     if abort is None:
         try:
             conn.request(method, path, body=body, headers=headers)
@@ -439,16 +573,37 @@ class FleetRouter(object):
                  stale_after=DEFAULT_STALE_AFTER, attempts=4,
                  fail_threshold=2, cooldown=1.0, max_cooldown=30.0,
                  probe_interval=0.25, upstream_timeout=600.0,
-                 base_delay=0.05, max_delay=2.0):
+                 connect_timeout=DEFAULT_CONNECT_TIMEOUT,
+                 base_delay=0.05, max_delay=2.0,
+                 hedge_quantile=None, hedge_min_delay=0.05,
+                 hedge_min_samples=20):
         self.reservation = reservation_server
         self.name = name
         self.replicas = list(replicas or [])
         self.stale_after = float(stale_after)
         self.attempts = int(attempts)
         self.upstream_timeout = float(upstream_timeout)
+        #: TCP connect bound, split from the read timeout: a
+        #: partitioned replica's black-holed SYN fails over in seconds
+        self.connect_timeout = float(connect_timeout)
         self.base_delay = float(base_delay)
         self.max_delay = float(max_delay)
         self.probe_interval = float(probe_interval)
+        #: hedged requests (PR 12): once an attempt has run longer than
+        #: this quantile of the router's OWN upstream-latency histogram
+        #: (floored at ``hedge_min_delay``), a second attempt goes to a
+        #: DIFFERENT replica and the first response wins — the
+        #: tail-latency answer to one gray (slow-but-alive) replica.
+        #: None disables hedging; the delay is evidence-based, so no
+        #: hedge fires until ``hedge_min_samples`` upstream latencies
+        #: have been observed (a cold router never hedges). Replica-
+        #: side idempotent dispatch (the dedup window keyed on
+        #: ``X-TFOS-Request-Id``) is what makes the losing attempt
+        #: harmless.
+        self.hedge_quantile = None if hedge_quantile is None \
+            else float(hedge_quantile)
+        self.hedge_min_delay = float(hedge_min_delay)
+        self.hedge_min_samples = int(hedge_min_samples)
         self.health = ReplicaHealth(fail_threshold=fail_threshold,
                                     cooldown=cooldown,
                                     max_cooldown=max_cooldown)
@@ -565,13 +720,20 @@ class FleetRouter(object):
         # attempts REUSE it, so the replicas' engine spans and this
         # router's spans share a timeline row end to end
         trace = tracing.mint_trace_id()
+        # ONE idempotency key per client request (PR 12), reused
+        # verbatim by every failover retry and hedge attempt: the
+        # replica-side dedup window replays (or joins) a request it
+        # already executed instead of generating it twice — what makes
+        # retrying an AMBIGUOUS timeout (did it run before the
+        # response was lost?) safe
+        request_id = uuid.uuid4().hex
         status = None
         try:
             try:
                 status, body, headers = serving.retry_call(
-                    lambda: self._attempt(raw_body, tried,
-                                          upstream_spent, client_gone,
-                                          trace, attempts_made),
+                    lambda: self._attempt_hedged(
+                        raw_body, tried, upstream_spent, client_gone,
+                        trace, attempts_made, request_id),
                     attempts=self.attempts, base_delay=self.base_delay,
                     max_delay=self.max_delay)
                 retry_after = None
@@ -600,30 +762,165 @@ class FleetRouter(object):
                     max(wall - upstream_spent[0], 0.0))
         return status, body, retry_after
 
+    def _hedge_delay(self):
+        """Seconds to wait before hedging, derived from the router's
+        own upstream-latency histogram at ``hedge_quantile`` (floored
+        at ``hedge_min_delay``); None while hedging is off or the
+        histogram holds fewer than ``hedge_min_samples`` observations
+        — the delay is evidence, never a cold guess."""
+        if self.hedge_quantile is None:
+            return None
+        with self._obs_lock:
+            if self._hist_upstream.count < self.hedge_min_samples:
+                return None
+            q = self._hist_upstream.quantile(self.hedge_quantile)
+        if q is None:
+            return None
+        return max(float(q), self.hedge_min_delay)
+
+    def _attempt_hedged(self, raw_body, tried, upstream_spent,
+                        client_gone, trace, attempts_made, request_id):
+        """One retry_call step, possibly racing TWO upstream attempts:
+        the primary starts immediately; if it is still running after
+        :meth:`_hedge_delay`, a hedge attempt goes to a DIFFERENT
+        replica (``tried`` already excludes the primary's) and the
+        first response wins. The loser is aborted through the same
+        teardown a vanished client gets (socket shutdown -> replica's
+        disconnect cancel frees the slot) — and because both attempts
+        carry the same ``X-TFOS-Request-Id``, a loser that had already
+        finished generating is just a dedup-window entry, not a
+        duplicate completion. With hedging off (or no evidence yet)
+        this is exactly one plain :meth:`_attempt` on the caller's
+        thread."""
+        hedge_delay = self._hedge_delay()
+        if hedge_delay is None:
+            return self._attempt(raw_body, tried, upstream_spent,
+                                 client_gone, trace, attempts_made,
+                                 request_id)
+        cv = threading.Condition()
+        outcomes = []  # (label, "ok"|"err", payload) in arrival order
+        lose = threading.Event()
+
+        def _run(label, skip_if_no_alternative=False):
+            try:
+                if skip_if_no_alternative:
+                    # a hedge only makes sense against a DIFFERENT
+                    # replica; with nobody else routable, joining the
+                    # primary's replica would just clear `tried` and
+                    # confuse failover bookkeeping
+                    views = self.replica_views()
+                    if not [r for r in route_order(views,
+                                                   self.stale_after)
+                            if r not in tried]:
+                        raise _HedgeLost("no alternative replica")
+                out = self._attempt(raw_body, tried, upstream_spent,
+                                    client_gone, trace, attempts_made,
+                                    request_id, lose=lose,
+                                    hedge=skip_if_no_alternative)
+                with cv:
+                    outcomes.append((label, "ok", out))
+                    cv.notify_all()
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                with cv:
+                    outcomes.append((label, "err", e))
+                    cv.notify_all()
+
+        threading.Thread(target=_run, args=("primary",), daemon=True,
+                         name="tfos-fleet-attempt").start()
+        with cv:
+            if not outcomes:
+                cv.wait(hedge_delay)
+            hedged = not outcomes
+        live = 1
+        if hedged:
+            with self._obs_lock:
+                self.counters.inc("hedges")
+            self.flight.instant("hedge_fired", trace=trace,
+                                delay_s=round(hedge_delay, 4))
+            threading.Thread(target=_run,
+                             args=("hedge", True), daemon=True,
+                             name="tfos-fleet-hedge").start()
+            live = 2
+        seen = 0
+        last_err = None
+        while True:
+            with cv:
+                while len(outcomes) <= seen:
+                    cv.wait(0.05)
+                label, kind, payload = outcomes[seen]
+            seen += 1
+            if kind == "ok":
+                lose.set()
+                if label == "hedge":
+                    with self._obs_lock:
+                        self.counters.inc("hedge_wins")
+                    self.flight.instant("hedge_won", trace=trace)
+                return payload
+            if isinstance(payload, _HedgeLost):
+                live -= 1  # hedge had nowhere to go; primary decides
+            elif isinstance(payload, _ClientGone):
+                # the END CLIENT is gone: nothing left to win. The
+                # race loop owns the count — exactly one per dispatch,
+                # no matter how many racing attempts saw the vanish
+                lose.set()
+                with self._obs_lock:
+                    self.counters.inc("client_disconnects")
+                raise payload
+            else:
+                live -= 1
+                last_err = payload
+            if live == 0:
+                # every live attempt failed; surface the last real
+                # error (payload as a fallback guards the impossible
+                # all-_HedgeLost case against `raise None`)
+                raise last_err if last_err is not None else payload
+
     def _attempt(self, raw_body, tried, upstream_spent,
-                 client_gone=None, trace=0, attempts_made=None):
+                 client_gone=None, trace=0, attempts_made=None,
+                 request_id=None, lose=None, hedge=False):
         """One dispatch attempt: pick the best untried replica, POST,
         classify the outcome. Raises Retriable to make retry_call fail
-        over; anything else returns verbatim for the client."""
+        over; anything else returns verbatim for the client. ``lose``
+        (hedging): an event that aborts this attempt because its rival
+        already won — the teardown path is the client-disconnect one,
+        but it is accounted as a lost hedge, not a disconnect.
+        ``hedge``: this attempt exists only to race a DIFFERENT
+        replica, so it must never take the clear-and-retry-same-replica
+        fallback — with no alternative at pick time it withdraws
+        (:class:`_HedgeLost`) and leaves the primary to decide."""
         if client_gone is not None and client_gone():
-            # vanished before we even picked: don't burn a slot
-            with self._obs_lock:
-                self.counters.inc("client_disconnects")
+            # vanished before we even picked: don't burn a slot.
+            # Under hedging (lose is not None) the OUTER race loop
+            # owns the disconnect count — two racing attempts seeing
+            # the same vanished client must tally ONE disconnect
+            if lose is None:
+                with self._obs_lock:
+                    self.counters.inc("client_disconnects")
             raise _ClientGone("client disconnected before dispatch")
         now = time.monotonic()
         t_pick = time.monotonic()
         snapshot = self._snapshot()
         views = self.replica_views(now, snapshot)
-        order = [rid for rid in route_order(views, self.stale_after)
-                 if rid not in tried]
-        if not order and tried:
-            # every routable replica was tried this request: clear
-            # the per-request exclusions so backoff + a fresh pick
-            # can retry one (it may have recovered — bounded by
-            # retry_call's attempt budget either way)
-            tried.clear()
-            order = route_order(views, self.stale_after)
         with self._obs_lock:
+            order = [rid for rid in route_order(views, self.stale_after)
+                     if rid not in tried]
+            if not order and tried:
+                if hedge:
+                    # the hedge's whole point is a DIFFERENT replica;
+                    # clearing `tried` here would erase the request's
+                    # failover exclusions and re-dispatch to the
+                    # primary's own (possibly gray) replica — withdraw
+                    # instead, even if the pre-launch check passed and
+                    # a staleness flip emptied the field since
+                    raise _HedgeLost("no alternative replica at pick")
+                # every routable replica was tried this request: clear
+                # the per-request exclusions so backoff + a fresh pick
+                # can retry one (it may have recovered — bounded by
+                # retry_call's attempt budget either way)
+                tried.clear()
+                order = route_order(views, self.stale_after)
+            if order:
+                tried.add(order[0])
             self.timers.add("pick", time.monotonic() - t_pick)
         if not order:
             with self._obs_lock:
@@ -631,29 +928,49 @@ class FleetRouter(object):
             raise NoReplicaAvailable(
                 "no routable replica ({} known)".format(len(views)))
         rid = order[0]
-        tried.add(rid)
         addr = (snapshot.get(rid) or {}).get("addr")
         if not addr:
             raise ReplicaUnavailable(
                 "replica {} has no advertised address".format(rid))
         more = len(order) > 1
         path = "/v1/models/{}:generate".format(self.name)
-        if attempts_made is not None:
-            attempts_made[0] += 1
+        abort = client_gone
+        if lose is not None:
+            abort = lambda: ((client_gone is not None and client_gone())
+                             or lose.is_set())
+        with self._obs_lock:
+            if attempts_made is not None:
+                attempts_made[0] += 1
+            attempt_no = attempts_made[0] if attempts_made else 1
+        extra = {"X-TFOS-Trace": str(trace)}
+        if request_id is not None:
+            # idempotency key + attempt ordinal: every retry and hedge
+            # of one client request shares the id, so the replica's
+            # dedup window can absorb duplicates of work it already did
+            extra["X-TFOS-Request-Id"] = str(request_id)
+            extra["X-TFOS-Attempt"] = str(attempt_no)
         self._note_inflight(rid, +1)
         t_up = time.monotonic()
         try:
             status, body, headers = _http_request(
                 addr, "POST", path, body=raw_body,
-                timeout=self.upstream_timeout, abort=client_gone,
-                extra_headers={"X-TFOS-Trace": str(trace)})
+                timeout=self.upstream_timeout,
+                connect_timeout=self.connect_timeout, abort=abort,
+                extra_headers=extra, net_src="router", net_dst=rid)
         except _ClientGone:
+            if lose is not None and lose.is_set():
+                # aborted because the rival attempt won — the client is
+                # still there; must not count as a disconnect
+                raise _HedgeLost("hedge rival won")
             # OUR client hung up; the upstream teardown already told
             # the replica (socket EOF -> its disconnect cancel). Not a
             # replica failure, not retriable — there is nobody left to
-            # answer
-            with self._obs_lock:
-                self.counters.inc("client_disconnects")
+            # answer. Hedged attempts (lose is not None) leave the
+            # count to the outer race loop: both racing attempts see
+            # the same vanished client, which is ONE disconnect
+            if lose is None:
+                with self._obs_lock:
+                    self.counters.inc("client_disconnects")
             raise
         except (OSError, http.client.HTTPException) as e:
             self.health.note_failure(rid, time.monotonic(),
@@ -670,8 +987,21 @@ class FleetRouter(object):
             with self._obs_lock:
                 self.timers.add("upstream", dt)
                 self._hist_upstream.observe(dt)
-            upstream_spent[0] += dt
+                upstream_spent[0] += dt
             self._note_inflight(rid, -1)
+        if status == 410 and self._retriable_kind(status, body) == "Fenced":
+            # a FENCED replica (stale lease epoch) can never serve this
+            # request — non-retriable AT the replica, but the fleet
+            # holds a valid successor, so the router fails over and
+            # hard-downs the fenced address
+            self.health.note_failure(rid, time.monotonic(),
+                                     reason="Fenced")
+            with self._obs_lock:
+                self.counters.inc("failovers")
+                self.counters.inc("fenced_upstreams")
+            raise ReplicaUnavailable(
+                "replica {} is fenced (stale lease epoch)".format(rid),
+                retry_after=0.0 if more else 0.5)
         if status in serving.RETRIABLE_HTTP_STATUS:
             kind = self._retriable_kind(status, body)
             if kind == "EngineFailed":
@@ -733,7 +1063,9 @@ class FleetRouter(object):
                 self.counters.inc("probes")
             try:
                 status, _, _ = _http_request(addr, "GET", "/healthz",
-                                             timeout=5.0)
+                                             timeout=5.0,
+                                             net_src="router",
+                                             net_dst=rid)
             except (OSError, http.client.HTTPException) as e:
                 status, e_str = None, str(e)
             if status == 200:

@@ -38,12 +38,18 @@ import struct
 import threading
 import time
 
-from tensorflowonspark_tpu import tracing
+from tensorflowonspark_tpu import chaos, tracing
 
 logger = logging.getLogger(__name__)
 
 #: Default seconds to wait for all nodes to register (reference default 600).
 DEFAULT_TIMEOUT = 600
+
+#: Default seconds a STARTED message may take to finish arriving before
+#: the server gives up on the connection (see MessageSocket). Idle time
+#: BETWEEN messages is never bounded — only a half-open / wedged peer
+#: that stalled mid-message trips this.
+DEFAULT_RECV_DEADLINE = 30.0
 
 _LEN = struct.Struct(">I")
 _MAX_MSG = 16 * 1024 * 1024
@@ -51,6 +57,20 @@ _MAX_MSG = 16 * 1024 * 1024
 
 class TimeoutError_(RuntimeError):
     """Barrier did not complete within the timeout."""
+
+
+class Fenced(RuntimeError):
+    """This beater's lease epoch is STALE: another holder registered
+    for the same identity after it (typically: a replacement spawned
+    while this one was partitioned away). NON-retriable by design —
+    re-beating harder cannot make a superseded lease current; the only
+    way back is an explicit re-registration (``Client.lease``), which
+    is an operator/supervisor decision, not a retry loop's."""
+
+    def __init__(self, msg, epoch=None):
+        super(Fenced, self).__init__(msg)
+        #: the CURRENT epoch held by the replacement (None if unknown)
+        self.epoch = epoch
 
 
 class Reservations(object):
@@ -109,26 +129,86 @@ class MessageSocket(object):
 
     Reference: ``reservation.MessageSocket`` (which framed *pickled* payloads
     — deliberately not reproduced; see module docstring).
+
+    ``recv_deadline`` bounds how long a message that has STARTED
+    arriving may take to finish: once any byte of a frame is in, the
+    rest (header remainder + body) must land within the deadline or the
+    read fails with ``ConnectionError``. Waiting for the FIRST byte of
+    the next message stays unbounded — an idle-but-healthy peer (a
+    registered client between beats) is normal, but a half-open TCP
+    peer that died mid-frame used to wedge the server's handler thread
+    in ``recv`` forever. The server arms this on every accepted
+    connection (:data:`DEFAULT_RECV_DEADLINE`); clients default to
+    unbounded for compatibility.
+
+    ``net_src``/``net_dst`` label this socket's exchanges for the
+    chaos network fault plane (``chaos.on_net``); unlabeled sockets
+    only match fully-wildcarded injections.
     """
 
-    def __init__(self, sock):
+    def __init__(self, sock, recv_deadline=None):
         self.sock = sock
+        self.recv_deadline = recv_deadline
+        self.net_src = None
+        self.net_dst = None
 
     def send(self, msg):
         data = json.dumps(msg, separators=(",", ":")).encode("utf-8")
-        self.sock.sendall(_LEN.pack(len(data)) + data)
+        frame = _LEN.pack(len(data)) + data
+        if chaos.net_armed():
+            # instrumented transport site: may raise NetPartitioned
+            # (a ConnectionError — callers treat it like a real one)
+            # or sleep (net_delay). A one-way send can't lose a
+            # response alone, so every loss here is request-side
+            # (response_capable=False); and a "dup" action is IGNORED
+            # — this is a framed request/response stream over TCP,
+            # where the transport cannot duplicate a frame, and
+            # re-sending one here would desynchronize the protocol
+            # (the peer answers twice, every later call reads the
+            # previous call's reply). net_dup models duplicated
+            # EXCHANGES, which only the HTTP transport can express.
+            chaos.on_net(self.net_src, self.net_dst)
+        self.sock.sendall(frame)
 
     def receive(self):
         header = self._recv_exact(_LEN.size)
         (length,) = _LEN.unpack(header)
         if length > _MAX_MSG:
             raise ValueError("reservation message too large: {} bytes".format(length))
-        return json.loads(self._recv_exact(length).decode("utf-8"))
+        return json.loads(
+            self._recv_exact(length, mid_message=True).decode("utf-8"))
 
-    def _recv_exact(self, n):
+    def _recv_exact(self, n, mid_message=False):
+        """Read exactly ``n`` bytes. ``mid_message``: part of the frame
+        already arrived, so the whole read is deadline-bounded from
+        entry; otherwise the deadline arms only once the first chunk
+        lands (waiting for a message to BEGIN is idle, not a stall)."""
         buf = bytearray()
+        deadline = None
+        if mid_message and self.recv_deadline is not None:
+            deadline = time.monotonic() + self.recv_deadline
         while len(buf) < n:
-            chunk = self.sock.recv(n - len(buf))
+            if deadline is None and buf and self.recv_deadline is not None:
+                deadline = time.monotonic() + self.recv_deadline
+            if deadline is not None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise ConnectionError(
+                        "reservation peer stalled mid-message "
+                        "({}/{} bytes after {}s)".format(
+                            len(buf), n, self.recv_deadline))
+                self.sock.settimeout(left)
+                try:
+                    chunk = self.sock.recv(n - len(buf))
+                except socket.timeout:
+                    raise ConnectionError(
+                        "reservation peer stalled mid-message "
+                        "({}/{} bytes after {}s)".format(
+                            len(buf), n, self.recv_deadline))
+                finally:
+                    self.sock.settimeout(None)
+            else:
+                chunk = self.sock.recv(n - len(buf))
             if not chunk:
                 raise ConnectionError("reservation peer closed connection")
             buf.extend(chunk)
@@ -149,8 +229,12 @@ class Server(object):
     a background thread serves REG/QUERY/QINFO/STOP until stopped.
     """
 
-    def __init__(self, count):
+    def __init__(self, count, recv_deadline=DEFAULT_RECV_DEADLINE):
         self.reservations = Reservations(count)
+        #: mid-message receive deadline armed on every accepted
+        #: connection (see MessageSocket) — a half-open peer fails its
+        #: handler thread in bounded time instead of wedging it forever
+        self.recv_deadline = recv_deadline
         self._sock = None
         self._thread = None
         self._stats_httpd = None
@@ -162,6 +246,13 @@ class Server(object):
         # (read by supervisor.Supervisor, which runs in this process)
         self._sup_lock = threading.Lock()
         self._leases = {}   # executor_id -> (monotonic recv time, payload)
+        # lease fencing (PR 12): identity -> current epoch, minted
+        # monotonically by LEASE messages. Once an identity has an
+        # epoch, only beats carrying the CURRENT epoch refresh its
+        # lease; anything else is answered FENCED and dropped — a
+        # replica re-beating after a partition healed cannot overwrite
+        # its replacement's lease (the split-brain double-serve window)
+        self._epochs = {}
         self._acked = set()  # partition ids fully consumed by a trainer
         # elastic-resize bookkeeping (ONE source of truth for width:
         # SupervisedCluster sets these at every formation, so /metrics
@@ -183,6 +274,24 @@ class Server(object):
         """Partition ids acknowledged as fully consumed (stable copy)."""
         with self._sup_lock:
             return set(self._acked)
+
+    def lease_epoch(self, executor_id):
+        """The CURRENT minted epoch for ``executor_id`` (None when the
+        identity never acquired one — legacy epoch-less beats)."""
+        with self._sup_lock:
+            return self._epochs.get(executor_id)
+
+    def mint_epoch(self, executor_id):
+        """Mint the next lease epoch for ``executor_id`` and make it
+        current — every outstanding older epoch is fenced from this
+        moment. The server-side half of ``Client.lease``; also callable
+        in-process (the supervisor spawning a replacement replica
+        fences the incumbent BEFORE the replacement's first beat)."""
+        with self._sup_lock:
+            epoch = self._epochs.get(executor_id, 0) + 1
+            self._epochs[executor_id] = epoch
+        logger.info("lease epoch %d minted for %r", epoch, executor_id)
+        return epoch
 
     def set_cluster_width(self, width, target=None):
         """Publish this formation's width (and the job's configured
@@ -224,6 +333,7 @@ class Server(object):
                 "age": round(lease["age"], 3),
                 "addr": payload.get("addr"),
                 "model": payload.get("model"),
+                "epoch": payload.get("epoch"),
                 "serving": payload.get("serving") or {},
                 "metrics": payload.get("metrics"),
             }
@@ -351,7 +461,7 @@ class Server(object):
 
     def _handle(self, conn):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        ms = MessageSocket(conn)
+        ms = MessageSocket(conn, recv_deadline=self.recv_deadline)
         try:
             while not self.done.is_set():
                 msg = ms.receive()
@@ -364,11 +474,35 @@ class Server(object):
                 elif mtype == "QINFO":
                     ms.send({"type": "INFO", "meta": self.reservations.get(),
                              "done": self.reservations.done()})
+                elif mtype == "LEASE":
+                    eid = msg.get("executor_id")
+                    ms.send({"type": "LEASE", "executor_id": eid,
+                             "epoch": self.mint_epoch(eid)})
                 elif mtype == "BEAT":
+                    eid = msg.get("executor_id")
+                    epoch = msg.get("epoch")
+                    payload = msg.get("payload") or {}
                     with self._sup_lock:
-                        self._leases[msg.get("executor_id")] = (
-                            time.monotonic(), msg.get("payload") or {})
-                    ms.send({"type": "OK"})
+                        current = self._epochs.get(eid)
+                        fenced = current is not None and epoch != current
+                        if not fenced:
+                            if epoch is not None:
+                                # the lease view carries its epoch, so
+                                # snapshots/routers can see which
+                                # incarnation is current
+                                payload = dict(payload, epoch=epoch)
+                            self._leases[eid] = (time.monotonic(), payload)
+                    if fenced:
+                        # the stale beat must NOT refresh the lease —
+                        # the replacement's is the live one — and the
+                        # beater must learn it is superseded
+                        logger.warning(
+                            "fencing stale beat from %r (epoch %r, "
+                            "current %r)", eid, epoch, current)
+                        ms.send({"type": "FENCED", "executor_id": eid,
+                                 "epoch": current})
+                    else:
+                        ms.send({"type": "OK"})
                 elif mtype == "ACK":
                     with self._sup_lock:
                         self._acked.add(msg.get("partition"))
@@ -480,12 +614,46 @@ class Client(object):
             # back off gently to keep the driver's accept loop unloaded
             poll_interval = min(poll_interval * 1.5, 2.0)
 
-    def beat(self, executor_id, payload=None):
+    def lease(self, executor_id):
+        """Acquire a fresh lease epoch for ``executor_id`` — the
+        fencing token every subsequent :meth:`beat` must carry. Minting
+        SUPERSEDES any prior holder of the identity: its next beat is
+        answered FENCED (see :class:`Fenced`). Serving replicas acquire
+        one before their first beat; a fenced replica re-registers
+        through here (a deliberate act, never an automatic retry)."""
+        # same chaos labels as beat(): a partition scoped to this
+        # identity's reservation link must catch its LEASE exchanges
+        # too — a fully partitioned replica cannot mint an epoch
+        # through a supposedly-down link
+        self._ms.net_src = executor_id
+        self._ms.net_dst = "reservation"
+        resp = self._call({"type": "LEASE", "executor_id": executor_id})
+        if resp.get("type") != "LEASE":
+            raise RuntimeError("lease rejected: {!r}".format(resp))
+        return int(resp["epoch"])
+
+    def beat(self, executor_id, payload=None, epoch=None):
         """Refresh this executor's heartbeat lease (supervision plane).
         ``payload`` is a small JSON-able status dict (trainer liveness,
-        feed progress, train step) the Supervisor classifies."""
-        resp = self._call({"type": "BEAT", "executor_id": executor_id,
-                           "payload": payload or {}})
+        feed progress, train step) the Supervisor classifies. ``epoch``
+        (from :meth:`lease`) is the fencing token: a beat carrying a
+        stale one raises :class:`Fenced` — NON-retriable; the caller
+        must stop acting as the identity's serving incarnation."""
+        # label the exchange for the chaos network fault plane: a
+        # net_partition=<id>:reservation spec catches exactly this
+        # identity's beats
+        self._ms.net_src = executor_id
+        self._ms.net_dst = "reservation"
+        msg = {"type": "BEAT", "executor_id": executor_id,
+               "payload": payload or {}}
+        if epoch is not None:
+            msg["epoch"] = int(epoch)
+        resp = self._call(msg)
+        if resp.get("type") == "FENCED":
+            raise Fenced(
+                "beat fenced: {!r} epoch {} superseded (current {})"
+                .format(executor_id, epoch, resp.get("epoch")),
+                epoch=resp.get("epoch"))
         if resp.get("type") != "OK":
             raise RuntimeError("beat rejected: {!r}".format(resp))
 

@@ -116,6 +116,11 @@ class EngineFailed(Retriable):
 #: 429 (QueueFull backpressure) and 503 (Shed / Draining / EngineFailed)
 RETRIABLE_HTTP_STATUS = (429, 503)
 
+#: fraction of a Retry-After floor added as jitter by retry_call: N
+#: clients told the same "Retry-After: T" by one recovering replica
+#: spread over [T, T*(1+this)] instead of stampeding it at exactly +T
+RETRY_AFTER_JITTER = 0.25
+
 
 def http_retriable(status, retry_after=None):
     """Map an upstream HTTP status to the matching client-side
@@ -147,8 +152,13 @@ def retry_call(fn, attempts=4, base_delay=0.1, max_delay=5.0,
     tries (full jitter — N clients retrying a shed replica must not
     re-arrive in lockstep). ``exc.retry_after`` refines the delay: a
     POSITIVE value (the wire's ``Retry-After``) floors it, capped at
-    ``max_delay`` — the server said when a retry is worth attempting,
-    and coming back sooner just buys another refusal; an EXPLICIT
+    ``max_delay``, PLUS up to ``RETRY_AFTER_JITTER`` of itself in
+    jitter — the server said when a retry is worth attempting, and
+    coming back sooner just buys another refusal, but N clients all
+    told "Retry-After: 2" by the same recovering replica must not
+    re-arrive at +2.000s in one synchronized stampede (the jitter is
+    NOT capped by ``max_delay``: capping would re-synchronize exactly
+    the clients whose floor hit the cap); an EXPLICIT
     ``retry_after == 0`` skips the sleep entirely — the router's
     failover shape, where the next attempt goes to a DIFFERENT
     replica and any wait is pure added latency; absent/None means
@@ -175,7 +185,9 @@ def retry_call(fn, attempts=4, base_delay=0.1, max_delay=5.0,
                         float(base_delay) * (2.0 ** (attempt - 1)))
             delay *= rng()
             if retry_after is not None:
-                delay = max(delay, min(retry_after, float(max_delay)))
+                floor = min(retry_after, float(max_delay))
+                delay = max(delay, floor * (1.0 + RETRY_AFTER_JITTER
+                                            * rng()))
             if delay > 0.0:
                 sleep(delay)
 
@@ -328,6 +340,109 @@ class QueueFull(RuntimeError):
     """The engine's admission queue is at ``max_queue`` — backpressure;
     retry later. The HTTP surface answers 429 instead of queueing work
     for a client that will have timed out by the time it decodes."""
+
+
+class Fenced(RuntimeError):
+    """This replica's serving lease epoch was superseded (another
+    holder registered for its identity — see ``reservation.Fenced``):
+    it must not serve. NON-retriable: the HTTP surface answers 410
+    (Gone) with ``kind: "Fenced"`` — a client or router should
+    re-resolve to the current holder, never retry here."""
+
+
+class DedupWindow(object):
+    """Bounded TTL + LRU idempotency window for request replay (PR 12).
+
+    The exactly-once half of partition-tolerant dispatch: a retry of a
+    request this replica ALREADY executed (the ambiguous-timeout shape
+    — the response was lost, not the work) must not execute twice.
+    Keyed on the router's ``X-TFOS-Request-Id``; three cases:
+
+    - **fresh** — no entry: the caller becomes the OWNER, executes,
+      and publishes the outcome (``complete``) or withdraws
+      (``fail`` — failed attempts are NOT cached, a later retry gets a
+      clean execution).
+    - **completed** — a finished entry inside the TTL: the stored
+      response is REPLAYED verbatim (a dedup *hit*).
+    - **in-flight** — the original is still executing: the retry JOINS
+      it (waits on the owner's outcome) instead of racing a duplicate
+      generation (a dedup *join*) — this is what makes a post-timeout
+      failover that lands back on the same replica safe while the
+      first execution is still running.
+
+    Bounded two ways: ``ttl_s`` (entries expire — a replay window, not
+    a permanent ledger) and ``capacity`` (LRU eviction — memory stays
+    bounded under sustained traffic). Evicting an in-flight entry is
+    safe: joiners hold the entry object itself, so the owner's outcome
+    still resolves them; the id just stops deduplicating afterwards.
+    Thread-safe (HTTP handler threads share it). ``now`` is injectable
+    for deterministic TTL tests."""
+
+    def __init__(self, capacity=2048, ttl_s=120.0, now=time.monotonic):
+        self.capacity = max(1, int(capacity))
+        self.ttl_s = float(ttl_s)
+        self._now = now
+        self._lock = threading.Lock()
+        self._entries = collections.OrderedDict()  # rid -> _DedupEntry
+
+    class _Entry(object):
+        __slots__ = ("done", "response", "error", "created")
+
+        def __init__(self, created):
+            self.done = threading.Event()
+            self.response = None
+            self.error = None
+            self.created = created
+
+    def begin(self, request_id):
+        """(entry, owner): ``owner`` True means the caller must execute
+        and then call :meth:`complete` or :meth:`fail`; False means the
+        entry belongs to an earlier arrival — replay/join it."""
+        rid = str(request_id)
+        now = self._now()
+        with self._lock:
+            self._expire_locked(now)
+            entry = self._entries.get(rid)
+            if entry is not None:
+                # TTL is since-last-access: the refresh keeps the
+                # OrderedDict's insertion order == recency order, so
+                # head-scan expiry is exact
+                entry.created = now
+                self._entries.move_to_end(rid)
+                return entry, False
+            entry = self._Entry(now)
+            self._entries[rid] = entry
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+            return entry, True
+
+    def complete(self, request_id, entry, response):
+        """Publish the owner's successful response for replay."""
+        entry.response = response
+        entry.done.set()
+
+    def fail(self, request_id, entry, error):
+        """Withdraw a failed execution: joiners already waiting get the
+        error (they were the same request — hiding it would hang them),
+        but the entry leaves the window so a LATER retry re-executes
+        instead of replaying a transient failure forever."""
+        entry.error = error
+        entry.done.set()
+        with self._lock:
+            if self._entries.get(str(request_id)) is entry:
+                del self._entries[str(request_id)]
+
+    def _expire_locked(self, now):
+        while self._entries:
+            rid, entry = next(iter(self._entries.items()))
+            if now - entry.created <= self.ttl_s:
+                break
+            del self._entries[rid]
+
+    def stats(self):
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "capacity": self.capacity, "ttl_s": self.ttl_s}
 
 
 class DecodeEngine(object):
@@ -1951,7 +2066,8 @@ class ModelServer(object):
     """
 
     def __init__(self, model_dir, name="model", host="127.0.0.1", port=8501,
-                 batch_window_ms=0, engine=None, replica_id=None):
+                 batch_window_ms=0, engine=None, replica_id=None,
+                 dedup_capacity=2048, dedup_ttl_s=120.0):
         from tensorflowonspark_tpu import export as export_lib
 
         if model_dir is not None:
@@ -1983,6 +2099,24 @@ class ModelServer(object):
         #: set by supervisor.Supervisor.watch (or any operator hook) when
         #: the serving path is known-bad; /healthz then answers 503
         self._unhealthy = None
+        #: lease-fencing latch (PR 12): set by the fleet Replica when
+        #: its beat comes back FENCED (a replacement holds a newer
+        #: lease epoch). While set, :generate/:predict answer 410
+        #: ``kind: "Fenced"`` (NON-retriable — re-resolve, don't retry)
+        #: and /healthz answers 503 ``status: "fenced"``, so a router
+        #: probe can never readmit a superseded replica
+        self._fenced = None
+        #: idempotent dispatch (PR 12): replay window keyed on the
+        #: router's ``X-TFOS-Request-Id`` — a retried/hedged/duplicated
+        #: :generate this server already executed is replayed (or
+        #: joined in-flight), never generated twice. Server-level so it
+        #: survives ``attach_engine`` swaps (the retry that matters
+        #: most arrives right after a recovery)
+        self._dedup = DedupWindow(capacity=dedup_capacity,
+                                  ttl_s=dedup_ttl_s)
+        self._dedup_hits = 0
+        self._dedup_joined = 0
+        self._dedup_obs_lock = threading.Lock()
         #: graceful-drain latch (drain() / SIGTERM): /healthz answers a
         #: distinct 503 'draining' and POST routes refuse with 503 while
         #: admitted work finishes. The lock + memo make drain()
@@ -2015,7 +2149,69 @@ class ModelServer(object):
                 outputs = self._apply(self._variables, batch)
         return _to_json(outputs, row_format)
 
-    def generate(self, payload, client_gone=None, trace=None):
+    def generate(self, payload, client_gone=None, trace=None,
+                 request_id=None):
+        """Idempotent :generate entry point: with a ``request_id`` (the
+        fleet router's ``X-TFOS-Request-Id`` header, reused verbatim by
+        every failover retry and hedge attempt of one client request),
+        the dedup window makes re-execution safe — a request this
+        server ALREADY answered is replayed from the stored response
+        (dedup hit), and one still executing is JOINED (the retry waits
+        on the original's outcome) instead of racing a duplicate
+        generation. Failed executions are withdrawn, so a later retry
+        runs clean. Without a ``request_id`` (direct clients) this is a
+        plain execution. See :meth:`_generate_once` for the payload
+        contract.
+
+        Raises :class:`Fenced` while the server's lease epoch is
+        superseded — direct API callers must not serve through a
+        fenced replica any more than HTTP clients (whose 410 the
+        handler answers from the same latch)."""
+        if self._fenced is not None:
+            raise Fenced("replica is fenced: " + self._fenced)
+        if request_id is None:
+            return self._generate_once(payload, client_gone, trace)
+        entry, owner = self._dedup.begin(request_id)
+        if not owner:
+            hit = entry.done.is_set()
+            with self._dedup_obs_lock:
+                if hit:
+                    self._dedup_hits += 1
+                else:
+                    self._dedup_joined += 1
+            counters = getattr(self.engine, "counters", None)
+            if counters is not None:
+                with self._dedup_obs_lock:
+                    counters.inc("dedup_hits" if hit else "dedup_joined")
+            logger.info("request %s deduplicated (%s)", request_id,
+                        "replayed" if hit else "joined in-flight")
+            deadline = time.monotonic() + 600.0
+            while not entry.done.wait(0.05):
+                if client_gone is not None and client_gone():
+                    # OUR client vanished; the owner's client may not
+                    # have — never cancel the original's work from here
+                    raise Cancelled(
+                        "client disconnected while joined to an "
+                        "in-flight duplicate")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "joined in-flight duplicate did not complete "
+                        "within 600s")
+            if entry.error is not None:
+                raise entry.error
+            return entry.response
+        try:
+            out = self._generate_once(payload, client_gone, trace)
+        except BaseException as e:
+            # transient failures are NOT cached: withdraw so a later
+            # retry re-executes (joiners already waiting get the error
+            # — they asked for the same doomed execution)
+            self._dedup.fail(request_id, entry, e)
+            raise
+        self._dedup.complete(request_id, entry, out)
+        return out
+
+    def _generate_once(self, payload, client_gone=None, trace=None):
         """{'prompt': [[...], ...], 'max_new_tokens': N} -> {'tokens': ...}.
 
         ``trace``: an externally minted trace id (the fleet router's
@@ -2145,6 +2341,24 @@ class ModelServer(object):
         self._unhealthy = str(reason)
         logger.error("serving marked unhealthy: %s", reason)
 
+    def fence(self, reason):
+        """Refuse to serve: this replica's lease epoch was superseded
+        (fleet.Replica calls this on a FENCED beat). :generate and
+        :predict answer 410 ``kind: "Fenced"`` — NON-retriable, the
+        client/router must go to the current lease holder — and
+        /healthz answers 503 ``status: "fenced"`` so no probe loop can
+        readmit a superseded replica. The engine keeps running (its
+        in-flight work finishes; only NEW work is refused): fencing is
+        an identity verdict, not an engine fault."""
+        self._fenced = str(reason)
+        logger.error("serving FENCED: %s", reason)
+
+    def unfence(self):
+        """Clear the fenced latch (``Replica.re_register`` — a fresh
+        lease epoch was deliberately acquired)."""
+        self._fenced = None
+        logger.info("serving unfenced (fresh lease epoch)")
+
     def healthz(self):
         """(status_code, body) for GET /healthz.
 
@@ -2165,6 +2379,20 @@ class ModelServer(object):
             # pinned schema (fleet plane): the id a scrape or router
             # joins this replica's series and decisions on
             body["replica_id"] = rid
+        # idempotent-dispatch visibility: window occupancy + absorbed
+        # duplicates (the partition-flap bench's proof that retries
+        # were deduplicated, not re-executed)
+        with self._dedup_obs_lock:
+            body["dedup"] = dict(self._dedup.stats(),
+                                 hits=self._dedup_hits,
+                                 joined=self._dedup_joined)
+        if self._fenced is not None:
+            # fenced outranks EVERYTHING: a superseded replica must
+            # never answer 200 (a router probe would readmit it into
+            # the exact split-brain fencing closed)
+            body["status"] = "fenced"
+            body["reason"] = self._fenced
+            return 503, body
         engine = self.engine
         if engine is not None:
             health = engine.healthy()
@@ -2432,16 +2660,29 @@ class ModelServer(object):
                         trace = int(raw_trace)
                     except ValueError:
                         trace = None  # malformed header: local id
+                # idempotency key (PR 12): every failover retry / hedge
+                # / net-duplicated delivery of one client request
+                # carries the same id — the dedup window's join key
+                request_id = self.headers.get("X-TFOS-Request-Id") \
+                    or None
                 routes = {"/v1/models/%s:predict" % server.name:
                           server.predict,
                           "/v1/models/%s:generate" % server.name:
                           lambda payload: server.generate(
                               payload, client_gone=self._client_gone,
-                              trace=trace)}
+                              trace=trace, request_id=request_id)}
                 handler = routes.get(self.path)
                 if handler is None:
                     return self._send(404,
                                       {"error": "not found: %s" % self.path})
+                if server._fenced is not None:
+                    # NON-retriable 410: this replica's lease epoch is
+                    # superseded — serving would double-serve alongside
+                    # the current holder. Clients/routers re-resolve;
+                    # only a deliberate re_register clears it
+                    return self._send(
+                        410, {"error": "replica is fenced: "
+                              + server._fenced, "kind": "Fenced"})
                 if server._draining:
                     # drain contract: no new work — in-flight requests
                     # finish, fresh ones go to another replica
@@ -2457,6 +2698,11 @@ class ModelServer(object):
                 except (_BadRequest, json.JSONDecodeError) as e:
                     # malformed JSON is the client's fault: 400, not 500
                     return self._send(400, {"error": str(e)})
+                except Fenced as e:
+                    # a fence that landed AFTER the pre-dispatch check:
+                    # same non-retriable 410 contract
+                    return self._send(410, {"error": str(e),
+                                            "kind": "Fenced"})
                 except QueueFull as e:
                     # backpressure, not failure: retry later
                     return self._send(429, {"error": str(e)})

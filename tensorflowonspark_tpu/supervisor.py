@@ -759,6 +759,25 @@ class Supervisor(object):
                         entry["router"].readmit(rid, owner="supervisor")
                     if entry.get("server") is not None:
                         entry["server"].attach_engine(entry["engine"])
+            if replica is not None and getattr(replica, "fenced", False):
+                # lease fencing (PR 12): a FENCED replica is
+                # administratively superseded — another holder owns its
+                # identity's current epoch. Its engine's liveness is
+                # irrelevant until a deliberate re_register(), and a
+                # RestartEngine respawn here would burn restart budget
+                # reviving a scheduler behind a server answering 410.
+                # Report once per fence episode, then stand down.
+                if not entry.get("fence_reported"):
+                    entry["fence_reported"] = True
+                    rid = getattr(entry["engine"], "replica_id", None)
+                    self.events.record("replica_fenced", replica=rid)
+                    self._report(FailureEvent(
+                        "replica_fenced", None,
+                        "replica {} fenced (stale lease epoch); "
+                        "supervision suspended until re_register"
+                        .format(rid)))
+                continue
+            entry.pop("fence_reported", None)  # re-registered: resume
             if entry["dead"]:
                 continue
             health = entry["engine"].healthy()

@@ -331,6 +331,16 @@ METRIC_FAMILIES = {
         ("gauge", "replica_id", "constant 1 carrying the engine's stable "
                                 "replica identity (join key for scraped "
                                 "series and router decisions)"),
+    # -- idempotent dispatch (PR 12): replica-side dedup window --
+    "tfos_serving_dedup_hits":
+        ("counter", "", "retried/duplicated requests answered from the "
+                        "dedup window's stored completion (executed "
+                        "once, replayed — the partition-flap proof "
+                        "that retries were absorbed)"),
+    "tfos_serving_dedup_joined":
+        ("counter", "", "duplicate deliveries that JOINED a still-"
+                        "executing original instead of racing a second "
+                        "generation"),
     # -- fleet plane (FleetRouter registry; router /metrics) --
     "tfos_fleet_requests":
         ("counter", "", "requests the router answered (any status)"),
@@ -345,6 +355,17 @@ METRIC_FAMILIES = {
         ("counter", "", "dispatches abandoned because the router's own "
                         "client disconnected (upstream torn down so "
                         "the replica's disconnect cancel fires)"),
+    "tfos_fleet_hedges":
+        ("counter", "", "hedge attempts launched (primary still "
+                        "running past the quantile-derived hedge "
+                        "delay)"),
+    "tfos_fleet_hedge_wins":
+        ("counter", "", "requests whose HEDGE attempt produced the "
+                        "winning response (the gray-replica tail the "
+                        "hedge clipped)"),
+    "tfos_fleet_fenced_upstreams":
+        ("counter", "", "upstream attempts answered 410 Fenced (stale "
+                        "lease epoch) — failed over and hard-downed"),
     "tfos_fleet_replicas":
         ("gauge", "", "replicas with a live serving lease"),
     "tfos_fleet_replicas_routable":

@@ -107,9 +107,33 @@ def test_retry_call_bounded_attempts_and_backoff_growth():
         serving.retry_call(fn, attempts=4, base_delay=0.1, max_delay=10.0,
                            sleep=delays.append, rng=lambda: 1.0)
     assert len(calls) == 4
-    # rng=1.0 makes jitter deterministic: full exponential envelope
-    # (retry_after floors at Retriable's default 1.0)
-    assert delays == [max(0.1, 1.0), max(0.2, 1.0), max(0.4, 1.0)]
+    # rng=1.0 makes jitter deterministic: retry_after (Retriable's
+    # default 1.0) floors every delay, plus the full anti-stampede
+    # jitter fraction of the floor
+    floor = 1.0 * (1.0 + serving.RETRY_AFTER_JITTER)
+    assert delays == [pytest.approx(floor)] * 3
+
+
+def test_retry_call_retry_after_jitter_spreads_synchronized_clients():
+    """Two clients told the same Retry-After by one recovering replica
+    must NOT re-arrive at the same instant: the floor gains up to
+    RETRY_AFTER_JITTER of itself, drawn per client."""
+
+    def fn():
+        raise serving.Shed("busy", retry_after=2.0)
+
+    def delays_for(draw):
+        delays = []
+        with pytest.raises(serving.Shed):
+            serving.retry_call(fn, attempts=2, base_delay=0.01,
+                               max_delay=10.0, sleep=delays.append,
+                               rng=lambda: draw)
+        return delays
+
+    lo, hi = delays_for(0.0), delays_for(1.0)
+    assert lo == [pytest.approx(2.0)], "zero draw keeps the exact floor"
+    assert hi == [pytest.approx(2.0 * (1 + serving.RETRY_AFTER_JITTER))]
+    assert hi[0] > lo[0], "different draws must spread the stampede"
 
 
 def test_retry_call_full_jitter_bounded_by_envelope():

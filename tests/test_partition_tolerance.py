@@ -1,0 +1,671 @@
+"""Partition-tolerant serving (PR 12): the network fault plane,
+idempotent dispatch, hedged requests, and lease fencing.
+
+Layers, matching the module split:
+
+- PURE — the netchaos spec grammar (endpoints, ``for=``/``seed=``
+  fields, mandatory-heal partitions), the seeded drop schedule's
+  determinism, the ``DedupWindow`` replay/join/withdraw contract, and
+  the ``ReplicaHealth`` cooldown-window interleavings (stale-success
+  discipline) — injected time, no sockets.
+- TRANSPORT — ``fleet._http_request``'s split connect/read timeouts
+  and ``reservation``'s lease/fence protocol over the real wire.
+- E2E — a replica that executes a request whose RESPONSE is lost
+  (``net_partition``'s opening exchange) serves the retry from the
+  dedup window (zero duplicate completions); a duplicated delivery
+  (``net_dup``) is absorbed the same way; a fenced replica answers
+  non-retriable 410 and the router fails over; hedged requests beat
+  one injected gray (``net_delay``) replica (slow). The repeated
+  partition-flap cycle rides ``make chaos`` (chaos marker).
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import chaos, fleet, generation, reservation, \
+    serving
+from tensorflowonspark_tpu.models.decoder import DecoderLM
+
+V, H, NH, L, MAXLEN = 17, 32, 4, 2, 48
+
+
+@pytest.fixture(scope="module")
+def lm():
+    train = DecoderLM(vocab=V, hidden=H, num_heads=NH, num_layers=L,
+                      max_len=MAXLEN, decode=False)
+    dec = DecoderLM(vocab=V, hidden=H, num_heads=NH, num_layers=L,
+                    max_len=MAXLEN, decode=True)
+    params = train.init(jax.random.PRNGKey(7),
+                        jnp.zeros((2, MAXLEN), jnp.int32))["params"]
+    return dec, params
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    chaos.disarm()
+
+
+def _solo(dec, params, prompt, max_new):
+    out = generation.generate_jit(
+        dec, params, jnp.asarray([prompt], jnp.int32), max_new)
+    return np.asarray(out)[0].tolist()
+
+
+def _post(url, payload, timeout=120, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers=dict({"Content-Type": "application/json"},
+                     **(headers or {})))
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get_json(url, timeout=30):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# -- netchaos spec grammar (pure) ------------------------------------------
+
+def test_net_spec_grammar_parses_points_and_fields():
+    spec = ("net_drop=0.25,only=router:replica-1,seed=9;"
+            "net_delay=0.5,only=*:replica-2;"
+            "net_dup=1.0;"
+            "net_partition=replica-0:reservation,for=2.5")
+    out = chaos.parse_spec(spec)
+    drop = out["net_drop"]
+    assert drop.value == 0.25 and drop.seed == 9
+    assert drop.endpoints == ("router", "replica-1")
+    assert out["net_delay"].endpoints == ("*", "replica-2")
+    assert out["net_dup"].endpoints is None
+    part = out["net_partition"]
+    assert part.endpoints == ("replica-0", "reservation")
+    assert part.window == 2.5
+
+
+def test_net_spec_grammar_rejects_bad_specs():
+    with pytest.raises(ValueError, match="for=T"):
+        chaos.parse_spec("net_partition=a:b")  # a heal time is the point
+    with pytest.raises(ValueError, match="SRC:DST"):
+        chaos.parse_spec("net_partition=lopsided,for=1")
+    with pytest.raises(ValueError, match="only apply to net points"):
+        chaos.parse_spec("kill_trainer_at_step=3,seed=1")
+    with pytest.raises(ValueError, match="only apply to net points"):
+        chaos.parse_spec("stall_decode_for=1,for=2")
+    with pytest.raises(ValueError, match="seed"):
+        chaos.parse_spec("net_drop=0.5,seed=abc")
+
+
+def test_net_drop_schedule_is_seed_deterministic():
+    def schedule():
+        out = []
+        for _ in range(32):
+            try:
+                chaos.on_net("a", "b")
+                out.append(0)
+            except chaos.NetPartitioned:
+                out.append(1)
+        return out
+
+    chaos.arm("net_drop=0.5,seed=1234")
+    first = schedule()
+    chaos.arm("net_drop=0.5,seed=1234")  # re-arm resets the RNG
+    assert schedule() == first, "same seed must yield the same schedule"
+    assert 0 < sum(first) < 32, "p=0.5 should drop some, not all"
+    chaos.arm("net_drop=0.5,seed=77")
+    assert schedule() != first, "a different seed changes the schedule"
+
+
+def test_net_endpoint_scoping():
+    chaos.arm("net_drop=1.0,only=router:replica-0")
+    with pytest.raises(chaos.NetPartitioned):
+        chaos.on_net("router", "replica-0")
+    assert chaos.on_net("router", "replica-1") is None
+    assert chaos.on_net("replica-0", "reservation") is None
+    # an unlabeled site never matches a scoped spec
+    assert chaos.on_net(None, None) is None
+    chaos.arm("net_drop=1.0,only=*:replica-0")
+    with pytest.raises(chaos.NetPartitioned):
+        chaos.on_net("anything", "replica-0")
+    chaos.arm("net_drop=1.0")  # unscoped matches even unlabeled sites
+    with pytest.raises(chaos.NetPartitioned):
+        chaos.on_net(None, None)
+
+
+def test_net_partition_opening_exchange_loses_response_then_heals():
+    """The choreography the idempotency plane is built around: the
+    exchange in flight when the link dies DID execute (only the
+    response is lost); everything after is request-side loss; after
+    ``for=`` the link heals and the injection is spent."""
+    chaos.arm("net_partition=router:replica-0,for=0.25")
+    act = chaos.on_net("router", "replica-0", response_capable=True)
+    assert act == "drop_response", "opening exchange: executed, answer lost"
+    with pytest.raises(chaos.NetPartitioned):
+        chaos.on_net("router", "replica-0", response_capable=True)
+    # a transport that cannot separate the sides gets request-side loss
+    # even at the opening
+    chaos.arm("net_partition=a:b,for=0.25")
+    with pytest.raises(chaos.NetPartitioned):
+        chaos.on_net("a", "b")
+    assert chaos.poll_until(
+        lambda: _survives("a", "b"), timeout=2.0), \
+        "partition must heal after its window"
+
+
+def _survives(src, dst):
+    try:
+        chaos.on_net(src, dst)
+        return True
+    except chaos.NetPartitioned:
+        return False
+
+
+def test_net_dup_and_delay():
+    chaos.arm("net_dup=1.0,seed=0")
+    assert chaos.on_net("x", "y") == "dup"
+    chaos.arm("net_delay=0.15")
+    t0 = time.monotonic()
+    assert chaos.on_net("x", "y") is None
+    assert time.monotonic() - t0 >= 0.14
+
+
+# -- DedupWindow (pure) ----------------------------------------------------
+
+def test_dedup_window_replay_join_and_withdraw():
+    win = serving.DedupWindow(capacity=8, ttl_s=60.0)
+    entry, owner = win.begin("r1")
+    assert owner
+    # a second arrival while in flight JOINS (same entry, not owner)
+    joined, owner2 = win.begin("r1")
+    assert joined is entry and not owner2
+    win.complete("r1", entry, {"tokens": [1, 2]})
+    replay, owner3 = win.begin("r1")
+    assert not owner3 and replay.done.is_set()
+    assert replay.response == {"tokens": [1, 2]}
+    # failures are withdrawn: the NEXT retry owns a clean execution
+    entry, owner = win.begin("r2")
+    assert owner
+    win.fail("r2", entry, RuntimeError("transient"))
+    assert entry.done.is_set() and entry.error is not None
+    retry, owner = win.begin("r2")
+    assert owner and retry is not entry
+
+
+def test_dedup_window_ttl_and_lru_bounds():
+    clock = [0.0]
+    win = serving.DedupWindow(capacity=3, ttl_s=10.0,
+                              now=lambda: clock[0])
+    for i in range(3):
+        entry, owner = win.begin("r{}".format(i))
+        assert owner
+        win.complete("r{}".format(i), entry, {"i": i})
+    # capacity eviction is LRU: touching r0 keeps it, adding r3 evicts
+    # the oldest untouched (r1)
+    _, owner = win.begin("r0")
+    assert not owner
+    entry, owner = win.begin("r3")
+    assert owner
+    win.complete("r3", entry, {})
+    assert win.begin("r1")[1], "LRU-evicted id re-executes"
+    # TTL: everything expires once the clock passes ttl_s since access
+    clock[0] = 100.0
+    assert win.begin("r0")[1], "expired id re-executes"
+    assert win.stats()["entries"] <= 3
+
+
+# -- ReplicaHealth cooldown-window interleavings (satellite) ----------------
+
+def test_health_stale_success_cannot_reopen_active_cooldown():
+    """A request admitted before the down-mark, completing after, must
+    not defeat the cooldown: recovery from DOWN goes through the
+    half-open probe, never through straggler evidence."""
+    h = fleet.ReplicaHealth(fail_threshold=2, cooldown=10.0,
+                            cooldown_factor=2.0)
+    h.note_failure("r", now=0.0)
+    h.note_failure("r", now=1.0)           # down until 11.0
+    assert h.state("r", now=2.0) == h.DOWN
+    h.note_success("r", now=2.0)           # the straggler lands
+    assert h.state("r", now=2.0) == h.DOWN, \
+        "stale success must not reopen an active cooldown"
+    # ... and the escalation it would have erased is still there: the
+    # half-open probe failing re-downs at the ESCALATED cooldown
+    assert h.state("r", now=11.5) == h.PROBE
+    h.note_failure("r", now=11.5)          # probe failed: 20s hold
+    assert h.state("r", now=30.0) == h.DOWN
+    assert h.state("r", now=31.6) == h.PROBE
+    # a PROBE-window success (fresh evidence) readmits and fully resets
+    h.note_success("r", now=31.6)
+    assert h.state("r", now=31.6) == h.UP
+
+
+def test_health_interleaved_quiesce_during_cooldown_and_probe():
+    h = fleet.ReplicaHealth(fail_threshold=1, cooldown=10.0)
+    h.note_failure("r", now=0.0)           # down until 10.0
+    h.quiesce("r", "drain", owner="rolling-drain")
+    # quiesce outranks the organic cooldown, including its probe window
+    assert h.state("r", now=5.0) == h.DOWN
+    assert h.state("r", now=11.0) == h.DOWN, "no probe under a hold"
+    # successes/failures during the hold never disturb it
+    h.note_success("r", now=11.0)
+    h.note_failure("r", now=11.5)
+    assert h.state("r", now=11.5) == h.DOWN
+    # the LAST hold clearing resets organic state too
+    h.readmit("r", owner="rolling-drain")
+    assert h.state("r", now=12.0) == h.UP
+
+
+def test_health_concurrent_interleavings_keep_invariants():
+    """Hammer note_success/note_failure/quiesce/readmit from threads:
+    no crash, and the terminal state is coherent (quiesced -> DOWN;
+    released + succeeded -> UP)."""
+    h = fleet.ReplicaHealth(fail_threshold=2, cooldown=0.01)
+
+    def churn(seed):
+        for i in range(200):
+            op = (seed + i) % 4
+            now = time.monotonic()
+            if op == 0:
+                h.note_failure("r", now)
+            elif op == 1:
+                h.note_success("r", now)
+            elif op == 2:
+                h.quiesce("r", owner="t{}".format(seed))
+            else:
+                h.readmit("r", owner="t{}".format(seed))
+
+    threads = [threading.Thread(target=churn, args=(s,))
+               for s in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    h.quiesce("r", owner="final")
+    assert h.state("r", now=time.monotonic()) == h.DOWN
+    h.readmit("r", owner=None)
+    h.note_success("r", now=time.monotonic() + 100.0)
+    assert h.state("r", now=time.monotonic()) == h.UP
+
+
+# -- transport: split connect/read timeouts --------------------------------
+
+def test_http_request_read_timeout_independent_of_connect():
+    """A server that accepts but never answers trips the READ timeout;
+    the generous connect bound must not extend it."""
+    lis = socket.socket()
+    lis.bind(("127.0.0.1", 0))
+    lis.listen(4)
+    addr = lis.getsockname()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            fleet._http_request(addr, "GET", "/", timeout=0.3,
+                                connect_timeout=30.0)
+        assert time.monotonic() - t0 < 5.0, \
+            "read deadline must fire at ~timeout, not connect_timeout"
+    finally:
+        lis.close()
+
+
+def test_http_request_connect_timeout_bounds_unaccepted_connect():
+    """A full accept backlog (the connect-level black hole a partition
+    looks like) fails within ~connect_timeout despite a long read
+    timeout."""
+    lis = socket.socket()
+    lis.bind(("127.0.0.1", 0))
+    lis.listen(0)
+    addr = lis.getsockname()
+    fillers = []
+    try:
+        # saturate the backlog so further SYNs are not accepted
+        for _ in range(16):
+            s = socket.socket()
+            s.setblocking(False)
+            try:
+                s.connect_ex(addr)
+            except OSError:
+                pass
+            fillers.append(s)
+        t0 = time.monotonic()
+        try:
+            fleet._http_request(addr, "GET", "/", timeout=10.0,
+                                connect_timeout=0.5)
+        except OSError:
+            pass  # expected: connect could not complete
+        assert time.monotonic() - t0 < 8.0, \
+            "connect bound must not wait out the read timeout"
+    finally:
+        for s in fillers:
+            s.close()
+        lis.close()
+
+
+# -- idempotent dispatch over HTTP -----------------------------------------
+
+def _mk_server(lm, **server_kw):
+    dec, params = lm
+    eng = serving.DecodeEngine(dec, params, slots=2,
+                               replica_id=server_kw.pop("replica_id",
+                                                        "replica-d"))
+    server = serving.ModelServer(None, engine=eng, name="m", port=0,
+                                 **server_kw)
+    host, port = server.start()
+    return eng, server, "http://{}:{}".format(host, port)
+
+
+def test_generate_dedup_replays_completed_request(lm):
+    dec, params = lm
+    eng, server, base = _mk_server(lm)
+    try:
+        url = base + "/v1/models/m:generate"
+        body = {"prompt": [1, 2, 3], "max_new_tokens": 4}
+        hdr = {"X-TFOS-Request-Id": "req-abc", "X-TFOS-Attempt": "1"}
+        status, first = _post(url, body, headers=hdr)
+        assert status == 200
+        prefills = eng.counters.snapshot()["counts"].get("prefills", 0)
+        # the "retry" after a lost response: same id, same body
+        status, again = _post(url, body, headers=dict(
+            hdr, **{"X-TFOS-Attempt": "2"}))
+        assert status == 200
+        assert again == first, "replay must be the ORIGINAL completion"
+        after = eng.counters.snapshot()["counts"].get("prefills", 0)
+        assert after == prefills, "a replayed request must not re-decode"
+        code, health = _get_json(base + "/healthz")
+        assert health["dedup"]["hits"] == 1
+        assert eng.counters.snapshot()["counts"].get("dedup_hits") == 1
+        # a DIFFERENT id is a fresh execution
+        status, _ = _post(url, body,
+                          headers={"X-TFOS-Request-Id": "req-xyz"})
+        assert status == 200
+        assert eng.counters.snapshot()["counts"].get("prefills", 0) \
+            == after + 1
+    finally:
+        server.stop()
+
+
+def test_generate_dedup_joins_in_flight_duplicate(lm):
+    dec, params = lm
+    eng, server, base = _mk_server(lm, replica_id="replica-j")
+    try:
+        url = base + "/v1/models/m:generate"
+        body = {"prompt": [2, 3, 4, 5], "max_new_tokens": 8}
+        hdr = {"X-TFOS-Request-Id": "req-join"}
+        results = []
+
+        def one():
+            results.append(_post(url, body, headers=hdr))
+
+        threads = [threading.Thread(target=one) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert [s for s, _ in results] == [200, 200, 200]
+        bodies = [b for _, b in results]
+        assert bodies[0] == bodies[1] == bodies[2]
+        counts = eng.counters.snapshot()["counts"]
+        assert counts.get("prefills", 0) == 1, \
+            "three deliveries of one request must execute ONCE"
+        assert counts.get("dedup_joined", 0) \
+            + counts.get("dedup_hits", 0) == 2
+    finally:
+        server.stop()
+
+
+def test_net_dup_delivery_absorbed_by_dedup(lm):
+    """A transport-duplicated :generate (net_dup) reaches the replica
+    twice; the dedup window replays the second delivery."""
+    dec, params = lm
+    with fleet.ServingFleet(dec, params, replicas=1, name="model",
+                            engine_kw={"slots": 2}) as f:
+        url = f.url("/v1/models/model:generate")
+        _post(url, {"prompt": [1, 2], "max_new_tokens": 2})  # warm
+        eng = f.replicas[0].engine
+        before = eng.counters.snapshot()["counts"]
+        chaos.arm("net_dup=1.0,only=router:replica-0")
+        status, out = _post(url, {"prompt": [3, 4, 5],
+                                  "max_new_tokens": 4})
+        chaos.disarm()
+        assert status == 200
+        assert out["tokens"] == _solo(dec, params, [3, 4, 5], 4)
+        after = eng.counters.snapshot()["counts"]
+        assert after.get("prefills", 0) == before.get("prefills", 0) + 1, \
+            "the duplicated delivery must not decode a second time"
+        assert after.get("dedup_hits", 0) \
+            + after.get("dedup_joined", 0) >= 1
+
+
+def test_partition_flap_retry_absorbed_zero_duplicates(lm):
+    """THE tentpole pin: the opening exchange of a router->replica
+    partition EXECUTES but loses its response; the router's retry
+    (same X-TFOS-Request-Id) lands after the heal and is served from
+    the dedup window — the client sees one clean 200, the engine ran
+    the request exactly once."""
+    dec, params = lm
+    with fleet.ServingFleet(dec, params, replicas=1, name="model",
+                            engine_kw={"slots": 2}) as f:
+        url = f.url("/v1/models/model:generate")
+        _post(url, {"prompt": [1, 2], "max_new_tokens": 2})  # warm
+        eng = f.replicas[0].engine
+        before = eng.counters.snapshot()["counts"]
+        chaos.arm("net_partition=router:replica-0,for=0.3")
+        t0 = time.monotonic()
+        status, out = _post(url, {"prompt": [5, 6, 7],
+                                  "max_new_tokens": 5})
+        wall = time.monotonic() - t0
+        chaos.disarm()
+        assert status == 200, "zero client-visible failures"
+        assert out["tokens"] == _solo(dec, params, [5, 6, 7], 5)
+        after = eng.counters.snapshot()["counts"]
+        assert after.get("prefills", 0) == before.get("prefills", 0) + 1, \
+            "zero duplicate completions: the retry was absorbed"
+        assert after.get("dedup_hits", 0) >= 1, \
+            "the dedup-hit counter is the proof retries were absorbed"
+        assert wall > 0.25, "the retry waited out the partition"
+        router_counts = f.router.counters.snapshot()["counts"]
+        assert router_counts.get("failovers", 0) >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_partition_flap_cycles_zero_duplicates(lm):
+    """Repeated partition/heal cycles (the bench leg's shape): every
+    cycle's retry is absorbed; completions == requests issued."""
+    dec, params = lm
+    with fleet.ServingFleet(dec, params, replicas=1, name="model",
+                            engine_kw={"slots": 2}) as f:
+        url = f.url("/v1/models/model:generate")
+        _post(url, {"prompt": [1, 2], "max_new_tokens": 2})  # warm
+        eng = f.replicas[0].engine
+        base_prefills = eng.counters.snapshot()["counts"]["prefills"]
+        hits = 0
+        for cycle in range(3):
+            chaos.arm("net_partition=router:replica-0,for=0.25")
+            prompt = [1 + cycle, 2 + cycle, 3 + cycle]
+            status, out = _post(url, {"prompt": prompt,
+                                      "max_new_tokens": 4})
+            assert status == 200
+            assert out["tokens"] == _solo(dec, params, prompt, 4)
+            chaos.disarm()
+        counts = eng.counters.snapshot()["counts"]
+        assert counts["prefills"] == base_prefills + 3, \
+            "every cycle executed exactly once"
+        assert counts.get("dedup_hits", 0) >= 3
+
+
+# -- lease fencing ----------------------------------------------------------
+
+def test_fenced_server_answers_non_retriable_410(lm):
+    eng, server, base = _mk_server(lm, replica_id="replica-f0")
+    try:
+        url = base + "/v1/models/m:generate"
+        server.fence("epoch 1 superseded by 2")
+        code, body = _get_json(base + "/healthz")
+        assert code == 503 and body["status"] == "fenced"
+        try:
+            _post(url, {"prompt": [1], "max_new_tokens": 1})
+            assert False, "fenced server must refuse"
+        except urllib.error.HTTPError as e:
+            assert e.code == 410
+            payload = json.loads(e.read())
+            assert payload["kind"] == "Fenced"
+        assert serving.http_retriable(410) is None, \
+            "410 is NON-retriable at the client"
+        # direct API callers hit the same taxonomy, not just HTTP ones
+        with pytest.raises(serving.Fenced):
+            server.generate({"prompt": [[1]], "max_new_tokens": 1})
+        server.unfence()
+        status, _ = _post(url, {"prompt": [1], "max_new_tokens": 1})
+        assert status == 200
+    finally:
+        server.stop()
+
+
+def test_replica_fenced_after_replacement_registers(lm):
+    """The acceptance pin: a replica whose identity was re-leased (the
+    supervisor-spawned replacement) is fenced on its next beat — its
+    beats stop refreshing the lease and its :generate answers 410 —
+    and only a deliberate re_register restores service."""
+    dec, params = lm
+    resv = reservation.Server(0)
+    addr = resv.start(host="127.0.0.1")
+    eng = serving.DecodeEngine(dec, params, slots=1,
+                               replica_id="replica-fc")
+    server = serving.ModelServer(None, engine=eng, name="m", port=0)
+    replica = fleet.Replica(server, addr, beat_interval=0.05)
+    try:
+        host, port = replica.start()
+        base = "http://{}:{}".format(host, port)
+        assert chaos.poll_until(
+            lambda: "replica-fc" in resv.serving_snapshot(), timeout=10)
+        assert resv.serving_snapshot()["replica-fc"]["epoch"] == 1
+        # the replacement registers for the same identity (the
+        # supervisor's in-process mint — same op Client.lease performs)
+        assert resv.mint_epoch("replica-fc") == 2
+        assert chaos.poll_until(lambda: replica.fenced, timeout=10), \
+            "the incumbent's next beat must fence it"
+        # beats stopped: the lease ages instead of refreshing
+        age0 = resv.serving_snapshot()["replica-fc"]["age"]
+        time.sleep(0.2)
+        assert resv.serving_snapshot()["replica-fc"]["age"] > age0
+        try:
+            _post(base + "/v1/models/m:generate",
+                  {"prompt": [1, 2], "max_new_tokens": 1})
+            assert False, "fenced replica must reject generate"
+        except urllib.error.HTTPError as e:
+            assert e.code == 410
+            assert json.loads(e.read())["kind"] == "Fenced"
+        code, body = _get_json(base + "/healthz")
+        assert code == 503 and body["status"] == "fenced"
+        # deliberate rejoin: fresh epoch, serving resumes
+        replica.re_register()
+        assert chaos.poll_until(
+            lambda: (resv.serving_snapshot().get("replica-fc") or {})
+            .get("epoch") == 3, timeout=10)
+        status, _ = _post(base + "/v1/models/m:generate",
+                          {"prompt": [1, 2], "max_new_tokens": 1})
+        assert status == 200
+    finally:
+        replica.stop()
+        resv.stop()
+
+
+def test_hedge_delay_is_evidence_based():
+    """No hedging without a quantile config; none before min_samples
+    observations; then the configured quantile of the router's own
+    upstream histogram, floored at hedge_min_delay."""
+    off = fleet.FleetRouter(None)
+    assert off._hedge_delay() is None, "hedging defaults OFF"
+    r = fleet.FleetRouter(None, hedge_quantile=0.9,
+                          hedge_min_samples=3, hedge_min_delay=0.05)
+    assert r._hedge_delay() is None, "a cold router never hedges"
+    for _ in range(3):
+        r._hist_upstream.observe(0.2)
+    delay = r._hedge_delay()
+    assert delay is not None and 0.15 <= delay <= 0.3
+    fast = fleet.FleetRouter(None, hedge_quantile=0.9,
+                             hedge_min_samples=1, hedge_min_delay=0.05)
+    fast._hist_upstream.observe(1e-4)
+    assert fast._hedge_delay() == pytest.approx(0.05), \
+        "hedge_min_delay floors a too-eager quantile"
+
+
+@pytest.mark.slow
+def test_hedged_request_beats_gray_replica(lm):
+    """One replica goes GRAY (alive, beating, slow on the wire —
+    net_delay): the hedge fires after the quantile-derived delay, the
+    other replica answers, and the client's wall time is bounded by
+    the hedge path, not the gray link. The same X-TFOS-Request-Id on
+    both attempts keeps the loser harmless."""
+    dec, params = lm
+    with fleet.ServingFleet(
+            dec, params, replicas=2, name="model",
+            engine_kw={"slots": 2},
+            router_kw={"hedge_quantile": 0.95, "hedge_min_samples": 4,
+                       "hedge_min_delay": 0.05}) as f:
+        url = f.url("/v1/models/model:generate")
+        for i in range(6):  # warm both replicas + build latency evidence
+            _post(url, {"prompt": [1 + (i % 3), 2],
+                        "max_new_tokens": 2})
+        assert f.router._hedge_delay() is not None
+        # gray out whichever replica the policy will pick NEXT, so the
+        # primary attempt provably hits the slow link
+        target = fleet.route_order(f.router.replica_views(),
+                                   f.router.stale_after)[0]
+        chaos.arm("net_delay=2.0,only=router:{}".format(target))
+        t0 = time.monotonic()
+        status, out = _post(url, {"prompt": [7, 8, 9],
+                                  "max_new_tokens": 4})
+        wall = time.monotonic() - t0
+        chaos.disarm()
+        assert status == 200
+        assert out["tokens"] == _solo(dec, params, [7, 8, 9], 4)
+        counts = f.router.counters.snapshot()["counts"]
+        assert counts.get("hedges", 0) >= 1, "the hedge must have fired"
+        assert counts.get("hedge_wins", 0) >= 1, \
+            "the hedge attempt must have produced the winning response"
+        assert wall < 1.8, (
+            "the hedged path must answer well inside the gray link's "
+            "2s delay (took {:.2f}s)".format(wall))
+
+
+def test_router_fails_over_from_fenced_replica(lm):
+    """A fenced replica reached by the router yields 410 kind=Fenced;
+    the router treats the REPLICA as unserviceable (health failure +
+    immediate failover) while the client still gets its answer from
+    the live holder."""
+    dec, params = lm
+    with fleet.ServingFleet(dec, params, replicas=2, name="model",
+                            engine_kw={"slots": 2}) as f:
+        url = f.url("/v1/models/model:generate")
+        # make the policy's next pick DETERMINISTIC: warm replica-0's
+        # engine directly (its queue-wait EWMA goes nonzero), wait for
+        # that gauge to ride a beat into the router's view, then fence
+        # replica-1 — the still-zero-EWMA replica the policy now
+        # provably prefers. Its beat keeps running (the lease stays
+        # live), which is exactly the race window: the router still
+        # routes to it and must recover via failover
+        f.replicas[0].engine.generate([1, 2], 2)
+        assert chaos.poll_until(
+            lambda: any(v["replica_id"] == "replica-0"
+                        and v["queue_wait_ewma_s"] > 0
+                        for v in f.router.replica_views()), timeout=10)
+        f.replicas[1].server.fence("stale epoch")
+        for i in range(4):
+            status, out = _post(url, {"prompt": [3 + i, 4],
+                                      "max_new_tokens": 3})
+            assert status == 200, "clients never see the fence"
+        counts = f.router.counters.snapshot()["counts"]
+        assert counts.get("fenced_upstreams", 0) >= 1

@@ -447,6 +447,44 @@ def test_restore_fallback_walks_past_corrupt_latest(tmp_path):
     ckpt.close()
 
 
+def test_restore_fallback_every_step_corrupt_is_terminal(tmp_path):
+    """Satellite (PR 12): when EVERY retained step is corrupt, fallback
+    restore must end in one clear terminal error — naming the root and
+    every step it tried, chaining the first failure — with no crash and
+    no partial mutation of the caller's template state."""
+    import copy
+
+    from tensorflowonspark_tpu import checkpoint
+
+    root = str(tmp_path / "ck")
+    ckpt = checkpoint.Checkpointer(root, chief=True)
+    for step in (1, 2, 3):
+        assert ckpt.save(step, _np_state(step), force=True)
+    ckpt.wait()
+    for step in (1, 2, 3):
+        assert chaos.corrupt_step(root, step) > 0
+    like = _np_state(0)
+    before = copy.deepcopy(like)
+    with pytest.raises(RuntimeError) as exc:
+        ckpt.restore(like, fallback=True)
+    msg = str(exc.value)
+    assert root in msg and "[3, 2, 1]" in msg, \
+        "the terminal error names the root and every step tried"
+    assert exc.value.__cause__ is not None, \
+        "the first restore failure must be chained for diagnosis"
+    # no partial state mutation: the template is untouched, so the
+    # caller can still fall back to cold init
+    assert set(like) == set(before)
+    np.testing.assert_array_equal(like["step"], before["step"])
+    np.testing.assert_array_equal(like["w"], before["w"])
+    # the checkpointer object survives: a later save still works
+    assert ckpt.save(4, _np_state(4), force=True)
+    ckpt.wait()
+    restored = ckpt.restore(_np_state(0), fallback=True)
+    assert int(restored["step"]) == 4
+    ckpt.close()
+
+
 def test_corrupt_checkpoint_injection_point(tmp_path):
     """The armed form: chaos garbles step N the moment save(N) commits —
     the deterministic reproduction of 'writer killed mid-commit'."""

@@ -71,6 +71,76 @@ def test_sort_cluster_info_is_deterministic():
     assert [m["executor_id"] for m in reservation.sort_cluster_info(metas)] == [0, 1, 2]
 
 
+def test_lease_epochs_fence_stale_beats():
+    """PR 12 lease fencing: epochs are minted monotonically per
+    identity; once one exists, only the CURRENT epoch's beats refresh
+    the lease — a superseded holder gets Fenced (non-retriable) and
+    its beat does NOT overwrite the replacement's lease."""
+    server = reservation.Server(1)
+    addr = server.start(host="127.0.0.1")
+    c = reservation.Client(addr)
+    e1 = c.lease("replica-0")
+    assert e1 == 1
+    c.beat("replica-0", {"role": "serving", "tag": "old"}, epoch=e1)
+    assert server.lease_epoch("replica-0") == 1
+    # the replacement registers for the same identity
+    e2 = c.lease("replica-0")
+    assert e2 == 2
+    c.beat("replica-0", {"role": "serving", "tag": "new"}, epoch=e2)
+    with pytest.raises(reservation.Fenced) as exc:
+        c.beat("replica-0", {"role": "serving", "tag": "old"}, epoch=e1)
+    assert exc.value.epoch == 2
+    snap = server.serving_snapshot()["replica-0"]
+    assert snap["epoch"] == 2, "the stale beat must not win the lease"
+    # an epoch-less beat on an epoch'd identity is stale by definition
+    with pytest.raises(reservation.Fenced):
+        c.beat("replica-0", {"role": "serving"})
+    # legacy identities (no epoch ever minted) keep epoch-less beats
+    c.beat("exec-3", {"state": "running"})
+    assert server.lease_snapshot()["exec-3"]["payload"] == {
+        "state": "running"}
+    # a partition scoped to the identity's reservation link catches
+    # LEASE exchanges too: a partitioned replica cannot mint an epoch
+    # through the down link
+    from tensorflowonspark_tpu import chaos
+    chaos.arm("net_partition=replica-0:reservation,for=30")
+    try:
+        with pytest.raises(ConnectionError):
+            c.lease("replica-0")
+    finally:
+        chaos.disarm()
+    c.close()
+    server.stop()
+
+
+def test_recv_deadline_unwedges_half_open_peer():
+    """Satellite: a peer that stalls MID-MESSAGE (half-open TCP) fails
+    its handler within the bounded deadline — while an idle-but-healthy
+    connection (no message in flight) is never bounded."""
+    import socket
+    import time
+
+    server = reservation.Server(1, recv_deadline=0.3)
+    addr = server.start(host="127.0.0.1")
+    # idle is fine: a registered client can sit quiet far longer than
+    # the deadline and still be served afterwards
+    c = reservation.Client(addr)
+    c.beat("e0", {})
+    time.sleep(0.5)
+    c.beat("e0", {})  # connection still alive after idle > deadline
+    # half-open: half a length header, then silence — the server must
+    # abandon the connection in ~deadline, not hold the handler forever
+    raw = socket.create_connection(addr)
+    raw.sendall(b"\x00\x00")
+    t0 = time.monotonic()
+    raw.settimeout(5.0)
+    assert raw.recv(1024) == b"", "server should close the wedged peer"
+    assert 0.2 <= time.monotonic() - t0 < 3.0
+    raw.close()
+    c.close()
+    server.stop()
+
+
 def test_reregistration_replaces_not_duplicates():
     server = reservation.Server(2)
     addr = server.start(host="127.0.0.1")

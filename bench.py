@@ -850,6 +850,136 @@ def _fleet_leg(dec, params, reqs, n_replicas, slots=8, concurrency=None):
         return tokens / wall, quantiles, stats
 
 
+def _autoscale_leg(dec, params, slots=4):
+    """serving_fleet.autoscale (PR 13): offered load ramps up then
+    down against a min=1/max=2 SLO-autoscaled fleet. Published claims:
+    the replica count TRACKS the load (>=1 scale-up during the high
+    plateau, >=1 scale-down back at low load — the scale-down lands
+    UNDER live traffic, so it also pins zero-loss retirement), p99 at
+    every plateau, and zero client-visible failures / zero duplicate
+    completions across every transition. Closed-loop offered load
+    (N workers, each holding one request open) so 'offered load' has
+    one number per plateau."""
+    import concurrent.futures
+    import json as json_mod
+    import math
+    import threading
+    import urllib.request
+
+    from tensorflowonspark_tpu import fleet as fleet_mod
+    from tensorflowonspark_tpu.autoscale import AutoscalePolicy
+
+    policy = AutoscalePolicy(
+        min_replicas=1, max_replicas=2, queue_wait_slo_s=0.25,
+        up_cooldown_s=0.5, down_cooldown_s=2.5, occupancy_low=0.35,
+        dead_after_s=10.0)
+    with fleet_mod.ServingFleet(dec, params, replicas=1,
+                                engine_kw={"slots": slots}) as f:
+        ctl = f.autoscale(policy=policy, interval=0.1)
+        url = f.url("/v1/models/model:generate")
+        responses_by_request = {}
+        resp_lock = threading.Lock()
+
+        def one(req_key, prompt, max_new):
+            body = json_mod.dumps({"prompt": prompt,
+                                   "max_new_tokens": max_new}).encode()
+            http_req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.monotonic()
+            with urllib.request.urlopen(http_req, timeout=600) as r:
+                r.read()
+                status = r.status
+            with resp_lock:
+                responses_by_request[req_key] = \
+                    responses_by_request.get(req_key, 0) + 1
+            return status, time.monotonic() - t0
+
+        trajectory = []
+        stop = threading.Event()
+        t_start = time.monotonic()
+
+        def sampler():
+            while not stop.is_set():
+                trajectory.append(
+                    (round(time.monotonic() - t_start, 2),
+                     len(f.reservation.serving_snapshot())))
+                time.sleep(0.25)
+
+        threading.Thread(target=sampler, daemon=True).start()
+
+        def plateau(name, workers, n_requests):
+            walls, failures = [], 0
+            reqs = [("{}:{}".format(name, i),
+                     [(i % 5) + 1, 2, 3, (i % 3) + 1], 16)
+                    for i in range(n_requests)]
+            lo = len(f.reservation.serving_snapshot())
+            hi = lo
+            with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+                futures = [pool.submit(one, *req) for req in reqs]
+                for fut in futures:
+                    try:
+                        status, wall = fut.result()
+                        if status == 200:
+                            walls.append(wall)
+                        else:
+                            failures += 1
+                    except Exception:  # noqa: BLE001 - counted
+                        failures += 1
+                    n = len(f.reservation.serving_snapshot())
+                    lo, hi = min(lo, n), max(hi, n)
+            p99 = None
+            if walls:
+                # ceil-rank (the worst request is IN the p99 at n<=100)
+                p99 = sorted(walls)[min(len(walls) - 1,
+                                        int(math.ceil(
+                                            0.99 * len(walls))) - 1)]
+            return {"plateau": name, "workers": workers,
+                    "requests": n_requests, "failures": failures,
+                    "p99_ms": round(p99 * 1e3, 1)
+                    if p99 is not None else None,
+                    "replicas_range": [lo, hi],
+                    "replicas_end":
+                        len(f.reservation.serving_snapshot())}
+
+        phases = [plateau("low_1", 2, 10),
+                  plateau("high", 12, 36),
+                  plateau("low_2", 2, 14)]
+        # trail low-rate traffic until the scale-down lands (bounded):
+        # the retirement must happen UNDER load to pin zero loss
+        deadline = time.monotonic() + 25.0
+        tail_reqs = 0
+        while time.monotonic() < deadline and ctl.counters.snapshot()[
+                "counts"].get("scale_downs", 0) < 1:
+            one("tail:{}".format(tail_reqs), [1, 2, 3], 8)
+            tail_reqs += 1
+            time.sleep(0.2)
+        stop.set()
+        counts = ctl.counters.snapshot()["counts"]
+        down_events = ctl.events.events("autoscale_scaled_down")
+        duplicates = sum(n - 1 for n in responses_by_request.values()
+                         if n > 1)
+        # compact the trajectory: keep points where the count changes
+        # (plus endpoints) so the artifact stays readable
+        compact = [pt for i, pt in enumerate(trajectory)
+                   if i in (0, len(trajectory) - 1)
+                   or trajectory[i - 1][1] != pt[1]]
+        return {
+            "policy": {"min": 1, "max": 2,
+                       "queue_wait_slo_s": policy.queue_wait_slo_s,
+                       "down_cooldown_s": policy.down_cooldown_s},
+            "phases": phases,
+            "tail_requests": tail_reqs,
+            "scale_ups": counts.get("scale_ups", 0),
+            "scale_downs": counts.get("scale_downs", 0),
+            "scale_down_drained_clean":
+                bool(down_events and down_events[-1]["drained_clean"]),
+            "failures": sum(p["failures"] for p in phases),
+            "duplicate_completions": duplicates,
+            "replica_trajectory": compact,
+        }
+
+
 def _serving_fleet_bench(on_tpu, replica_counts=(1, 2, 4)):
     """Aggregate serving throughput at 1 vs 2 vs 4 router-fronted
     replicas on the shared mixed-length workload. Returns the
@@ -894,6 +1024,16 @@ def _serving_fleet_bench(on_tpu, replica_counts=(1, 2, 4)):
         if n > 1 and base and by_replicas.get(n):
             block["scaling_{}x".format(n)] = round(
                 by_replicas[n] / base, 2)
+    # autoscale load-ramp leg (PR 13): replica count tracks offered
+    # load between min=1/max=2 with zero failures at every transition.
+    # TFOS_BENCH_AUTOSCALE=0 skips just this leg.
+    if os.environ.get("TFOS_BENCH_AUTOSCALE", "1") == "1":
+        try:
+            block["autoscale"] = _autoscale_leg(dec, params)
+        except Exception as e:  # noqa: BLE001 - report, not die
+            print("serving_fleet.autoscale failed: {}".format(e),
+                  file=sys.stderr)
+            block["autoscale"] = {"error": str(e)}
     return block
 
 

@@ -47,6 +47,12 @@ def main(argv=None):
                          "an R-replica serving fleet (fleet.ServingFleet "
                          "router), with the shared serving.retry_call "
                          "client retry policy, and report solo-parity")
+    ap.add_argument("--executors", type=int, default=0, metavar="N",
+                    help="with --fleet: host the replicas INSIDE N "
+                         "engine executor processes (the PR 13 "
+                         "executor-role serving bootstrap) instead of "
+                         "the driver — the demo prints each replica's "
+                         "executor + pid so the placement is visible")
     ap.add_argument("--out", default=None,
                     help="write {loss, prompt, generated} JSON here")
     args = ap.parse_args(argv)
@@ -157,9 +163,34 @@ def main(argv=None):
             start = int(rs.randint(0, args.period))
             reqs.append(([(start + i) % args.period for i in range(n)],
                          int(rs.randint(2, args.seq_len))))
+        sc = None
+        fleet_kw = {}
+        if args.executors:
+            # executor-hosted path (PR 13): replicas bootstrap inside
+            # executor processes and register their real HTTP addrs
+            # over BEAT; the router routes to them unchanged
+            if args.executors < args.fleet:
+                raise SystemExit(
+                    "--executors {} < --fleet {}: each replica needs "
+                    "its own executor".format(args.executors,
+                                              args.fleet))
+            from tensorflowonspark_tpu.engine.context import Context
+            sc = Context(args.executors, executor_env={
+                "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", ""),
+                "PALLAS_AXON_POOL_IPS": ""})
+            fleet_kw = dict(placement="executors", sc=sc,
+                            spawn_timeout=300)
         fl = cluster.serving_fleet(dec, params, replicas=args.fleet,
-                                   name="lm", engine_kw={"slots": 4})
+                                   name="lm", engine_kw={"slots": 4},
+                                   **fleet_kw)
         try:
+            if args.executors:
+                placement = {
+                    rid: info.get("host")
+                    for rid, info in
+                    fl.reservation.serving_snapshot().items()}
+                print("placement", placement,
+                      "(driver pid {})".format(os.getpid()))
             url = fl.url("/v1/models/lm:generate")
 
             def post(payload):
@@ -204,6 +235,8 @@ def main(argv=None):
             print("fleet    ", fleet_stats)
         finally:
             fl.stop()
+            if sc is not None:
+                sc.stop()
         if fleet_stats["solo_mismatches"]:
             raise SystemExit(
                 "fleet-served outputs diverged from solo generate")

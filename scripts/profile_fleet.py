@@ -35,6 +35,58 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _spawn_to_first_token(dec, params, slots, executors):
+    """Time the autoscaler's capacity-add latency: a 1-replica fleet
+    calls ``spawn_replica()`` (what a scale-up does — bootstrap +
+    lease + wire-verified healthz) and the new replica is then asked
+    for ONE token directly, so ``spawn_to_first_token_s`` is the wall
+    from the scale decision to the first token the added capacity
+    could serve. ``executors`` > 0 hosts the fleet on engine executors
+    and times the EXECUTOR-side spawn (task dispatch + jax import +
+    engine build in a fresh process — the honest number for
+    placement='executors'); 0 times the driver-local spawn (programs
+    shared, so this is the floor)."""
+    import time as time_mod
+    import urllib.request
+
+    from tensorflowonspark_tpu import fleet as fleet_mod
+
+    sc = None
+    kw = {}
+    if executors:
+        from tensorflowonspark_tpu.engine.context import Context
+        sc = Context(executors, executor_env={
+            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+            "PALLAS_AXON_POOL_IPS": ""})
+        kw = dict(placement="executors", sc=sc, spawn_timeout=300)
+    f = fleet_mod.ServingFleet(dec, params, replicas=1,
+                               engine_kw={"slots": slots}, **kw)
+    try:
+        f.start()
+        t0 = time_mod.monotonic()
+        replica = f.spawn_replica()
+        spawn_s = time_mod.monotonic() - t0
+        addr = replica.addr
+        body = json.dumps({"prompt": [1, 2, 3],
+                           "max_new_tokens": 1}).encode()
+        req = urllib.request.Request(
+            "http://{}:{}/v1/models/model:generate".format(*addr),
+            data=body, headers={"Content-Type": "application/json"})
+        t1 = time_mod.monotonic()
+        with urllib.request.urlopen(req, timeout=600) as r:
+            r.read()
+        first_token_s = time_mod.monotonic() - t1
+        return {"placement": "executors" if executors else "driver",
+                "spawn_s": round(spawn_s, 3),
+                "first_token_s": round(first_token_s, 3),
+                "spawn_to_first_token_s": round(
+                    spawn_s + first_token_s, 3)}
+    finally:
+        f.stop()
+        if sc is not None:
+            sc.stop()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--replicas", type=int, default=2)
@@ -47,6 +99,15 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--json", action="store_true",
                     help="print one JSON blob instead of the table")
+    ap.add_argument("--spawn", action="store_true",
+                    help="additionally time spawn-to-first-token for "
+                         "a scale-up replica (the autoscaler's "
+                         "capacity-add latency)")
+    ap.add_argument("--executors", type=int, default=0,
+                    help="with --spawn: host the fleet on N engine "
+                         "executors and time the EXECUTOR-side spawn "
+                         "(bootstrap task + engine build + lease + "
+                         "healthz); 0 = driver-local spawn")
     args = ap.parse_args(argv)
     if args.total_len < 16:
         ap.error("--total-len must be >= 16 (the mixed workload draws "
@@ -81,6 +142,9 @@ def main(argv=None):
                       "total_new_tokens": sum(mn for _, mn in reqs)},
            "tokens_per_sec": round(tps, 1),
            "request": quantiles, **stats}
+    if args.spawn:
+        out["spawn"] = _spawn_to_first_token(dec, params, args.slots,
+                                             args.executors)
 
     if args.json:
         print(json.dumps(out))
@@ -98,6 +162,12 @@ def main(argv=None):
         out["stage_ms"]))
     print("  failovers: {}  no_replica: {}".format(
         out["failovers"], out["no_replica"]))
+    if args.spawn:
+        print("  spawn-to-first-token ({}): spawn {}s + first token "
+              "{}s = {}s".format(
+                  out["spawn"]["placement"], out["spawn"]["spawn_s"],
+                  out["spawn"]["first_token_s"],
+                  out["spawn"]["spawn_to_first_token_s"]))
 
 
 if __name__ == "__main__":

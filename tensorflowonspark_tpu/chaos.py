@@ -129,6 +129,15 @@ testable):
   emitted tokens is cancelled as if its client disconnected
   mid-stream; the step-boundary slot-free path is the behavior under
   test.
+- ``kill_serving_executor_at_request=K,only=<replica_id>,fuse=PATH`` —
+  whole-EXECUTOR loss on the serving plane (PR 13): once the scoped
+  replica's engine has seen K requests submitted, SIGKILL the executor
+  process hosting it (the engine runs IN the executor for
+  executor-hosted fleets). The lease expires, the router down-marks,
+  and the autoscaler's replacement spawn is the recovery under test.
+  ``fuse`` is mandatory (the replacement replica inherits the victim's
+  replica_id AND the armed executor_env spec); pair with
+  :func:`schedule_executor_return` for deterministic capacity return.
 
 Every fire is logged loudly. All checks are O(1) dict lookups when
 nothing is armed, so instrumented sites cost nothing in production.
@@ -153,7 +162,8 @@ POINTS = ("kill_trainer_at_step", "kill_trainer_at_batch",
           "kill_trainer_when_queued", "stall_consumer_for",
           "stall_ring_slot", "drop_heartbeats_for", "corrupt_checkpoint",
           "kill_scheduler_at_step", "stall_decode_for",
-          "disconnect_client_at_token", "drop_executor_then_return_after"
+          "disconnect_client_at_token", "drop_executor_then_return_after",
+          "kill_serving_executor_at_request"
           ) + NET_POINTS
 
 
@@ -348,6 +358,17 @@ def parse_spec(spec):
             raise ValueError(
                 "chaos fields for=/seed= only apply to net points "
                 "({}), not {}".format(", ".join(NET_POINTS), point))
+        if point == "kill_serving_executor_at_request" and not fuse:
+            # same load-bearing fuse as drop_executor: the spec rides
+            # executor_env into EVERY executor incarnation, and the
+            # autoscaler's replacement replica keeps the victim's
+            # replica_id — without a fuse the replacement (or a revived
+            # executor) re-fires at the same request count forever
+            raise ValueError(
+                "kill_serving_executor_at_request requires fuse=PATH "
+                "(the kill must be single-shot across executor "
+                "incarnations — the replacement replica inherits both "
+                "the armed spec and the victim's replica_id)")
         if point == "drop_executor_then_return_after" and not fuse:
             # the fuse is load-bearing here, not just single-shot
             # bookkeeping: the spec rides executor_env into every
@@ -518,6 +539,32 @@ def on_decode_step(steps_done, ident=None):
                      "scheduler", steps_done, inj.value, ident)
         raise SchedulerKilled(
             "chaos: decode scheduler killed at step {}".format(steps_done))
+
+
+def on_serving_request(requests_seen, ident=None):
+    """Serving-admission site (serving.DecodeEngine._submit_many),
+    called with the cumulative number of requests this engine has seen
+    submitted. ``kill_serving_executor_at_request=K,only=<replica_id>``
+    SIGKILLs the WHOLE executor process hosting the replica once the
+    K-th request arrives — executor loss at a deterministic point in
+    the serving stream, the signature the autoscaler's replacement path
+    (lease expiry -> router down-mark -> replacement spawn) recovers
+    from. Refuses outside an executor-hosted serving node: the process
+    about to die must actually BE an executor (node.serve_replica sets
+    the marker env), not a driver-placement test process that merely
+    armed the spec."""
+    inj = armed("kill_serving_executor_at_request", ident)
+    if inj is None or requests_seen < inj.value:
+        return
+    if os.environ.get("TFOS_SERVING_EXECUTOR_ID") is None:
+        raise RuntimeError(
+            "kill_serving_executor_at_request can only fire inside an "
+            "executor-hosted serving node (node.serve_replica sets "
+            "TFOS_SERVING_EXECUTOR_ID); this process is not one — "
+            "scope the injection with only=<replica_id> or arm it via "
+            "executor_env")
+    _kill_self(inj, "serving request %d >= %g on replica %s"
+               % (requests_seen, inj.value, ident))
 
 
 def on_token(tokens_emitted):

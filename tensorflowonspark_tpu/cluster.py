@@ -403,17 +403,37 @@ def run(sc, map_fun, tf_args, num_executors, num_ps=0, tensorboard=False,
 
 
 def serving_fleet(model, params, replicas=2, name="model",
-                  supervise=False, restart=None, **fleet_kw):
-    """Construct and START an in-process serving fleet (PR 6): N
+                  supervise=False, restart=None, placement="driver",
+                  sc=None, autoscale=None, **fleet_kw):
+    """Construct and START a serving fleet (PR 6 / PR 13): N
     continuous-batching ``DecodeEngine`` replicas behind their own
     ``ModelServer``s, registered with a fresh reservation server via
     BEAT leases, fronted by a least-loaded ``fleet.FleetRouter`` —
     the serving-plane analog of :func:`run`'s one-call cluster
-    formation. ``supervise=True`` additionally arms the recovery loop
-    (``Supervisor.watch_fleet``: dead replica -> router quiesced ->
-    RestartEngine respawn -> readmit; ``restart`` overrides the
-    policy). Returns the started ``fleet.ServingFleet`` (a context
-    manager — ``with`` it, or call ``stop()``)::
+    formation.
+
+    ``placement`` (PR 13) says WHERE replicas live: ``"driver"`` (the
+    default, all replicas in this process — PR 6's shape) or
+    ``"executors"`` — each replica bootstraps INSIDE an executor
+    process via a ``cluster.run``-style ``role: "serving"`` map_fun
+    (``node.serve_replica``), registering its real HTTP address over
+    the same BEAT lease; ``sc`` (an engine Context) is required there.
+    The router surface is identical either way.
+
+    ``supervise=True`` additionally arms the recovery loop
+    (``Supervisor.watch_fleet`` for in-process replicas: dead replica
+    -> router quiesced -> RestartEngine respawn -> readmit;
+    ``Supervisor.watch_serving`` lease classification for
+    executor-hosted ones; ``restart`` overrides the policy).
+
+    ``autoscale`` (an ``autoscale.AutoscalePolicy``, or True for the
+    defaults) arms the SLO-driven controller: replica count then
+    TRACKS offered load between the policy's min/max — scale-up on
+    queue-wait/TTFT breaches onto free executors, zero-loss
+    drain-retirement when idle, fenced replacement of dead replicas.
+
+    Returns the started ``fleet.ServingFleet`` (a context manager —
+    ``with`` it, or call ``stop()``)::
 
         f = cluster.serving_fleet(dec_model, params, replicas=3,
                                   supervise=True)
@@ -422,12 +442,16 @@ def serving_fleet(model, params, replicas=2, name="model",
         f.stop()
 
     Extra ``fleet_kw`` (``engine_kw``, ``beat_interval``,
-    ``router_kw``, ...) pass through to ``fleet.ServingFleet``."""
+    ``router_kw``, ``executors``, ``spawn_timeout``, ...) pass through
+    to ``fleet.ServingFleet``."""
     from tensorflowonspark_tpu import fleet as fleet_mod
 
     f = fleet_mod.ServingFleet(model, params, replicas=replicas,
-                               name=name, **fleet_kw)
+                               name=name, placement=placement, sc=sc,
+                               **fleet_kw)
     f.start()
     if supervise:
         f.supervise(restart=restart)
+    if autoscale is not None and autoscale is not False:
+        f.autoscale(policy=None if autoscale is True else autoscale)
     return f

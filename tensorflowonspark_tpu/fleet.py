@@ -53,6 +53,7 @@ In-process quickstart (the shape ``cluster.serving_fleet`` wraps)::
 import http.client
 import json
 import logging
+import os
 import socket
 import threading
 import time
@@ -259,6 +260,13 @@ class ReplicaHealth(object):
                 rec.update(fails=0, downs=0, down_until=None)
         logger.info("replica %s hold released by %s", rid, owner)
 
+    def forget(self, rid):
+        """Drop every record of ``rid`` — a RETIRED replica (autoscale
+        scale-down) must not leave failure state behind that would
+        prejudice a future replica reusing the id."""
+        with self._lock:
+            self._r.pop(str(rid), None)
+
     def known(self):
         with self._lock:
             return list(self._r)
@@ -277,7 +285,12 @@ class Replica(object):
     an ``attach_engine`` swap (supervisor restart, rolling drain) is
     picked up on the next beat."""
 
-    def __init__(self, server, reservation_addr, beat_interval=0.25):
+    #: location marker: in-process Replica agents are driver-local;
+    #: RemoteReplica handles (executor-hosted, PR 13) override this
+    remote = False
+
+    def __init__(self, server, reservation_addr, beat_interval=0.25,
+                 host_meta=None):
         self.server = server
         self.reservation_addr = tuple(reservation_addr)
         self.beat_interval = float(beat_interval)
@@ -287,6 +300,11 @@ class Replica(object):
                 "fleet replicas need a replica identity: mount an "
                 "engine (its replica_id is the default) or pass "
                 "ModelServer(replica_id=...)")
+        #: {"executor": id, "pid": n} for executor-hosted replicas —
+        #: rides every beat so the driver can join replica_id to the
+        #: process actually serving it (the autoscaler's placement
+        #: ledger and the pids-differ-from-driver acceptance pin)
+        self.host_meta = dict(host_meta) if host_meta else None
         self.addr = None
         #: lease fencing (PR 12): the epoch minted by the reservation
         #: server for THIS incarnation of the identity; every beat
@@ -320,6 +338,8 @@ class Replica(object):
         payload = {"role": "serving", "replica_id": self.replica_id,
                    "addr": list(self.addr), "model": self.server.name,
                    "state": "serving"}
+        if self.host_meta is not None:
+            payload["host"] = self.host_meta
         if engine is not None:
             payload["serving"] = engine.load_stats()
             payload["metrics"] = engine.metrics.snapshot()
@@ -371,6 +391,38 @@ class Replica(object):
                     self._client = None
             self._stop.wait(self.beat_interval)
 
+    # -- lifecycle (shared verbs: rolling_drain / retirement call these
+    # on in-process Replicas and RemoteReplicas alike) ---------------------
+
+    def drain_engine(self, timeout=None):
+        """Zero-loss drain of the CURRENT engine (every admitted
+        request finishes; the engine ends stopped, the server stays
+        up); returns the engine's clean-drain verdict. Raises
+        RuntimeError when no engine is mounted (a stopped server
+        mid-cycle has nothing to drain OR rebuild from — the caller
+        must abort, not guess)."""
+        engine = self.server.engine
+        if engine is None:
+            raise RuntimeError(
+                "replica {} has no mounted engine to drain".format(
+                    self.replica_id))
+        return engine.drain(timeout=timeout)
+
+    def respawn_engine(self, upgrade=None):
+        """Build and attach the drained engine's successor:
+        ``upgrade(old) -> new`` when given (a weight swap), else
+        ``old.respawn()`` (same construction config, shared metrics).
+        ``attach_engine`` clears the unhealthy mark, so /healthz
+        recovers once the fresh scheduler is up."""
+        old = self.server.engine
+        if old is None:
+            raise RuntimeError(
+                "replica {} has no engine to respawn from".format(
+                    self.replica_id))
+        fresh = upgrade(old) if upgrade is not None else old.respawn()
+        self.server.attach_engine(fresh)
+        return fresh
+
     def re_register(self):
         """Deliberately rejoin the fleet after being fenced: mint a
         FRESH lease epoch (superseding whoever fenced us — the caller
@@ -402,6 +454,206 @@ class Replica(object):
                 pass
             self._client = None
         self.server.stop()
+
+
+class ServingNode(object):
+    """One EXECUTOR-HOSTED serving replica: DecodeEngine + ModelServer
+    + :class:`Replica` beat agent, built inside the executor process
+    from a driver-shipped spec (PR 13 — the paper's ``TFCluster.run``
+    executor-role bootstrap applied to serving). The node also mounts
+    the remote lifecycle RPCs (``POST /admin/drain|respawn|
+    re_register|stop``) on its own HTTP server — rolling drains,
+    autoscale retirement, and fence recovery need a transport to an
+    executor-hosted replica, and the replica's server IS it.
+
+    ``spec`` (a plain picklable dict, shipped inside the
+    ``node.serve_replica`` closure):
+
+    - ``replica_id`` / ``name`` — serving identity + model name
+    - ``model`` / ``params`` — the decode-mode module and host-side
+      (numpy) params; OR ``builder``, a zero-arg callable returning
+      ``(model, params)`` (load from a checkpoint/export path on the
+      executor instead of shipping weights over the task wire)
+    - ``engine_kw`` — DecodeEngine knobs (slots, kv paging,
+      ``attn_impl``, ...) — the spawn config rides here verbatim
+    - ``reservation_addr`` / ``beat_interval`` — the driver's BEAT
+      registry and cadence
+    """
+
+    def __init__(self, spec, executor_id=None, host=None):
+        self.spec = dict(spec)
+        self.replica_id = str(self.spec["replica_id"])
+        self.executor_id = executor_id
+        self.host = host or "127.0.0.1"
+        self.replica = None
+        self.server = None
+
+    def start(self):
+        from tensorflowonspark_tpu.serving import DecodeEngine, \
+            ModelServer
+
+        spec = self.spec
+        builder = spec.get("builder")
+        if builder is not None:
+            model, params = builder()
+        else:
+            model, params = spec["model"], spec["params"]
+        kw = dict(spec.get("engine_kw") or {})
+        kw.setdefault("flight", tracing.FlightRecorder())
+        engine = DecodeEngine(model, params,
+                              replica_id=self.replica_id, **kw)
+        try:
+            self.server = ModelServer(None, engine=engine,
+                                      name=spec.get("name", "model"),
+                                      host=self.host, port=0)
+            self.replica = Replica(
+                self.server, tuple(spec["reservation_addr"]),
+                beat_interval=float(spec.get("beat_interval", 0.25)),
+                host_meta={"executor": self.executor_id,
+                           "pid": os.getpid()})
+        except BaseException:
+            engine.stop()
+            raise
+        self.server.register_admin("drain", self._rpc_drain)
+        self.server.register_admin("respawn", self._rpc_respawn)
+        self.server.register_admin("re_register", self._rpc_re_register)
+        self.server.register_admin("stop", self._rpc_stop)
+        addr = self.replica.start()
+        logger.info("serving node %s up on %s:%d (executor %s, pid %d)",
+                    self.replica_id, addr[0], addr[1], self.executor_id,
+                    os.getpid())
+        return addr
+
+    # -- admin RPC handlers (run on the replica's HTTP threads) ------------
+
+    def _rpc_drain(self, payload):
+        timeout = payload.get("timeout")
+        clean = self.replica.drain_engine(
+            timeout=None if timeout is None else float(timeout))
+        return {"replica_id": self.replica_id, "clean": bool(clean)}
+
+    def _rpc_respawn(self, payload):
+        old = self.server.engine
+        if old is not None:
+            old.stop()
+        fresh = self.replica.respawn_engine()
+        return {"replica_id": self.replica_id,
+                "attn_impl": fresh.attn_impl, "ok": True}
+
+    def _rpc_re_register(self, payload):
+        self.replica.re_register()
+        return {"replica_id": self.replica_id, "ok": True}
+
+    def _rpc_stop(self, payload):
+        # respond FIRST, then tear down: stop() closes the very HTTP
+        # server this handler is answering through, and the driver's
+        # bounded-deadline RPC must see its 200 rather than a reset
+        timer = threading.Timer(0.2, self.stop)
+        timer.daemon = True
+        timer.start()
+        return {"replica_id": self.replica_id, "stopping": True}
+
+    def stop(self):
+        if self.replica is not None:
+            self.replica.stop()  # beat thread + server + engine
+        elif self.server is not None:
+            self.server.stop()
+
+
+class RemoteReplica(object):
+    """Driver-side handle to an executor-hosted replica: same lifecycle
+    verbs as the in-process :class:`Replica` (``drain_engine`` /
+    ``respawn_engine`` / ``re_register`` / ``stop``), each a bounded
+    ``POST /admin/<verb>`` against the replica's own HTTP server at its
+    lease-advertised address. Routing never goes through this object —
+    the router reads addresses straight off the BEAT snapshot — so the
+    handle exists purely for lifecycle (rolling drain, autoscale
+    retirement, fence recovery) and placement bookkeeping
+    (``executor_id``)."""
+
+    remote = True
+
+    def __init__(self, replica_id, reservation_server, executor_id=None,
+                 admin_timeout=30.0, connect_timeout=3.0):
+        self.replica_id = str(replica_id)
+        self.reservation = reservation_server
+        self.executor_id = executor_id
+        self.admin_timeout = float(admin_timeout)
+        self.connect_timeout = float(connect_timeout)
+
+    @property
+    def addr(self):
+        """The replica's CURRENT lease-advertised address (a
+        replacement spawned under the same identity moves it); None
+        once the lease is gone."""
+        info = self.reservation.serving_snapshot().get(self.replica_id)
+        addr = (info or {}).get("addr")
+        return tuple(addr) if addr else None
+
+    @property
+    def engine(self):
+        """Executor-hosted engines have no driver-side object; the
+        None is the marker in-process code paths branch on."""
+        return None
+
+    def _admin(self, verb, body=None, timeout=None):
+        addr = self.addr
+        if addr is None:
+            raise RuntimeError(
+                "replica {} has no live lease (no address to reach "
+                "its admin surface)".format(self.replica_id))
+        status, raw, _ = _http_request(
+            addr, "POST", "/admin/{}".format(verb),
+            body=json.dumps(body or {}).encode(),
+            timeout=timeout if timeout is not None else self.admin_timeout,
+            connect_timeout=self.connect_timeout,
+            net_src="driver", net_dst=self.replica_id)
+        try:
+            parsed = json.loads(raw)
+        except ValueError:
+            parsed = {}
+        if status != 200:
+            raise RuntimeError(
+                "admin {} on replica {} answered {}: {}".format(
+                    verb, self.replica_id, status,
+                    parsed.get("error", raw[:200])))
+        return parsed
+
+    def drain_engine(self, timeout=None):
+        # the RPC read deadline must outlast the drain itself; an
+        # unbounded (None) drain gets a 600s read cap — the drain
+        # still completes server-side past it, only the verdict is
+        # lost (and reported as unclean)
+        rpc_timeout = 600.0 if timeout is None \
+            else float(timeout) + self.admin_timeout
+        out = self._admin("drain", {"timeout": timeout},
+                          timeout=rpc_timeout)
+        return bool(out.get("clean"))
+
+    def respawn_engine(self, upgrade=None):
+        if upgrade is not None:
+            raise NotImplementedError(
+                "upgrade= callables cannot cross the process boundary "
+                "to an executor-hosted replica; ship new weights via a "
+                "respawn-from-checkpoint spec instead")
+        return self._admin("respawn")
+
+    def re_register(self):
+        return self._admin("re_register")
+
+    def stop(self, timeout=10.0):
+        """Remote teardown with a bounded deadline; best-effort — a
+        dead executor's replica needs no stopping, and stop() must
+        never hang a fleet teardown on a corpse. Returns True when the
+        replica acknowledged."""
+        try:
+            self._admin("stop", timeout=timeout)
+            return True
+        except (OSError, RuntimeError,
+                http.client.HTTPException) as e:
+            logger.info("remote stop of replica %s best-effort "
+                        "failed: %s", self.replica_id, e)
+            return False
 
 
 # -- router ----------------------------------------------------------------
@@ -1128,6 +1380,19 @@ class FleetRouter(object):
             for v in views:
                 lines.append('{}{{replica="{}"}} {}'.format(
                     family, v["replica_id"], tracing._fmt(key(v))))
+        # replica_id -> executor join (PR 13): which executor hosts
+        # each replica, from the beat-carried host metadata — the
+        # info-pattern gauge an operator joins autoscale decisions and
+        # per-replica series against (absent for driver-local replicas)
+        hosted = [(rid, snapshot[rid]["host"]) for rid in sorted(snapshot)
+                  if snapshot[rid].get("host")]
+        if hosted:
+            lines.append("# TYPE tfos_serving_replica_host gauge")
+            for rid, host in hosted:
+                lines.append(
+                    'tfos_serving_replica_host{{replica_id="{}",'
+                    'executor="{}"}} 1'.format(rid,
+                                               host.get("executor")))
         labeled = [((), self.metrics.snapshot())]
         for rid in sorted(snapshot):
             m = snapshot[rid].get("metrics")
@@ -1198,15 +1463,18 @@ class FleetRouter(object):
 
     def rolling_drain(self, upgrade=None, drain_timeout=None,
                       healthz_timeout=30.0):
-        """Zero-downtime engine upgrade across the in-process fleet,
-        one replica at a time: quiesce (this router stops routing new
-        work to it) -> ``engine.drain()`` (every admitted request
-        finishes — the PR 4 zero-loss contract) -> build the successor
-        (``upgrade(old_engine)`` -> new engine, e.g. same config with
-        fresh weights; default ``old.respawn()``) -> ``server.
-        attach_engine`` -> wait for ``GET /healthz`` to answer 200
-        over the wire -> readmit. Traffic keeps flowing through the
-        remaining replicas for the whole cycle.
+        """Zero-downtime engine upgrade across the fleet, one replica
+        at a time: quiesce (this router stops routing new work to it)
+        -> drain (every admitted request finishes — the PR 4 zero-loss
+        contract) -> build the successor (``upgrade(old_engine)`` ->
+        new engine, e.g. same config with fresh weights; default
+        ``respawn()``) -> re-arm -> wait for ``GET /healthz`` to
+        answer 200 over the wire -> readmit. Traffic keeps flowing
+        through the remaining replicas for the whole cycle. Works over
+        in-process Replica agents AND executor-hosted RemoteReplicas —
+        both speak the same ``drain_engine``/``respawn_engine`` verbs
+        (remotely those are the /admin lifecycle RPCs); ``upgrade=``
+        callables are in-process only.
 
         Returns a report dict: per-replica ``{replica_id,
         drained_clean, recovered, wall_s}`` plus ``zero_loss`` (every
@@ -1216,30 +1484,36 @@ class FleetRouter(object):
         failed upgrade degrades capacity by exactly one replica)."""
         if not self.replicas:
             raise RuntimeError(
-                "rolling_drain needs in-process Replica objects "
-                "(router constructed with replicas=[...])")
+                "rolling_drain needs Replica handles (router "
+                "constructed with replicas=[...])")
+        if upgrade is not None and any(getattr(r, "remote", False)
+                                       for r in self.replicas):
+            # refuse UP FRONT: discovering this on the first remote
+            # respawn would already have drained (and stopped) that
+            # replica's engine for nothing
+            raise NotImplementedError(
+                "rolling_drain(upgrade=...) cannot cross the process "
+                "boundary to executor-hosted replicas; ship new "
+                "weights via a respawn-from-checkpoint spec instead")
         report = {"replicas": [], "zero_loss": True, "completed": True}
-        for replica in self.replicas:
+        for replica in list(self.replicas):
             rid = replica.replica_id
             t0 = time.monotonic()
             self.quiesce(rid, "rolling drain", owner="rolling-drain")
-            old = replica.engine
-            if old is None:
-                # stopped server mid-cycle: nothing to drain OR rebuild
-                # from — abort rather than guess at a successor
-                report["replicas"].append(
-                    {"replica_id": rid, "drained_clean": False,
-                     "recovered": False,
-                     "wall_s": round(time.monotonic() - t0, 3)})
-                report["zero_loss"] = False
-                report["completed"] = False
-                break
-            clean = old.drain(timeout=drain_timeout)
-            fresh = upgrade(old) if upgrade is not None \
-                else old.respawn()
-            replica.server.attach_engine(fresh)
-            recovered = self._await_healthz(replica.addr,
-                                            healthz_timeout)
+            clean = recovered = False
+            try:
+                clean = replica.drain_engine(timeout=drain_timeout)
+                replica.respawn_engine(upgrade=upgrade)
+            except (RuntimeError, OSError,
+                    http.client.HTTPException) as e:
+                # stopped server mid-cycle / unreachable executor:
+                # nothing to drain OR rebuild from — abort rather than
+                # guess at a successor (replica left quiesced)
+                logger.error("rolling drain of replica %s failed: %s",
+                             rid, e)
+            else:
+                recovered = self._await_healthz(replica.addr,
+                                                healthz_timeout)
             if recovered:
                 self.readmit(rid, owner="rolling-drain")
             wall = time.monotonic() - t0
@@ -1258,6 +1532,8 @@ class FleetRouter(object):
 
     @staticmethod
     def _await_healthz(addr, timeout):
+        if not addr:
+            return False
         deadline = time.monotonic() + float(timeout)
         while time.monotonic() < deadline:
             try:
@@ -1396,27 +1672,54 @@ class FleetRouter(object):
         self.stop()
 
 
-# -- in-process fleet ------------------------------------------------------
+# -- fleet (driver-local or executor-hosted replicas) ----------------------
+
+class NoCapacity(RuntimeError):
+    """spawn_replica found no free executor to place a replica on —
+    the autoscaler's evidence-gated "capacity exists" check failed
+    (the regrow-probe pattern: scale-up waits for capacity, it never
+    invents it)."""
+
 
 class ServingFleet(object):
-    """N in-process serving replicas + reservation registry + router,
-    wired and lifecycle-managed as one object (the shape the fleet
-    bench, the chaos e2e, and ``cluster.serving_fleet`` use; a
-    multi-host fleet runs the same :class:`Replica` agents pointed at
-    the driver's reservation address and the same router on the
-    driver).
+    """N serving replicas + reservation registry + router, wired and
+    lifecycle-managed as one object (the shape the fleet bench, the
+    chaos e2e, and ``cluster.serving_fleet`` use).
 
-    Each replica is a ``DecodeEngine`` (``replica-<i>`` identity,
-    shared ``model``/``params``, per-replica ``engine_kw``) behind its
-    own ``ModelServer`` on an ephemeral port. ``start()`` blocks until
-    every replica's first BEAT lease is live, so the router can route
-    the moment it returns."""
+    ``placement="driver"`` (default): every replica is a
+    ``DecodeEngine`` in THIS process (``replica-<i>`` identity, shared
+    ``model``/``params``) behind its own ``ModelServer`` on an
+    ephemeral port — the PR 6 shape.
+
+    ``placement="executors"`` (PR 13): replicas run INSIDE executor
+    processes — ``sc`` (an engine :class:`~tensorflowonspark_tpu
+    .engine.context.Context`) ships a ``node.serve_replica`` bootstrap
+    task per chosen executor, the executor-side :class:`ServingNode`
+    builds the engine+server there and registers over the SAME BEAT
+    lease with its real HTTP address, and the router routes to it
+    exactly as it does to in-process replicas (dispatch is
+    address-based). Fleet width stops being bounded by one process;
+    :meth:`spawn_replica` / :meth:`retire_replica` /
+    :meth:`replace_replica` make it dynamic (the autoscaler's verbs).
+
+    ``start()`` blocks until every replica's first BEAT lease is live,
+    so the router can route the moment it returns."""
 
     def __init__(self, model, params, replicas=2, name="model",
                  engine_kw=None, host="127.0.0.1", beat_interval=0.25,
-                 reservation_server=None, router_kw=None):
+                 reservation_server=None, router_kw=None,
+                 placement="driver", sc=None, executors=None,
+                 spawn_timeout=120.0):
         if int(replicas) < 1:
             raise ValueError("a fleet needs >= 1 replica")
+        if placement not in ("driver", "executors"):
+            raise ValueError(
+                "placement must be 'driver' or 'executors', got "
+                "{!r}".format(placement))
+        if placement == "executors" and sc is None:
+            raise ValueError(
+                "placement='executors' needs sc= (an engine Context "
+                "to ship the serving bootstrap tasks through)")
         self.model = model
         self.params = params
         self.n_replicas = int(replicas)
@@ -1425,64 +1728,188 @@ class ServingFleet(object):
         self.host = host
         self.beat_interval = float(beat_interval)
         self.router_kw = dict(router_kw or {})
+        self.placement = placement
+        self.sc = sc
+        #: optional explicit executor-id pool replicas may land on
+        #: (None = any alive executor)
+        self.executors = list(executors) if executors is not None \
+            else None
+        self.spawn_timeout = float(spawn_timeout)
         self._own_reservation = reservation_server is None
         self.reservation = reservation_server \
             if reservation_server is not None else reservation.Server(0)
         self.replicas = []
         self.router = None
         self.supervisor = None
+        self.autoscaler = None
         self._started = False
+        self._resv_addr = None
+        self._next_idx = 0
+        self._np_params = None
+        self._spawns = {}  # rid -> AsyncResult of its bootstrap task
 
-    def start(self, form_timeout=30.0):
-        if self._started:
-            return self
+    # -- replica construction ----------------------------------------------
+
+    def _new_rid(self):
+        rid = "replica-{}".format(self._next_idx)
+        self._next_idx += 1
+        return rid
+
+    def _replica(self, rid):
+        for replica in self.replicas:
+            if replica.replica_id == str(rid):
+                return replica
+        return None
+
+    def _spawn_local_replica(self, rid):
         from tensorflowonspark_tpu.serving import DecodeEngine, \
             ModelServer
 
+        # one FlightRecorder PER replica (unless the caller provided
+        # one): real deployments have one ring per process, and the
+        # router's /debug/trace stitch labels spans by source —
+        # in-process replicas sharing the process-global ring would
+        # each dump EVERYONE's spans under their own label
+        kw = dict(self.engine_kw)
+        kw.setdefault("flight", tracing.FlightRecorder())
+        engine = DecodeEngine(self.model, self.params, replica_id=rid,
+                              **kw)
+        try:
+            server = ModelServer(None, engine=engine, name=self.name,
+                                 host=self.host, port=0)
+            replica = Replica(server, self._resv_addr,
+                              beat_interval=self.beat_interval)
+            # tracked BEFORE start(): a replica that fails to start
+            # must be reachable by the cleanup below, or its engine's
+            # scheduler thread leaks
+            self.replicas.append(replica)
+        except BaseException:
+            engine.stop()
+            raise
+        replica.start()
+        return replica
+
+    def _host_params(self):
+        """Params as host (numpy) arrays, cached: the spawn spec rides
+        a cloudpickled task closure into the executor, and device
+        arrays must not cross that wire."""
+        if self._np_params is None:
+            import jax
+            import numpy as np
+            self._np_params = jax.tree_util.tree_map(
+                np.asarray, self.params)
+        return self._np_params
+
+    def alive_executors(self):
+        alive_fn = getattr(self.sc, "executors_alive", None)
+        if alive_fn is None:
+            return []
+        eligible = list(alive_fn())
+        if self.executors is not None:
+            eligible = [e for e in eligible if e in self.executors]
+        return eligible
+
+    def replica_hosts(self):
+        """{replica_id: executor_id} for executor-hosted replicas —
+        the placement ledger scale-up consults."""
+        return {r.replica_id: r.executor_id for r in self.replicas
+                if getattr(r, "remote", False)}
+
+    def free_executor(self):
+        """An alive, eligible executor hosting no replica — the
+        evidence-gated "capacity exists" probe (None when the fleet is
+        packed; scale-up must wait, as the regrow probe does)."""
+        hosting = set(self.replica_hosts().values())
+        for eid in self.alive_executors():
+            if eid not in hosting:
+                return eid
+        return None
+
+    def _dispatch_spawn(self, rid, eid):
+        """Ship one serving bootstrap task pinned to executor ``eid``
+        (exclusion of every other alive executor is how the engine's
+        one-task-per-executor dispatch is pointed at exactly one) and
+        track the driver-side RemoteReplica handle."""
+        from tensorflowonspark_tpu import node as node_mod
+
+        alive = self.alive_executors()
+        if eid not in alive:
+            raise RuntimeError(
+                "executor {} is not alive/eligible (alive: {})".format(
+                    eid, alive))
+        spec = {"replica_id": rid, "name": self.name,
+                "reservation_addr": list(self._resv_addr),
+                "beat_interval": self.beat_interval,
+                "engine_kw": self.engine_kw,
+                "model": self.model, "params": self._host_params()}
+        rdd = self.sc.parallelize([eid], 1)
+        result = rdd.foreachPartitionAsync(
+            node_mod.serve_replica(spec), one_task_per_executor=True,
+            exclude=[e for e in alive if e != eid])
+        self._spawns[rid] = result
+        replica = RemoteReplica(rid, self.reservation, executor_id=eid)
+        self.replicas.append(replica)
+        return replica
+
+    def _await_lease(self, rid, timeout, min_epoch=None):
+        """Block until ``rid``'s serving lease is live and FRESH
+        (and, for a replacement, carries an epoch newer than the fence
+        minted against the corpse); surfaces the bootstrap task's own
+        error if it failed instead."""
+        deadline = time.monotonic() + float(timeout)
+        fresh_age = max(3 * self.beat_interval, 1.0)
+        result = self._spawns.get(rid)
+        while time.monotonic() < deadline:
+            if result is not None:
+                err = result.first_error()
+                if err is not None:
+                    raise RuntimeError(
+                        "serving bootstrap task for {} failed: "
+                        "{}".format(rid, err[1]))
+            info = self.reservation.serving_snapshot().get(rid)
+            if info is not None and info.get("addr") \
+                    and (info.get("age") or 1e9) < fresh_age \
+                    and (min_epoch is None
+                         or (info.get("epoch") or 0) > min_epoch):
+                return info
+            time.sleep(0.02)
+        raise TimeoutError(
+            "replica {}'s serving lease did not arrive within "
+            "{}s".format(rid, timeout))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, form_timeout=None):
+        if self._started:
+            return self
+        form_timeout = float(form_timeout) if form_timeout is not None \
+            else (30.0 if self.placement == "driver"
+                  else self.spawn_timeout)
         try:
             if self._own_reservation:
-                resv_addr = self.reservation.start(host=self.host)
+                self._resv_addr = self.reservation.start(host=self.host)
             else:
-                resv_addr = self.reservation.addr
-            for i in range(self.n_replicas):
-                # one FlightRecorder PER replica (unless the caller
-                # provided one): real deployments have one ring per
-                # process, and the router's /debug/trace stitch labels
-                # spans by source — in-process replicas sharing the
-                # process-global ring would each dump EVERYONE's spans
-                # under their own label and multiply the dropped tally
-                kw = dict(self.engine_kw)
-                kw.setdefault("flight", tracing.FlightRecorder())
-                engine = DecodeEngine(self.model, self.params,
-                                      replica_id="replica-{}".format(i),
-                                      **kw)
-                try:
-                    server = ModelServer(None, engine=engine,
-                                         name=self.name,
-                                         host=self.host, port=0)
-                    replica = Replica(server, resv_addr,
-                                      beat_interval=self.beat_interval)
-                    # tracked BEFORE start(): a replica that fails to
-                    # start must be reachable by the cleanup below, or
-                    # its engine's scheduler thread leaks
-                    self.replicas.append(replica)
-                except BaseException:
-                    engine.stop()
-                    raise
-                replica.start()
+                self._resv_addr = self.reservation.addr
+            if self.placement == "driver":
+                for _ in range(self.n_replicas):
+                    self._spawn_local_replica(self._new_rid())
+            else:
+                eligible = self.alive_executors()
+                if len(eligible) < self.n_replicas:
+                    raise RuntimeError(
+                        "fleet needs {} executors but only {} are "
+                        "alive/eligible".format(self.n_replicas,
+                                                len(eligible)))
+                for eid in eligible[:self.n_replicas]:
+                    self._dispatch_spawn(self._new_rid(), eid)
             # formation barrier: every replica's lease must be live
             # before the router opens, or the first requests race the
-            # first beats
-            deadline = time.monotonic() + float(form_timeout)
-            want = {r.replica_id for r in self.replicas}
-            while time.monotonic() < deadline:
-                if want <= set(self.reservation.serving_snapshot()):
-                    break
-                time.sleep(0.02)
-            else:
-                raise TimeoutError(
-                    "fleet formation: not every replica's serving lease "
-                    "arrived within {}s".format(form_timeout))
+            # first beats (spawn-task errors surface here too)
+            deadline = time.monotonic() + form_timeout
+            for replica in list(self.replicas):
+                self._await_lease(
+                    replica.replica_id,
+                    max(deadline - time.monotonic(), 0.1))
             self.router = FleetRouter(self.reservation, name=self.name,
                                       host=self.host,
                                       replicas=self.replicas,
@@ -1499,6 +1926,148 @@ class ServingFleet(object):
         self._started = True
         return self
 
+    # -- elastic width (the autoscaler's verbs) ----------------------------
+
+    def spawn_replica(self, replica_id=None, executor_id=None,
+                      timeout=None):
+        """Grow the fleet by one replica (or respawn ``replica_id`` —
+        a REPLACEMENT under the same identity). Executor placement
+        picks a free executor (:meth:`free_executor`; raises
+        :class:`NoCapacity` when none exists); a replacement first
+        MINTS a fresh fencing epoch against the incumbent, so a
+        partitioned-but-alive corpse can never serve stale after its
+        replacement registers (PR 12's lease fencing, applied at every
+        (re)spawn). Blocks until the new replica's lease is live AND
+        its /healthz answers 200 over the wire, then force-clears any
+        corpse-era router health state for the id. Returns the replica
+        handle."""
+        if not self._started:
+            raise RuntimeError("fleet is not started")
+        timeout = float(timeout) if timeout is not None \
+            else self.spawn_timeout
+        replacing = replica_id is not None \
+            and self._replica(replica_id) is not None
+        rid = str(replica_id) if replica_id is not None \
+            else self._new_rid()
+        min_epoch = None
+        if self.placement == "driver":
+            if replacing:
+                raise NotImplementedError(
+                    "driver-placement replicas are replaced by the "
+                    "supervisor's RestartEngine, not by respawn")
+            replica = self._spawn_local_replica(rid)
+        else:
+            eid = executor_id if executor_id is not None \
+                else self.free_executor()
+            if eid is None:
+                raise NoCapacity(
+                    "no free executor to place replica {} on "
+                    "(alive/eligible: {}, hosting: {})".format(
+                        rid, self.alive_executors(),
+                        self.replica_hosts()))
+            if replacing:
+                # fence the corpse BEFORE the replacement's first
+                # lease call: from this instant any beat the old
+                # holder still manages is answered FENCED
+                min_epoch = self.reservation.mint_epoch(rid)
+                self.replicas.remove(self._replica(rid))
+            replica = self._dispatch_spawn(rid, eid)
+        try:
+            info = self._await_lease(rid, timeout, min_epoch=min_epoch)
+            if not FleetRouter._await_healthz(tuple(info["addr"]),
+                                              min(timeout, 30.0)):
+                raise RuntimeError(
+                    "replica {} lease is live but /healthz never "
+                    "answered 200".format(rid))
+        except BaseException:
+            # a FRESH spawn that failed is simply not part of the
+            # fleet (the next breach re-fires scale-up); a failed
+            # REPLACEMENT must keep its handle TRACKED — the identity
+            # is still a fleet member below target, and untracking it
+            # would make the autoscaler forget the dead replica ever
+            # existed (no further REPLACE decisions, a min=1 fleet
+            # stuck at zero forever)
+            if not replacing and replica in self.replicas:
+                self.replicas.remove(replica)
+            raise
+        if self.router is not None:
+            # wire-verified above: clear every hold and any failure
+            # escalation the DEAD incarnation earned (owner=None is
+            # the force-clear) so the replacement is routable now, not
+            # after the corpse's cooldown expires
+            self.router.readmit(rid, owner=None)
+        logger.info("replica %s %s (%s)", rid,
+                    "replaced" if replacing else "spawned",
+                    "executor {}".format(replica.executor_id)
+                    if getattr(replica, "remote", False) else "driver")
+        return replica
+
+    def replace_replica(self, replica_id, timeout=None):
+        """Respawn a DEAD executor-hosted replica under the SAME
+        identity on whatever free executor exists — the autoscaler's
+        repair verb (lease expired -> router down-marked -> this). The
+        fencing mint inside :meth:`spawn_replica` guarantees the old
+        incarnation can never serve again."""
+        if self.placement != "executors":
+            raise RuntimeError(
+                "replace_replica is for executor-hosted fleets")
+        return self.spawn_replica(replica_id=replica_id,
+                                  timeout=timeout)
+
+    def retire_replica(self, replica_id, drain_timeout=None):
+        """Zero-loss scale-down of one replica: quiesce at the router
+        (no new dispatches) -> ``drain_engine`` (every admitted
+        request finishes — ``rolling_drain``'s zero-loss contract) ->
+        stop the replica (remote: bounded /admin/stop RPC) -> mint a
+        fencing epoch (a zombie whose stop RPC never landed latches
+        itself on its next beat instead of serving stale) ->
+        deregister the lease and forget router health state. Returns
+        the clean-drain verdict."""
+        replica = self._replica(replica_id)
+        if replica is None:
+            raise KeyError(
+                "no replica {!r} in this fleet".format(replica_id))
+        rid = replica.replica_id
+        if self.router is not None:
+            self.router.quiesce(rid, "retiring (scale-down)",
+                                owner="autoscale")
+        clean = False
+        try:
+            clean = replica.drain_engine(timeout=drain_timeout)
+        except (RuntimeError, OSError,
+                http.client.HTTPException) as e:
+            logger.warning("retirement drain of replica %s failed "
+                           "(%s); stopping anyway", rid, e)
+        try:
+            replica.stop()
+        except Exception as e:  # noqa: BLE001 - teardown is best-effort
+            logger.warning("retirement stop of replica %s failed: %s",
+                           rid, e)
+        self.reservation.mint_epoch(rid)
+        if replica in self.replicas:
+            self.replicas.remove(replica)
+        self.reservation.drop_lease(rid)
+        if self.router is not None:
+            self.router.readmit(rid, owner="autoscale")
+            self.router.health.forget(rid)
+        logger.info("replica %s retired (drain %s)", rid,
+                    "clean" if clean else "UNCLEAN")
+        return clean
+
+    def autoscale(self, policy=None, **controller_kw):
+        """Arm the SLO-driven autoscaler (autoscale.py): a driver-side
+        control loop scaling this fleet between the policy's
+        min/max_replicas from the SLO signals the replicas already
+        beat. Returns the started controller (also stashed on
+        ``self.autoscaler`` for stop())."""
+        from tensorflowonspark_tpu import autoscale as autoscale_mod
+
+        if self.autoscaler is None:
+            self.autoscaler = autoscale_mod.AutoscaleController(
+                self, policy=policy, **controller_kw)
+            self.autoscaler.start()
+        return self.autoscaler
+
     @property
     def router_addr(self):
         return self.router.addr
@@ -1508,14 +2077,21 @@ class ServingFleet(object):
         return "http://{}:{}{}".format(host, port, path)
 
     def supervise(self, restart=None, config=None):
-        """Arm the recovery loop: a Supervisor watching every replica
-        (dead scheduler -> router quiesced first -> RestartEngine
-        respawn -> router readmit). Returns the supervisor."""
+        """Arm the recovery loop: a Supervisor watching every
+        in-process replica (dead scheduler -> router quiesced first ->
+        RestartEngine respawn -> router readmit) and, for
+        executor-hosted replicas, classifying their serving LEASES
+        (expired lease / dead engine -> quiesce + attributed incident;
+        the autoscaler owns the replacement, so no restart budget
+        burns on an executor the driver cannot respawn in place).
+        Returns the supervisor."""
         from tensorflowonspark_tpu import supervisor as supervisor_mod
 
         if self.supervisor is None:
             self.supervisor = supervisor_mod.Supervisor(config=config)
             self.supervisor.watch_fleet(self, restart=restart)
+            if any(getattr(r, "remote", False) for r in self.replicas):
+                self.supervisor.watch_serving(self)
         return self.supervisor
 
     def rolling_drain(self, upgrade=None, drain_timeout=None,
@@ -1525,6 +2101,9 @@ class ServingFleet(object):
             healthz_timeout=healthz_timeout)
 
     def stop(self):
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+            self.autoscaler = None
         if self.supervisor is not None:
             self.supervisor.stop()
             self.supervisor = None
@@ -1532,12 +2111,24 @@ class ServingFleet(object):
             self.router.stop()
             self.router = None
         for replica in self.replicas:
-            replica.stop()
+            # RemoteReplica.stop is a bounded /admin/stop RPC and
+            # swallows unreachable-executor failures — teardown must
+            # not hang on (or leak) executor-hosted node processes
+            try:
+                replica.stop()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                logger.warning("stop of replica %s failed",
+                               replica.replica_id, exc_info=True)
         # start() is re-callable (it re-forms the fleet): the stopped
         # corpses must not linger in the registry, or a restart would
         # route/drain/watch over duplicate replica_ids with dead
         # engines
         self.replicas = []
+        self._spawns = {}
+        # a re-start() names from replica-0 again (fresh formation;
+        # identity reuse is safe — Client.lease mints the NEXT epoch
+        # even against a shared reservation server's history)
+        self._next_idx = 0
         if self._own_reservation:
             self.reservation.stop()
             # a stopped Server cannot serve again (its done latch stays

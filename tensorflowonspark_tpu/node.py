@@ -480,6 +480,84 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
     return _mapfn
 
 
+#: executor-hosted serving nodes in THIS process, keyed by replica_id
+#: (fleet.ServingNode objects). Module-level for the same reason as
+#: _NODE_STATE: the serve/stop closures ship by value, so access goes
+#: through a module function that both sides resolve via sys.modules.
+_SERVING_STATE = {}
+
+
+def _serving_state():
+    import sys
+    return sys.modules[__name__]._SERVING_STATE
+
+
+def serve_replica(spec):
+    """Return the ``role: "serving"`` bootstrap closure, run once on
+    the target executor (PR 13): the paper's executor-role map_fun
+    applied to the serving plane. The closure builds the replica
+    IN the executor process — ``fleet.ServingNode``: DecodeEngine
+    (spawn config rides ``spec["engine_kw"]`` — slots, paging,
+    ``attn_impl``), ModelServer on an ephemeral port with the remote
+    lifecycle RPCs mounted, and the BEAT agent registering the
+    replica's real HTTP address with the driver's reservation server —
+    then RETURNS, leaving the node serving on daemon threads (the
+    executor's task slot frees; the driver reaches the node over HTTP
+    from here on). Unlike the training bootstrap, the engine runs in
+    the executor process itself: a serving executor IS its accelerator
+    owner, there is no feed plane to keep jax out of.
+
+    A task retried onto an executor already hosting this replica_id
+    stops the incumbent first (the re-spawn semantics the autoscaler's
+    replacement path relies on when a revived executor is chosen
+    again)."""
+
+    def _mapfn(iterator):
+        for _ in iterator:
+            pass
+        from tensorflowonspark_tpu import fleet as fleet_mod
+        from tensorflowonspark_tpu.engine import executor as engine_executor
+
+        info = engine_executor.get_executor_info()
+        executor_id = info.get("executor_id")
+        if executor_id is None:
+            executor_id = util.read_executor_id()
+        rid = str(spec["replica_id"])
+        # chaos gate: kill_serving_executor_at_request refuses to fire
+        # in any process that is not an executor-hosted serving node
+        os.environ["TFOS_SERVING_EXECUTOR_ID"] = str(executor_id)
+        old = _serving_state().pop(rid, None)
+        if old is not None:
+            logger.warning("executor %s already hosts replica %s; "
+                           "stopping the incumbent before re-spawning",
+                           executor_id, rid)
+            try:
+                old.stop()
+            except Exception:  # noqa: BLE001 - replaced either way
+                logger.exception("incumbent replica %s stop failed", rid)
+        host = info.get("host") or util.get_ip_address()
+        node = fleet_mod.ServingNode(spec, executor_id=executor_id,
+                                     host=host)
+        node.start()
+        _serving_state()[rid] = node
+
+    return _mapfn
+
+
+def stop_replica(replica_id):
+    """Closure that stops an executor-hosted replica in place (the
+    task-based fallback when the /admin/stop RPC cannot be used)."""
+
+    def _mapfn(iterator):
+        for _ in iterator:
+            pass
+        node = _serving_state().pop(str(replica_id), None)
+        if node is not None:
+            node.stop()
+
+    return _mapfn
+
+
 #: default seconds between heartbeat-lease beats (env: TFOS_BEAT_INTERVAL;
 #: supervised runs tighten it via SupervisorConfig -> cluster_meta)
 DEFAULT_BEAT_INTERVAL = 2.0

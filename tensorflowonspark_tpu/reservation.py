@@ -293,6 +293,21 @@ class Server(object):
         logger.info("lease epoch %d minted for %r", epoch, executor_id)
         return epoch
 
+    def drop_lease(self, identity):
+        """Remove ``identity``'s lease (deliberate deregistration — a
+        retired serving replica must vanish from ``serving_snapshot``
+        rather than linger as an ever-aging corpse the autoscaler would
+        keep counting). The identity's EPOCH is kept: a zombie beat
+        from a stop RPC that never landed re-creates nothing — the
+        retirer minted a fresh epoch first, so the zombie is answered
+        FENCED and latches itself. Returns True when a lease was
+        dropped."""
+        with self._sup_lock:
+            dropped = self._leases.pop(identity, None) is not None
+        if dropped:
+            logger.info("lease for %r dropped (deregistered)", identity)
+        return dropped
+
     def set_cluster_width(self, width, target=None):
         """Publish this formation's width (and the job's configured
         target width) for the driver-side /metrics and /stats views —
@@ -336,6 +351,12 @@ class Server(object):
                 "epoch": payload.get("epoch"),
                 "serving": payload.get("serving") or {},
                 "metrics": payload.get("metrics"),
+                # executor-hosted replicas (PR 13): where this replica
+                # actually runs ({"executor": id, "pid": n}) — the
+                # replica_id -> host join the autoscaler places by and
+                # the router's replica_host info gauge renders; absent
+                # for driver-local replicas
+                "host": payload.get("host"),
             }
         return out
 

@@ -733,6 +733,9 @@ class DecodeEngine(object):
         self._draining = False
         self._broken = None
         self._failed_requests = 0  # admitted-but-failed ledger (drain)
+        #: cumulative requests submitted (chaos site: the
+        #: kill_serving_executor_at_request count)
+        self._requests_seen = 0
         # admission-control evidence: EWMAs of this engine's own recent
         # decode-step and prefill wall times (scheduler thread writes,
         # submit path reads under _cv). None until the first sample —
@@ -902,6 +905,17 @@ class DecodeEngine(object):
                 raise ValueError(
                     "deadline_s must be > 0, got {}".format(deadline_s))
         with self._cv:
+            # chaos site (PR 13): kill_serving_executor_at_request
+            # fires on the K-th submitted request — whole-executor
+            # SIGKILL for the autoscaler's replacement path. Counted
+            # under _cv (concurrent HTTP handlers submit in parallel;
+            # an unlocked read-modify-write would drift the fire
+            # point) and BEFORE admission, so the K-th request itself
+            # never answers (its router attempt fails over). O(1)
+            # when unarmed.
+            self._requests_seen += len(vetted)
+            chaos.on_serving_request(self._requests_seen,
+                                     ident=self.replica_id)
             # draining outranks stopped: a drained engine ends with
             # BOTH flags set, and a request that raced past the HTTP
             # layer's drain check must still get the retriable 503
@@ -2131,6 +2145,15 @@ class ModelServer(object):
         #: — handler threads are daemons and die at interpreter exit)
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        #: remote lifecycle RPCs (PR 13): ``POST /admin/<name>`` routes
+        #: to callables registered via :meth:`register_admin` — how a
+        #: driver reaches an EXECUTOR-HOSTED replica for drain /
+        #: respawn / re_register / stop (rolling drains and autoscale
+        #: retirement need a transport, and the replica's own HTTP
+        #: server is it). Empty by default: a server that registered
+        #: nothing (driver-local fleets, plain model servers) answers
+        #: 404 for the whole /admin/ space.
+        self._admin = {}
 
     # -- request handling ------------------------------------------------
 
@@ -2310,6 +2333,17 @@ class ModelServer(object):
                                "signature_name": "serving_default"},
                 "metadata": {"signature_def": self.signature,
                              "format": "tfos-tpu-export-v1"}}
+
+    def register_admin(self, name, fn):
+        """Mount ``fn(payload_dict) -> response_dict`` as ``POST
+        /admin/<name>`` — the remote lifecycle RPC surface an
+        executor-hosted replica exposes (fleet.ServingNode registers
+        drain / respawn / re_register / stop). Admin routes bypass the
+        fenced and draining gates BY DESIGN: fencing and draining are
+        verdicts about SERVING traffic, and the operator RPCs that
+        resolve those very states (re_register a fenced replica, stop a
+        drained one) must still be reachable."""
+        self._admin[str(name)] = fn
 
     # -- health (supervision plane) ---------------------------------------
 
@@ -2650,6 +2684,22 @@ class ModelServer(object):
                         server._inflight -= 1
 
             def _do_post_tracked(self):
+                if self.path.startswith("/admin/"):
+                    # lifecycle RPCs bypass the fenced/draining gates
+                    # below: they exist to RESOLVE those states
+                    fn = server._admin.get(self.path[len("/admin/"):])
+                    if fn is None:
+                        return self._send(
+                            404, {"error": "not found: %s" % self.path})
+                    try:
+                        n = int(self.headers.get("Content-Length", "0"))
+                        payload = json.loads(self.rfile.read(n) or b"{}")
+                        return self._send(200, fn(payload or {}))
+                    except json.JSONDecodeError as e:
+                        return self._send(400, {"error": str(e)})
+                    except Exception as e:  # noqa: BLE001 - surface 500
+                        logger.exception("admin %s failed", self.path)
+                        return self._send(500, {"error": str(e)})
                 # trace-context propagation (fleet plane): a router-
                 # minted X-TFOS-Trace id is adopted as the engine trace
                 # id so this replica's spans join the fleet timeline

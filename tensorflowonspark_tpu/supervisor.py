@@ -427,6 +427,7 @@ class Supervisor(object):
         self._restored_seen = False
         self._first_step_seen = False
         self._watched = []          # serving engines under watch
+        self._serving_watch = None  # executor-hosted fleet lease watch
         self._stop = threading.Event()
         self._thread = None
         self._started = time.monotonic()
@@ -468,6 +469,7 @@ class Supervisor(object):
             self._track_recovery(leases)
             self._classify_stragglers(leases, now)
         self._check_watched()
+        self._check_serving_leases()
 
     def _classify_engine_liveness(self):
         """Fast-path executor-lost detection from the engine's own
@@ -719,17 +721,93 @@ class Supervisor(object):
         return self
 
     def watch_fleet(self, fleet, restart=None):
-        """Watch every replica of a ``fleet.ServingFleet``: a dead
-        replica scheduler quiesces that replica at the router FIRST,
-        then restarts through :class:`RestartEngine` (default policy;
-        pass your own to re-tune), then readmits. One entry per
-        replica, all driven by this supervisor's monitor thread."""
+        """Watch every IN-PROCESS replica of a ``fleet.ServingFleet``:
+        a dead replica scheduler quiesces that replica at the router
+        FIRST, then restarts through :class:`RestartEngine` (default
+        policy; pass your own to re-tune), then readmits. One entry
+        per replica, all driven by this supervisor's monitor thread.
+        Executor-hosted replicas have no driver-side engine object to
+        poll — they are covered by :meth:`watch_serving`'s lease
+        classification instead."""
         for replica in fleet.replicas:
+            if getattr(replica, "remote", False):
+                continue
             self.watch(replica.engine, server=replica.server,
                        restart=restart if restart is not None
                        else RestartEngine(),
                        router=fleet.router, replica=replica)
         return self
+
+    def watch_serving(self, fleet, stale_after=1.0):
+        """Attribute EXECUTOR-HOSTED replica death (PR 13): classify
+        the fleet's serving BEAT leases the way cluster supervision
+        classifies trainer leases. A replica whose lease expired
+        (SIGKILLed executor — the beat died with the process) or whose
+        lease says the engine is dead is quiesced at the router and
+        reported ONCE per episode as an attributed ``serving_replica_
+        lost`` failure with the last lease payload as evidence. No
+        RestartEngine budget burns here — the driver cannot respawn an
+        engine inside a dead executor; repair belongs to the
+        autoscaler's replacement path (same identity, fresh fencing
+        epoch), and a FENCED corpse that resurfaces is deliberately
+        ignored (its replacement is the live story). Keep
+        ``stale_after`` BELOW the autoscale policy's ``dead_after_s``
+        (default 1.0 vs 3.0) so the attributed incident lands before
+        the repair erases its evidence. Recovery —
+        the lease returning fresh under a live engine — re-arms the
+        episode and readmits nothing itself (the replacement path's
+        wire-verified readmit already did)."""
+        self._serving_watch = {"fleet": fleet,
+                               "stale_after": float(stale_after),
+                               "reported": set()}
+        self.start()
+        return self
+
+    def _check_serving_leases(self):
+        watch = self._serving_watch
+        if watch is None:
+            return
+        fleet = watch["fleet"]
+        snapshot = fleet.reservation.serving_snapshot()
+        for replica in list(fleet.replicas):
+            if not getattr(replica, "remote", False):
+                continue
+            rid = replica.replica_id
+            info = snapshot.get(rid)
+            age = (info or {}).get("age")
+            gauges = (info or {}).get("serving") or {}
+            epoch = (info or {}).get("epoch")
+            current = fleet.reservation.lease_epoch(rid)
+            if epoch is not None and current is not None \
+                    and epoch < current:
+                # superseded incarnation (replacement in flight or
+                # already serving): the corpse's lease is history,
+                # not a fresh failure
+                continue
+            dead = age is None or age > watch["stale_after"] \
+                or gauges.get("alive") is False
+            if dead and rid not in watch["reported"]:
+                watch["reported"].add(rid)
+                reason = ("serving lease expired (age {}s > {}s) — "
+                          "executor presumed lost".format(
+                              round(age, 2) if age is not None else None,
+                              watch["stale_after"])
+                          if age is None or age > watch["stale_after"]
+                          else "lease fresh but engine dead")
+                if fleet.router is not None:
+                    fleet.router.quiesce(rid, reason, owner="supervisor")
+                self.events.record("serving_replica_lost", replica=rid,
+                                   executor=replica.executor_id,
+                                   reason=reason)
+                self._report(FailureEvent(
+                    "serving_replica_lost", None,
+                    "replica {} (executor {}): {}".format(
+                        rid, replica.executor_id, reason),
+                    payload={"lease": info, "replica": rid}))
+            elif not dead and rid in watch["reported"]:
+                watch["reported"].discard(rid)
+                self.events.record("serving_replica_recovered",
+                                   replica=rid)
 
     def _check_watched(self):
         for entry in self._watched:

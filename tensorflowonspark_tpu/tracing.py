@@ -391,6 +391,39 @@ METRIC_FAMILIES = {
     "tfos_fleet_replica_inflight":
         ("gauge", "replica", "requests the router holds open against "
                              "each replica"),
+    # -- executor-hosted serving + SLO autoscaler (PR 13) --
+    "tfos_serving_replica_host":
+        ("gauge", "replica_id,executor", "constant 1 joining each "
+                                         "executor-hosted replica to "
+                                         "the executor that runs it "
+                                         "(absent for driver-local "
+                                         "replicas)"),
+    "tfos_autoscale_decisions":
+        ("counter", "", "autoscale control-loop evaluations (every "
+                        "poll, holds included)"),
+    "tfos_autoscale_scale_ups":
+        ("counter", "", "replicas added by the autoscaler (SLO breach "
+                        "-> spawn on a free executor)"),
+    "tfos_autoscale_scale_downs":
+        ("counter", "", "replicas retired by the autoscaler (sustained "
+                        "idle -> zero-loss drain retirement)"),
+    "tfos_autoscale_replacements":
+        ("counter", "", "dead replicas repaired under the same "
+                        "identity (lease expiry -> fenced replacement "
+                        "spawn, or in-place respawn RPC)"),
+    "tfos_autoscale_scale_up_blocked":
+        ("counter", "", "scale-ups (or replacements) the capacity gate "
+                        "refused — no free executor existed"),
+    "tfos_autoscale_unclean_retirements":
+        ("counter", "", "scale-down drains that timed out or failed "
+                        "(zero-loss retirement is the contract; this "
+                        "counting up is an alert)"),
+    "tfos_autoscale_replicas_live":
+        ("gauge", "", "replicas with a fresh lease and a live engine, "
+                      "as the autoscaler last counted them"),
+    "tfos_autoscale_replicas_target":
+        ("gauge", "", "replica count the autoscaler currently wants "
+                      "(live adjusted by its latest decision)"),
     # -- feed plane (DataFeed registry; BEAT-piggybacked to the driver) --
     "tfos_feed_stage_seconds":
         ("counter", "stage", "host-side feed wall seconds per stage "
@@ -564,6 +597,49 @@ class Histogram(object):
                 "counts": list(self._counts),
                 "sum": self._sum, "n": self._n,
                 "min": self._min, "max": self._max}
+
+
+def snapshot_quantile(snap, q):
+    """Approximate q-quantile from a :meth:`Histogram.snapshot` dict —
+    the same bucket math as :meth:`Histogram.quantile`, usable on
+    snapshots that crossed the BEAT wire (the autoscaler prices a
+    replica's TTFT p99 from its lease-carried snapshot without
+    reconstructing a Histogram). None when the snapshot is empty or
+    malformed."""
+    try:
+        n = int(snap["n"])
+        counts = snap["counts"]
+        lo, growth = float(snap["lo"]), float(snap["growth"])
+        smin, smax = snap.get("min"), snap.get("max")
+    except (TypeError, KeyError, ValueError):
+        return None
+    if not n:
+        return None
+    q = float(q)
+    if q <= 0.0:
+        return smin
+    if q >= 1.0:
+        return smax
+    rank = max(1, int(math.ceil(q * n)))
+    cum = 0
+    n_bounds = len(counts) - 1
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        if cum + c >= rank:
+            if i == n_bounds:  # overflow bucket
+                value = smax
+            else:
+                upper = lo * growth ** i
+                lower = upper / growth
+                value = lower * growth ** ((rank - cum) / float(c))
+            if smin is not None:
+                value = max(value, smin)
+            if smax is not None:
+                value = min(value, smax)
+            return value
+        cum += c
+    return smax
 
 
 def _fmt(value):

@@ -9,7 +9,7 @@ PYTHON ?= python
 SHELL := /bin/bash
 
 .PHONY: test test-fast bench smoke install lint native clean chaos \
-  metrics-lint goodput-report
+  metrics-lint racecheck goodput-report
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -26,6 +26,15 @@ tensorflowonspark_tpu/_libshmring.so: native/shm_ring.cpp
 metrics-lint:
 	$(PYTHON) scripts/metrics_lint.py
 
+# concurrency lint gate (PR 14): AST-based guarded-attribute race
+# check, lock-order audit, and thread-lifecycle rules over the whole
+# package (tensorflowonspark_tpu/analysis/, stdlib-ast only, ~2s).
+# New findings fail CI; pre-existing benign ones live in
+# analysis/baseline.json with written reasons. Rule catalog and the
+# fix-vs-baseline workflow: docs/static_analysis.md
+racecheck:
+	$(PYTHON) -m tensorflowonspark_tpu.analysis
+
 # goodput plane (PR 10): render the badput/straggler tables — hermetic
 # demo here; point scripts/goodput_report.py --url at a live driver's
 # stats port for a real job (the chaos goodput e2e rides `make chaos`
@@ -36,7 +45,7 @@ goodput-report:
 
 # per-suite wall clock cap via coreutils timeout (pytest-timeout is not a
 # hard dependency); a wedged multi-process test fails CI instead of hanging
-test: metrics-lint
+test: metrics-lint racecheck
 	timeout $(SUITE_TIMEOUT) $(PYTHON) -m pytest tests/ -q
 
 # example-surface smokes (tests/test_examples.py) add ~12 min of

@@ -48,6 +48,7 @@ def doc_catalog(path=DOC):
 
 def main(argv=None):
     from tensorflowonspark_tpu import tracing
+    from tensorflowonspark_tpu.analysis import report
 
     code = {name: meta[0]
             for name, meta in tracing.METRIC_FAMILIES.items()}
@@ -57,27 +58,29 @@ def main(argv=None):
         print("metrics-lint: cannot read {}: {}".format(DOC, e),
               file=sys.stderr)
         return 1
-    problems = []
+    # findings ride the SAME report helper as `make racecheck`
+    # (analysis/report.py), so the two merge gates render identically
+    # and operators read one failure shape
+    findings = []
     for name in sorted(set(code) - set(docs)):
-        problems.append("in code (tracing.METRIC_FAMILIES) but missing "
-                        "from docs/observability.md: {}".format(name))
+        findings.append(report.Finding(
+            "undocumented-family", "tracing.METRIC_FAMILIES", 0, name,
+            "in code (tracing.METRIC_FAMILIES) but missing from "
+            "docs/observability.md: {}".format(name)))
     for name in sorted(set(docs) - set(code)):
-        problems.append("documented in docs/observability.md but not in "
-                        "tracing.METRIC_FAMILIES: {}".format(name))
+        findings.append(report.Finding(
+            "unexported-family", "docs/observability.md", 0, name,
+            "documented in docs/observability.md but not in "
+            "tracing.METRIC_FAMILIES: {}".format(name)))
     for name in sorted(set(code) & set(docs)):
         if code[name] != docs[name]:
-            problems.append("type drift for {}: code says {!r}, docs "
-                            "say {!r}".format(name, code[name],
-                                              docs[name]))
-    if problems:
-        print("metrics-lint FAILED ({} problem(s)):".format(
-            len(problems)), file=sys.stderr)
-        for p in problems:
-            print("  - " + p, file=sys.stderr)
-        return 1
-    print("metrics-lint: {} families, code and docs agree".format(
-        len(code)))
-    return 0
+            findings.append(report.Finding(
+                "type-drift", "docs/observability.md", 0, name,
+                "type drift for {}: code says {!r}, docs say "
+                "{!r}".format(name, code[name], docs[name])))
+    return report.emit(
+        "metrics-lint", findings,
+        ok_summary="{} families, code and docs agree".format(len(code)))
 
 
 if __name__ == "__main__":

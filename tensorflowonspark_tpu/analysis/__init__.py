@@ -1,0 +1,49 @@
+"""Concurrency lint plane: AST-based static analysis as a merge gate.
+
+Four of the last eight PRs' review-hardening passes caught the same
+bug class by hand: unlocked read-modify-writes on shared state touched
+from HTTP handler threads, BEAT agents, and supervisor polls (router
+histogram writes, the goodput ledger's compile-claim, twice a chaos
+request counter). ``make metrics-lint`` already proved the pattern
+that works — turn a review finding into a CI failure — so this
+package does the same for data races. Three passes, stdlib ``ast``
+only (no new dependencies, safe as a default-test-target
+prerequisite):
+
+1. **Guarded-attribute race check** (:mod:`guards`) — per class,
+   infer the guard set (attributes mutated inside ``with self._lock``
+   / ``with self._cv`` / any ``threading.Lock|RLock|Condition``
+   attribute anywhere in the class) and flag every mutation,
+   augmented assignment, or read-modify-write of a guarded attribute
+   outside that guard — including mutations in private methods
+   reached only from unlocked contexts (intra-class call graph,
+   lock state propagated to a fixpoint). A second rule flags
+   CROSS-THREAD mutations: an attribute a class's own thread body
+   (``Thread(target=self._loop)``) and any other entry point both
+   mutate with no lock held anywhere.
+2. **Lock-order audit** (:mod:`lockorder`) — build the per-class
+   lock-acquisition graph from nested ``with`` statements and
+   intra-class call edges; a cycle (A-under-B in one method,
+   B-under-A in another) is an error, and so is re-entering a
+   non-reentrant ``Lock`` the caller already holds.
+3. **Thread-lifecycle rules** (:mod:`lifecycle`) — every
+   ``Thread(...)`` must pass ``daemon=`` and ``name=`` explicitly and
+   be reachable from a ``join()`` (or be registered as intentionally
+   unjoined); every ``except`` that catches the serving retriable
+   taxonomy must re-raise or map to a pinned HTTP kind, not swallow.
+
+Findings are suppressed inline with the ``# tfos: <rule>(<reason>)``
+grammar (``unguarded`` / ``unjoined`` / ``daemon`` / ``lock-order`` /
+``swallow`` — the reason is MANDATORY; an empty one is itself a
+finding) or baselined in ``analysis/baseline.json`` (pre-existing
+benign findings, each entry carrying a written reason, so the gate
+fails loudly on NEW findings only). ``make racecheck`` runs the
+driver (:mod:`racecheck`) over the live package; it shares the
+finding/exit-code report helper (:mod:`report`) with
+``scripts/metrics_lint.py`` so the two gates render identically.
+
+See docs/static_analysis.md for the rule catalog, the suppression
+grammar, and the fix-vs-baseline workflow.
+"""
+
+from tensorflowonspark_tpu.analysis.report import Finding, emit  # noqa: F401

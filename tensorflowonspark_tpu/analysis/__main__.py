@@ -1,0 +1,7 @@
+"""``python -m tensorflowonspark_tpu.analysis`` == ``make racecheck``."""
+
+import sys
+
+from tensorflowonspark_tpu.analysis.racecheck import main
+
+sys.exit(main())

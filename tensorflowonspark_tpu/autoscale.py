@@ -289,6 +289,14 @@ class AutoscaleController(object):
         self._state = {"last_up": None, "last_down": None}
         self._last_record = None
         self._last_note = None
+        # one control step at a time: poll_once is public (tests and
+        # operators drive it) AND the loop thread calls it — two
+        # concurrent evaluations of the same evidence would BOTH
+        # apply (a double scale-down retires two replicas for one
+        # idle verdict) and race the cooldown stamps and the
+        # decision-suppression memos (unlocked read-modify-writes).
+        # Pinned by test_autoscale.py's two-thread barrier test.
+        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
         router = getattr(fleet, "router", None)
@@ -335,7 +343,19 @@ class AutoscaleController(object):
                 for r in list(self.fleet.replicas)]
 
     def poll_once(self, now=None):
-        now = now if now is not None else time.monotonic()
+        """One full control step (read -> decide -> record -> apply),
+        serialized: a caller landing while another step is mid-apply
+        waits and then evaluates FRESH state (the first step's stamps
+        and fleet changes), so one idle verdict can never retire two
+        replicas."""
+        with self._lock:
+            # `now` defaults AFTER the lock: a step that waited out a
+            # long apply must price cooldowns at the time it actually
+            # runs, not at the time it queued
+            return self._poll_locked(
+                now if now is not None else time.monotonic())
+
+    def _poll_locked(self, now):
         views = self.views()
         decision = decide(self.policy, views, self._state, now)
         self.counters.inc("decisions")
@@ -485,6 +505,19 @@ class AutoscaleController(object):
                 # the lifecycle RPC — cheaper than a cross-executor
                 # replacement and keeps the placement ledger intact
                 replica.respawn_engine()
+                if self.fleet.router is not None:
+                    self.fleet.router.readmit(rid, owner=None)
+            elif not remote:
+                # driver-placement dead lease: the replica OBJECT
+                # lives in this process, so the lease died because
+                # its beat loop stopped (fenced by an operator mint,
+                # or a wedged beat) — not because an executor
+                # vanished. replace_replica cannot apply (it raises
+                # for driver fleets, which used to wedge the
+                # controller in a permanent REPLACE loop); the repair
+                # verb is re_register: fresh epoch, restarted beat
+                # loop, same engine
+                replica.re_register()
                 if self.fleet.router is not None:
                     self.fleet.router.readmit(rid, owner=None)
             else:

@@ -121,7 +121,7 @@ class _ExecutorHandle(object):
         self.own_queue = queue.Queue()
         self.alive = True
         self.conn_broken = False
-        self.thread = threading.Thread(
+        self.thread = threading.Thread(  # tfos: unjoined(daemon; exits when its executor connection closes — the engine has no per-handle teardown hook)
             target=self._loop, name="executor-handle-%d" % self.executor_id,
             daemon=True)
         self.thread.start()
@@ -258,6 +258,7 @@ class Context(object):
         self._stopping = threading.Event()
         self._job_counter = 0
         self._lock = threading.Lock()
+        # tfos: unjoined(daemon; _accept_loop exits when stop() closes the listening socket)
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                name="engine-accept", daemon=True)
         self._accept_thread.start()

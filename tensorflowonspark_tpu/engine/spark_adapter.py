@@ -59,7 +59,7 @@ class SparkAsyncResult(object):
             finally:
                 self._done.set()
 
-        self._thread = threading.Thread(
+        self._thread = threading.Thread(  # tfos: unjoined(get() waits on the done Event instead; the daemon thread ends with fn())
             target=runner, name="spark-adapter-job", daemon=True)
         self._thread.start()
 

@@ -316,6 +316,16 @@ class Replica(object):
         #: server refuses to serve until :meth:`re_register`
         self.fenced = False
         self._client = None
+        # guards epoch / fenced / _client: the beat thread mutates
+        # all three, and re_register()/stop() land from operator or
+        # supervisor threads. Unserialized, a re_register racing an
+        # in-flight FENCED beat could have its clear overwritten by
+        # the beat's latch — the replica ends permanently fenced with
+        # a dead beat loop while re_register reports success (pinned
+        # by test_fleet.py's barrier test). Each beat iteration holds
+        # the lock end to end; the exchange is one small framed
+        # message, so re_register/stop wait at most one beat.
+        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
 
@@ -354,6 +364,17 @@ class Replica(object):
 
     def _beat_loop(self):
         while not self._stop.is_set():
+            if not self._beat_once():
+                return  # fenced: beating stops until re_register()
+            self._stop.wait(self.beat_interval)
+
+    def _beat_once(self):
+        """One beat iteration, atomic under the replica lock (state
+        reads, the exchange, and any fence latch are one unit — a
+        re_register serializes entirely before or entirely after it).
+        Returns False when the loop must exit (this identity was
+        fenced)."""
+        with self._lock:
             try:
                 if self._client is None:
                     self._client = reservation.Client(
@@ -379,7 +400,7 @@ class Replica(object):
                 self.server.fence(
                     "lease epoch {} superseded by {}".format(
                         self.epoch, e.epoch))
-                return
+                return False
             except Exception as e:  # noqa: BLE001 - beats must survive
                 logger.warning("replica %s beat failed: %s",
                                self.replica_id, e)
@@ -389,7 +410,7 @@ class Replica(object):
                     except Exception:  # noqa: BLE001
                         pass
                     self._client = None
-            self._stop.wait(self.beat_interval)
+        return True
 
     # -- lifecycle (shared verbs: rolling_drain / retirement call these
     # on in-process Replicas and RemoteReplicas alike) ---------------------
@@ -429,10 +450,26 @@ class Replica(object):
         asserts this replica is the one that should serve), clear the
         server's fenced latch, and restart the beat loop. The operator/
         supervisor decision the ``Fenced`` taxonomy demands — never an
-        automatic retry."""
-        self.epoch = None  # re-acquired by the loop's lease call
-        self.fenced = False
-        self.server.unfence()
+        automatic retry.
+
+        Serialized against the beat loop: the reset runs either before
+        a beat iteration (which then simply leases the fresh epoch) or
+        after its fence latch (which this reset then clears and, the
+        fenced loop being on its way out, a FRESH loop replaces) —
+        never interleaved with one, so a racing FENCED verdict can no
+        longer overwrite this reset and strand the replica fenced with
+        no beat loop."""
+        with self._lock:
+            was_fenced = self.fenced
+            self.epoch = None  # re-acquired by the loop's lease call
+            self.fenced = False
+            self.server.unfence()
+        thread = self._thread
+        if was_fenced and thread is not None and thread.is_alive():
+            # the latch ran under the lock BEFORE this reset took it,
+            # so the old loop is exiting; wait it out rather than
+            # racing a corpse that is still returning
+            thread.join(timeout=5)
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
             self._thread = threading.Thread(
@@ -444,15 +481,26 @@ class Replica(object):
 
     def stop(self):
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5)
             self._thread = None
-        if self._client is not None:
-            try:
-                self._client.close()
-            except Exception:  # noqa: BLE001
-                pass
-            self._client = None
+        if thread is None or not thread.is_alive():
+            # loop is down: the lock is free and closing is safe
+            with self._lock:
+                if self._client is not None:
+                    try:
+                        self._client.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._client = None
+        else:
+            # a beat wedged past the join timeout still owns the
+            # client; closing it out from under a mid-exchange daemon
+            # thread is the use-after-close this lock exists to stop
+            logger.warning(
+                "replica %s beat thread busy at stop(); leaving its "
+                "client to the daemon thread", self.replica_id)
         self.server.stop()
 
 
@@ -548,8 +596,10 @@ class ServingNode(object):
         # respond FIRST, then tear down: stop() closes the very HTTP
         # server this handler is answering through, and the driver's
         # bounded-deadline RPC must see its 200 rather than a reset
+        # tfos: unjoined(the timer tears down its own process; nothing survives to join it)
         timer = threading.Timer(0.2, self.stop)
         timer.daemon = True
+        timer.name = "tfos-admin-stop-{}".format(self.replica_id)
         timer.start()
         return {"replica_id": self.replica_id, "stopping": True}
 
@@ -767,6 +817,7 @@ def _http_exchange(addr, method, path, body, headers, timeout,
         finally:
             done.set()
 
+    # tfos: unjoined(abandoned by design on abort: it may be blocked in recv on the socket just shut down)
     worker = threading.Thread(target=_exchange, daemon=True,
                               name="tfos-fleet-upstream")
     worker.start()
@@ -1077,6 +1128,7 @@ class FleetRouter(object):
                     outcomes.append((label, "err", e))
                     cv.notify_all()
 
+        # tfos: unjoined(the race loop collects outcomes via the cv; a losing attempt may outlive the dispatch by design)
         threading.Thread(target=_run, args=("primary",), daemon=True,
                          name="tfos-fleet-attempt").start()
         with cv:
@@ -1089,6 +1141,7 @@ class FleetRouter(object):
                 self.counters.inc("hedges")
             self.flight.instant("hedge_fired", trace=trace,
                                 delay_s=round(hedge_delay, 4))
+            # tfos: unjoined(same contract as the primary attempt above)
             threading.Thread(target=_run,
                              args=("hedge", True), daemon=True,
                              name="tfos-fleet-hedge").start()
@@ -1442,7 +1495,8 @@ class FleetRouter(object):
             if not addr:
                 continue
             t = threading.Thread(target=_fetch, args=(rid, addr),
-                                 daemon=True)
+                                 daemon=True,
+                                 name="tfos-trace-fetch-{}".format(rid))
             t.start()
             threads.append(t)
         for t in threads:
@@ -1747,19 +1801,49 @@ class ServingFleet(object):
         self._next_idx = 0
         self._np_params = None
         self._spawns = {}  # rid -> AsyncResult of its bootstrap task
+        # guards the width bookkeeping (replicas / _next_idx /
+        # _spawns) AND the executor-placement decision: the
+        # autoscaler's control thread and operator threads drive
+        # spawn/retire/replace concurrently, and the unlocked
+        # ``_next_idx += 1`` read-modify-write can mint the SAME
+        # replica id twice (two engines, one identity, one lease —
+        # split-brain by construction), an unlocked list-mutation can
+        # make ``_replica`` skip a member mid-scan, and an unlocked
+        # free_executor()-then-dispatch lets two spawns both pick the
+        # SAME free executor. RLock: the placement section holds it
+        # across helpers (free_executor / _dispatch_spawn) that take
+        # it themselves. Pinned by test_fleet.py's concurrent
+        # _new_rid/_replica tests.
+        self._lock = threading.RLock()
 
     # -- replica construction ----------------------------------------------
 
     def _new_rid(self):
-        rid = "replica-{}".format(self._next_idx)
-        self._next_idx += 1
-        return rid
+        with self._lock:
+            rid = "replica-{}".format(self._next_idx)
+            self._next_idx += 1
+            return rid
 
     def _replica(self, rid):
-        for replica in self.replicas:
-            if replica.replica_id == str(rid):
-                return replica
+        with self._lock:
+            for replica in self.replicas:
+                if replica.replica_id == str(rid):
+                    return replica
         return None
+
+    def _track(self, replica):
+        with self._lock:
+            self.replicas.append(replica)
+
+    def _untrack(self, replica):
+        """Remove ``replica`` from the registry; True when it was
+        tracked (the membership check and the removal are one atomic
+        unit — two concurrent untracks cannot both 'win')."""
+        with self._lock:
+            if replica in self.replicas:
+                self.replicas.remove(replica)
+                return True
+            return False
 
     def _spawn_local_replica(self, rid):
         from tensorflowonspark_tpu.serving import DecodeEngine, \
@@ -1782,7 +1866,7 @@ class ServingFleet(object):
             # tracked BEFORE start(): a replica that fails to start
             # must be reachable by the cleanup below, or its engine's
             # scheduler thread leaks
-            self.replicas.append(replica)
+            self._track(replica)
         except BaseException:
             engine.stop()
             raise
@@ -1812,8 +1896,9 @@ class ServingFleet(object):
     def replica_hosts(self):
         """{replica_id: executor_id} for executor-hosted replicas —
         the placement ledger scale-up consults."""
-        return {r.replica_id: r.executor_id for r in self.replicas
-                if getattr(r, "remote", False)}
+        with self._lock:
+            return {r.replica_id: r.executor_id for r in self.replicas
+                    if getattr(r, "remote", False)}
 
     def free_executor(self):
         """An alive, eligible executor hosting no replica — the
@@ -1846,9 +1931,10 @@ class ServingFleet(object):
         result = rdd.foreachPartitionAsync(
             node_mod.serve_replica(spec), one_task_per_executor=True,
             exclude=[e for e in alive if e != eid])
-        self._spawns[rid] = result
         replica = RemoteReplica(rid, self.reservation, executor_id=eid)
-        self.replicas.append(replica)
+        with self._lock:
+            self._spawns[rid] = result
+            self.replicas.append(replica)
         return replica
 
     def _await_lease(self, rid, timeout, min_epoch=None):
@@ -1957,21 +2043,48 @@ class ServingFleet(object):
                     "supervisor's RestartEngine, not by respawn")
             replica = self._spawn_local_replica(rid)
         else:
-            eid = executor_id if executor_id is not None \
-                else self.free_executor()
-            if eid is None:
-                raise NoCapacity(
-                    "no free executor to place replica {} on "
-                    "(alive/eligible: {}, hosting: {})".format(
-                        rid, self.alive_executors(),
-                        self.replica_hosts()))
-            if replacing:
-                # fence the corpse BEFORE the replacement's first
-                # lease call: from this instant any beat the old
-                # holder still manages is answered FENCED
-                min_epoch = self.reservation.mint_epoch(rid)
-                self.replicas.remove(self._replica(rid))
-            replica = self._dispatch_spawn(rid, eid)
+            # the pick and the dispatch are ONE atomic placement
+            # decision: free_executor() reads the hosting ledger, and
+            # two concurrent spawns racing between the read and
+            # _dispatch_spawn's track would both pick the same free
+            # executor — the second bootstrap can never run there and
+            # burns its whole spawn_timeout on a fleet with genuinely
+            # free capacity elsewhere
+            with self._lock:
+                corpse = self._replica(rid) if replacing else None
+                if corpse is not None:
+                    # untrack the corpse BEFORE the pick: its own
+                    # executor must count as free for its replacement
+                    # (a revived executor is a valid — often the only
+                    # — target; picking around it wedged a
+                    # single-executor fleet in NoCapacity forever)
+                    self._untrack(corpse)
+                try:
+                    eid = executor_id if executor_id is not None \
+                        else self.free_executor()
+                    if eid is None:
+                        raise NoCapacity(
+                            "no free executor to place replica {} on "
+                            "(alive/eligible: {}, hosting: {})".format(
+                                rid, self.alive_executors(),
+                                self.replica_hosts()))
+                    if replacing:
+                        # fence the corpse BEFORE the replacement's
+                        # first lease call: from this instant any beat
+                        # the old holder still manages is answered
+                        # FENCED. Minted only once capacity exists —
+                        # a blocked replacement must not fence an
+                        # incarnation nothing will supersede.
+                        min_epoch = self.reservation.mint_epoch(rid)
+                    replica = self._dispatch_spawn(rid, eid)
+                except BaseException:
+                    # the dead identity must STAY TRACKED on any
+                    # pre-dispatch failure, or the autoscaler forgets
+                    # it ever existed and REPLACE stops re-firing
+                    # (the PR-13 hardening contract)
+                    if corpse is not None:
+                        self._track(corpse)
+                    raise
         try:
             info = self._await_lease(rid, timeout, min_epoch=min_epoch)
             if not FleetRouter._await_healthz(tuple(info["addr"]),
@@ -1987,8 +2100,8 @@ class ServingFleet(object):
             # would make the autoscaler forget the dead replica ever
             # existed (no further REPLACE decisions, a min=1 fleet
             # stuck at zero forever)
-            if not replacing and replica in self.replicas:
-                self.replicas.remove(replica)
+            if not replacing:
+                self._untrack(replica)
             raise
         if self.router is not None:
             # wire-verified above: clear every hold and any failure
@@ -2044,8 +2157,7 @@ class ServingFleet(object):
             logger.warning("retirement stop of replica %s failed: %s",
                            rid, e)
         self.reservation.mint_epoch(rid)
-        if replica in self.replicas:
-            self.replicas.remove(replica)
+        self._untrack(replica)
         self.reservation.drop_lease(rid)
         if self.router is not None:
             self.router.readmit(rid, owner="autoscale")
@@ -2110,7 +2222,7 @@ class ServingFleet(object):
         if self.router is not None:
             self.router.stop()
             self.router = None
-        for replica in self.replicas:
+        for replica in list(self.replicas):
             # RemoteReplica.stop is a bounded /admin/stop RPC and
             # swallows unreachable-executor failures — teardown must
             # not hang on (or leak) executor-hosted node processes
@@ -2123,12 +2235,14 @@ class ServingFleet(object):
         # corpses must not linger in the registry, or a restart would
         # route/drain/watch over duplicate replica_ids with dead
         # engines
-        self.replicas = []
-        self._spawns = {}
-        # a re-start() names from replica-0 again (fresh formation;
-        # identity reuse is safe — Client.lease mints the NEXT epoch
-        # even against a shared reservation server's history)
-        self._next_idx = 0
+        with self._lock:
+            self.replicas = []
+            self._spawns = {}
+            # a re-start() names from replica-0 again (fresh
+            # formation; identity reuse is safe — Client.lease mints
+            # the NEXT epoch even against a shared reservation
+            # server's history)
+            self._next_idx = 0
         if self._own_reservation:
             self.reservation.stop()
             # a stopped Server cannot serve again (its done latch stays

@@ -198,6 +198,7 @@ def start(authkey, queues, mode="local", host=None, maxsize=QUEUE_MAXSIZE):
 
     mgr = _Server(address=address, authkey=authkey)
     server = mgr.get_server()
+    # tfos: unjoined(process-lifetime queue broker; serve_forever ends with the executor process)
     threading.Thread(target=server.serve_forever, name="tfmanager-server",
                      daemon=True).start()
     # get_server() binds immediately, so server.address is final here.

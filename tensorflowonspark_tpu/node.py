@@ -463,6 +463,7 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                     except Exception:
                         pass
 
+            # tfos: unjoined(exits with the trainer process it watches; the executor task has no later teardown hook)
             threading.Thread(target=_watch, name="trainer-watchdog",
                              daemon=True).start()
         else:
@@ -647,6 +648,7 @@ def _start_beat_thread(cluster_meta, mgr, executor_id):
                 except Exception:  # noqa: BLE001
                     pass
 
+    # tfos: unjoined(silenced by _shutdown's final SYNCHRONOUS beat at teardown; the daemon loop ends with the executor)
     threading.Thread(target=_beat_loop, name="tfos-beat-%s" % executor_id,
                      daemon=True).start()
 
@@ -1102,7 +1104,8 @@ def _probe_feed_transport(ring, reps=4, records=32):
 
         # the authkey handshake is synchronous on BOTH ends, so accept
         # must already be in flight when Client() connects
-        acceptor = threading.Thread(target=_accept, daemon=True)
+        acceptor = threading.Thread(target=_accept, daemon=True,
+                                    name="tfos-probe-accept")
         acceptor.start()
         wconn = _ConnClient(listener.address, authkey=probe_key)
         try:  # from here every exit path must close both pair ends

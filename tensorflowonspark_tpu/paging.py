@@ -209,12 +209,38 @@ class BlockPool(object):
         :meth:`allocatable`, while sharing a LIVE block (another
         in-flight sequence holds a reference) costs nothing — the
         distinction that lets concurrent same-prefix requests admit
-        together instead of serializing on a pool-sized prefix."""
+        together instead of serializing on a pool-sized prefix.
+
+        NOTE: pricing a plan against capacity needs
+        :meth:`plan_admission` — a separate ``allocatable()`` call is
+        a SECOND lock acquisition, and the pool can mutate between the
+        two (the racecheck triage's torn-read finding: an admission
+        estimate on an HTTP handler thread straddling the scheduler's
+        ``acquire`` double-counted the deficit and shed feasible
+        deadlines)."""
+        ids, need, lru_resident, _, _ = self.plan_admission(tokens)
+        return ids, need, lru_resident
+
+    def plan_admission(self, tokens):
+        """(shared_ids, new_blocks_needed, lru_resident, allocatable,
+        epoch) — :meth:`plan` plus the pool's current capacity and
+        mutation epoch, all read under ONE lock hold, so the deficit
+        ``new_needed + lru_resident - allocatable`` is priced against
+        a single consistent snapshot and the epoch provably matches
+        the verdict (the blocked-head memo's key). Invariant a torn
+        read breaks and this cannot: ``lru_resident`` and
+        ``allocatable`` move together when a chain is acquired, so
+        ``lru_resident + (total - allocatable)`` never exceeds the
+        chain's own length plus the truly-live block count (pinned by
+        the concurrent churn test in tests/test_paged_kv.py)."""
         tokens = list(tokens)
         with self._lock:
             ids, _ = self._walk_locked(tokens)
             lru_resident = sum(1 for bid in ids if bid in self._lru)
-        return ids, self.blocks_for(len(tokens)) - len(ids), lru_resident
+            allocatable = len(self._free) + len(self._lru)
+            epoch = self._epoch
+        return (ids, self.blocks_for(len(tokens)) - len(ids),
+                lru_resident, allocatable, epoch)
 
     def register(self, tokens, n_tokens, block_id, origin="prompt"):
         """Publish ``block_id`` as holding the K/V of the FULL block

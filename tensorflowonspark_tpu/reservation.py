@@ -464,6 +464,7 @@ class Server(object):
             self._stats_httpd = ThreadingHTTPServer(("", 0), Handler)
             self.stats_addr = (self.addr[0],
                                self._stats_httpd.server_address[1])
+            # tfos: unjoined(stop() shuts the httpd down; serve_forever returns and the daemon exits)
             threading.Thread(target=self._stats_httpd.serve_forever,
                              name="reservation-stats-http",
                              daemon=True).start()
@@ -478,7 +479,9 @@ class Server(object):
                 conn, _ = self._sock.accept()
             except OSError:
                 break  # listening socket closed by stop()
-            threading.Thread(target=self._handle, args=(conn,), daemon=True).start()
+            # tfos: unjoined(one daemon per connection, bounded by recv_deadline; ends at socket close)
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True, name="tfos-resv-conn").start()
 
     def _handle(self, conn):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)

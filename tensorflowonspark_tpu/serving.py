@@ -880,9 +880,14 @@ class DecodeEngine(object):
             # EARLIEST possible release so a tight deadline sheds at
             # the door (503 + Retry-After) instead of queueing into a
             # certain 504.
-            shared, need, lru_shared = self._pool.plan(prompt)
-            deficit = need + lru_shared + extra_blocks \
-                - self._pool.allocatable()
+            # ONE atomic pool snapshot (plan_admission): plan and
+            # capacity from separate lock holds can straddle a
+            # scheduler-side acquire/release — the torn read
+            # double-counts the deficit (spurious shed) or masks it
+            # (admit into a certain 504)
+            shared, need, lru_shared, allocatable, _ = \
+                self._pool.plan_admission(prompt)
+            deficit = need + lru_shared + extra_blocks - allocatable
             if deficit > 0 and remaining:
                 wait = max(wait, min(remaining) * step)
         return {"queue_wait_s": wait,
@@ -1376,14 +1381,20 @@ class DecodeEngine(object):
                             # (planned admissions alloc — and bump —
                             # right after the scan), so the old
                             # verdict stands.
-                            epoch = self._pool.epoch()
-                            if self._head_block_memo == (head, epoch):
+                            if self._head_block_memo == \
+                                    (head, self._pool.epoch()):
                                 break
                             toks = head.prompt + head._tokens
-                            shared, need, lru_shared = \
-                                self._pool.plan(toks)
+                            # verdict and capacity from ONE pool
+                            # snapshot; the memo stores the epoch OF
+                            # that snapshot, so a mutation landing
+                            # mid-scan (drop_cache from an operator
+                            # thread) invalidates it next step instead
+                            # of pinning a torn verdict
+                            shared, need, lru_shared, allocatable, \
+                                epoch = self._pool.plan_admission(toks)
                             if need + lru_shared + planned_blocks \
-                                    > self._pool.allocatable():
+                                    > allocatable:
                                 self._head_block_memo = (head, epoch)
                                 break
                             self._head_block_memo = None
@@ -2603,9 +2614,16 @@ class ModelServer(object):
 
         def _on_sigterm(signum, frame):
             logger.warning("SIGTERM: draining serving %r", self.name)
+            # daemon=False is the CONTRACT, not an omission: the
+            # interpreter joins non-daemon threads at exit, so the
+            # drain finishes before the process dies — a daemon drain
+            # would be killed mid-zero-loss exactly when SIGTERM-then-
+            # exit is the whole point
+            # tfos: unjoined(non-daemon: interpreter exit IS the join)
             threading.Thread(target=self.drain,
                              kwargs={"timeout": timeout},
-                             name="tfos-serving-drain").start()
+                             name="tfos-serving-drain",
+                             daemon=False).start()
 
         return signal_mod.signal(signal_mod.SIGTERM, _on_sigterm)
 

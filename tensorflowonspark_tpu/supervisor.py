@@ -806,6 +806,15 @@ class Supervisor(object):
                     payload={"lease": info, "replica": rid}))
             elif not dead and rid in watch["reported"]:
                 watch["reported"].discard(rid)
+                if fleet.router is not None:
+                    # release OUR hold (owner-scoped): the lease
+                    # recovered WITHOUT a replacement — a beat stall,
+                    # not a death — so spawn_replica's force-clear
+                    # will never run, and an unreleased supervisor
+                    # quiesce would hold a healthy replica out of
+                    # routing forever (a 1-replica fleet: 503s
+                    # despite a live, beating replica)
+                    fleet.router.readmit(rid, owner="supervisor")
                 self.events.record("serving_replica_recovered",
                                    replica=rid)
 

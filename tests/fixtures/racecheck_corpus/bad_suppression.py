@@ -1,0 +1,17 @@
+"""Racecheck fixture: a suppression with an EMPTY reason — the
+grammar demands one, so this MUST flag bad-suppression."""
+
+import threading
+
+
+class EmptyReason(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def inc(self):
+        with self._lock:
+            self._n += 1
+
+    def reset(self):
+        self._n = 0  # tfos: unguarded()

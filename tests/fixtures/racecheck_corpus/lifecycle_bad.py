@@ -1,0 +1,46 @@
+"""Racecheck fixture: thread-lifecycle and taxonomy violations that
+MUST flag."""
+
+import threading
+
+
+class Retriable(RuntimeError):
+    pass
+
+
+class Shed(Retriable):
+    pass
+
+
+def spawn_anonymous():
+    # MUST FLAG thread-daemon + thread-name + thread-unjoined
+    threading.Thread(target=print).start()
+
+
+def spawn_named_no_daemon():
+    # MUST FLAG thread-daemon (name present, daemon absent)
+    t = threading.Thread(target=print, name="fixture-worker")
+    t.start()
+    t.join()
+
+
+class Spawner(object):
+    def start(self):
+        # MUST FLAG thread-unjoined: no join on self._t anywhere
+        self._t = threading.Thread(target=print, name="fixture-bg",
+                                   daemon=True)
+        self._t.start()
+
+
+def swallow(fn):
+    try:
+        return fn()
+    except Shed:
+        pass  # MUST FLAG retriable-swallow: eaten, not mapped
+
+
+def swallow_logged(fn, logger):
+    try:
+        return fn()
+    except (Retriable, ValueError) as e:
+        logger.warning("ignored: %s", e)  # MUST FLAG: logging != mapping

@@ -1,0 +1,79 @@
+"""Racecheck fixture: lock-order hazards that MUST flag, and an
+ordered twin that must not."""
+
+import threading
+
+
+class Deadlocky(object):
+    """A-under-B in one method, B-under-A in another — two threads
+    taking these in opposite order deadlock. MUST FLAG lock-order."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                pass
+
+
+class DeadlockyViaCall(object):
+    """Same cycle, one leg hidden behind an intra-class call."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            self._take_b()
+
+    def _take_b(self):
+        with self._b:
+            pass
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                pass
+
+
+class SelfNest(object):
+    """Re-entering a non-reentrant Lock via a Condition alias —
+    single-thread deadlock. MUST FLAG lock-self-nest."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def wedge(self):
+        with self._lock:
+            with self._cv:
+                pass
+
+
+class Ordered(object):
+    """Consistent order everywhere — must pass clean."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._a:
+            self._take_b()
+
+    def _take_b(self):
+        with self._b:
+            pass

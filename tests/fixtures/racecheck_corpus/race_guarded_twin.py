@@ -1,0 +1,57 @@
+"""Racecheck fixture: the guarded TWIN of race_unguarded.py — same
+shapes, every mutation provably under the lock (directly or through
+the caller-holds-the-lock convention) — MUST pass clean."""
+
+import threading
+
+
+class Guarded(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._items = []
+
+    def inc(self):
+        with self._lock:
+            self._count += 1
+            self._items.append(self._count)
+
+    def reset(self):
+        with self._lock:
+            self._count = 0
+
+    def bump_twice(self):
+        with self._lock:
+            self._bump()          # caller holds the lock ...
+
+    def _bump(self):
+        self._count += 1          # ... so this is GUARDED (no flag)
+
+    def shrink(self):
+        with self._lock:
+            self._items.pop()
+
+
+class CrossThreadGuarded(object):
+    """Thread + public writer sharing state, correctly: both sides
+    take the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._seen = 0
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fixture-loop", daemon=True)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self._seen += 1
+
+    def note(self):
+        with self._lock:
+            self._seen += 1
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join()

@@ -1,0 +1,57 @@
+"""Racecheck fixture: known races that MUST flag (tests/test_analysis.py).
+
+Parsed, never imported — the analyzer is purely syntactic.
+"""
+
+import threading
+
+
+class Racy(object):
+    """The guarded-attribute race shape: _count is mutated under
+    _lock in inc() — so it is guarded — and mutated bare in the
+    public reset() and in a private helper reached from an UNLOCKED
+    public path."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._items = []
+
+    def inc(self):
+        with self._lock:
+            self._count += 1
+            self._items.append(self._count)
+
+    def reset(self):
+        self._count = 0           # MUST FLAG: unguarded assign
+
+    def bump_twice(self):
+        self._bump()              # unlocked call site ...
+
+    def _bump(self):
+        self._count += 1          # MUST FLAG: reached unlocked
+
+    def shrink(self):
+        self._items.pop()         # MUST FLAG: unguarded mutator call
+
+
+class CrossThread(object):
+    """The cross-thread shape: _seen mutated lock-free both by the
+    spawned loop and a public method; no lock exists at all."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._seen = 0
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fixture-loop", daemon=True)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._seen += 1       # thread root ...
+
+    def note(self):
+        self._seen += 1           # MUST FLAG: ... and a public root
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join()

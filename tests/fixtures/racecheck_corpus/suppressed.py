@@ -1,0 +1,34 @@
+"""Racecheck fixture: every violation carries a valid suppression —
+MUST pass clean (the suppression grammar round-trip)."""
+
+import threading
+
+
+class Retriable(RuntimeError):
+    pass
+
+
+class RacySuppressed(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def inc(self):
+        with self._lock:
+            self._count += 1
+
+    def reset(self):
+        self._count = 0  # tfos: unguarded(fixture: single-writer by construction)
+
+
+def spawn():
+    # tfos: unjoined(fixture: fire-and-forget by design)
+    threading.Thread(target=print, name="fixture-ff",
+                     daemon=True).start()
+
+
+def swallow(fn):
+    try:
+        return fn()
+    except Retriable:  # tfos: swallow(fixture: best-effort probe, caller polls state())
+        pass

@@ -1,0 +1,431 @@
+"""Concurrency lint plane (PR 14): the analyzer analyzed.
+
+Four layers:
+
+- FIXTURE CORPUS — known-race snippets that must flag, guarded twins
+  that must pass, a lock-order cycle, the thread-lifecycle and
+  retriable-swallow rules (tests/fixtures/racecheck_corpus/).
+- SUPPRESSION + BASELINE round-trip — the ``# tfos: <tag>(<reason>)``
+  grammar silences exactly its finding (an EMPTY reason is itself a
+  finding), baseline entries absorb keyed findings, stale entries
+  warn, and a baseline entry with no written reason fails the gate.
+- UNITS — entry-context propagation (the caller-holds-the-lock
+  convention), the Condition(lock) alias, thread-spawn labeling.
+- SELF-CHECK — ``make racecheck`` (the exact driver `make` runs) is
+  clean on the live package modulo the checked-in baseline: the gate
+  that fails CI on new findings provably passes on the tree it ships
+  with.
+
+Pure python (ast only) — no jax, no sockets, tier-1 cheap.
+"""
+
+import ast
+import io
+import json
+import os
+import textwrap
+
+from tensorflowonspark_tpu.analysis import core, guards, lifecycle, \
+    lockorder, racecheck, report
+
+CORPUS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "fixtures", "racecheck_corpus")
+
+
+def _keys(path):
+    findings, _, bad = racecheck.analyze_file(
+        os.path.join(CORPUS, path), rel=path)
+    return {f.key for f in findings} | {f.key for f in bad}
+
+
+def _rules(path):
+    return {k.split(":")[0] for k in _keys(path)}
+
+
+def _analyze_source(source):
+    tree = ast.parse(textwrap.dedent(source))
+    models = core.build_class_models(tree, "snippet.py")
+    return (guards.check(models) + lockorder.check(models)
+            + lifecycle.check(tree, "snippet.py"))
+
+
+# -- fixture corpus ---------------------------------------------------------
+
+class TestCorpus:
+    def test_known_race_flags(self):
+        keys = _keys("race_unguarded.py")
+        assert ("unguarded:race_unguarded.py:Racy.reset:_count"
+                in keys)
+        assert ("unguarded:race_unguarded.py:Racy._bump:_count"
+                in keys), "private helper reached unlocked must flag"
+        assert ("unguarded:race_unguarded.py:Racy.shrink:_items"
+                in keys), "in-place mutator call must flag"
+        assert ("cross-thread:race_unguarded.py:CrossThread:_seen"
+                in keys)
+
+    def test_guarded_twin_passes(self):
+        assert _keys("race_guarded_twin.py") == set(), \
+            "the guarded twin (incl. caller-holds-the-lock helper) " \
+            "must produce zero findings"
+
+    def test_lock_cycle_flags(self):
+        keys = _keys("lock_cycle.py")
+        assert any(k.startswith("lock-order:lock_cycle.py:Deadlocky:")
+                   for k in keys)
+        assert any(
+            k.startswith("lock-order:lock_cycle.py:DeadlockyViaCall:")
+            for k in keys), "cycle through an intra-class call edge"
+        assert ("lock-self-nest:lock_cycle.py:SelfNest:_lock"
+                in keys), "Condition(lock) alias re-entry"
+        assert not any(":Ordered:" in k for k in keys), \
+            "consistent order must pass"
+
+    def test_lifecycle_rules_flag(self):
+        rules = _rules("lifecycle_bad.py")
+        assert {"thread-daemon", "thread-name", "thread-unjoined",
+                "retriable-swallow"} <= rules
+
+    def test_corpus_fails_the_gate(self):
+        # the acceptance shape: racecheck exits non-zero on the race
+        # corpus (no baseline)...
+        rc = racecheck.run([CORPUS], None, out=io.StringIO(),
+                           err=io.StringIO())
+        assert rc == 1
+
+
+# -- suppression + baseline round-trip -------------------------------------
+
+class TestSuppressionAndBaseline:
+    def test_suppressed_corpus_is_clean(self):
+        findings, suppressed, bad = racecheck.analyze_file(
+            os.path.join(CORPUS, "suppressed.py"), rel="suppressed.py")
+        assert findings == [] and bad == []
+        assert suppressed >= 3, "each suppression tallies"
+
+    def test_empty_reason_is_itself_a_finding(self):
+        keys = _keys("bad_suppression.py")
+        assert any(k.startswith("bad-suppression:") for k in keys)
+
+    def test_baseline_absorbs_and_stale_warns(self, tmp_path):
+        target = os.path.join(CORPUS, "race_unguarded.py")
+        # keys must match what run() records: repo-relative paths
+        rel = os.path.relpath(target,
+                              os.path.dirname(racecheck._PKG_ROOT))
+        findings, _, _ = racecheck.analyze_file(target, rel=rel)
+        entries = [{"key": f.key, "reason": "fixture: known benign"}
+                   for f in findings]
+        entries.append({"key": "unguarded:gone.py:Gone.fn:x",
+                        "reason": "stale on purpose"})
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(json.dumps({"entries": entries}))
+        out, err = io.StringIO(), io.StringIO()
+        rc = racecheck.run([target], str(baseline), out=out, err=err)
+        assert rc == 0, err.getvalue()
+        assert "stale baseline entry" in err.getvalue()
+        assert "gone.py" in err.getvalue()
+
+    def test_baseline_without_reason_fails(self, tmp_path):
+        target = os.path.join(CORPUS, "race_guarded_twin.py")
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(json.dumps({"entries": [
+            {"key": "unguarded:x.py:C.m:attr", "reason": ""}]}))
+        err = io.StringIO()
+        rc = racecheck.run([target], str(baseline),
+                           out=io.StringIO(), err=err)
+        assert rc == 1
+        assert "no written reason" in err.getvalue()
+
+    def test_suppression_is_per_site(self, tmp_path):
+        # two unguarded sites of the same method+attr: a suppression
+        # on the SECOND silences only it; the first still flags
+        src = (
+            "import threading\n\n\n"
+            "class C(object):\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._n = 0\n\n"
+            "    def inc(self):\n"
+            "        with self._lock:\n"
+            "            self._n += 1\n\n"
+            "    def reset(self):\n"
+            "        self._n = 0\n"
+            "        self._n = 1  # tfos: unguarded(second site only)\n")
+        target = tmp_path / "two_sites.py"
+        target.write_text(src)
+        findings, suppressed, bad = racecheck.analyze_file(
+            str(target), rel="two_sites.py")
+        assert bad == []
+        assert suppressed == 1
+        assert len(findings) == 1
+        assert findings[0].line == 14, \
+            "the UNsuppressed first site must still flag"
+
+    def test_bad_suppression_is_not_baselineable(self, tmp_path):
+        target = os.path.join(CORPUS, "bad_suppression.py")
+        rel = os.path.relpath(target,
+                              os.path.dirname(racecheck._PKG_ROOT))
+        _, _, bad = racecheck.analyze_file(target, rel=rel)
+        assert bad, "fixture must produce a bad-suppression finding"
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(json.dumps({"entries": [
+            {"key": f.key, "reason": "trying to launder it"}
+            for f in bad]}))
+        err = io.StringIO()
+        rc = racecheck.run([target], str(baseline),
+                           out=io.StringIO(), err=err)
+        assert rc == 1, \
+            "an empty-reason suppression must not be baselineable"
+        assert "bad-suppression" in err.getvalue()
+        # and --emit-baseline refuses to print it as a skeleton entry
+        out = io.StringIO()
+        racecheck.run([target], None, emit_skeleton=True, out=out,
+                      err=io.StringIO())
+        assert all("bad-suppression" not in e["key"]
+                   for e in json.loads(out.getvalue())["entries"])
+
+    def test_explicit_missing_baseline_is_an_error(self):
+        # (the "cannot read baseline" message rides whatever
+        # sys.stderr was at import — exit code 2 is the contract)
+        rc = racecheck.main(
+            [os.path.join(CORPUS, "race_guarded_twin.py"),
+             "--baseline", "/nonexistent/baseline.json"])
+        assert rc == 2, \
+            "a missing EXPLICIT baseline path must fail loudly"
+
+    def test_timer_obeys_lifecycle_rules(self, tmp_path):
+        flagged = _analyze_source("""
+            import threading
+
+            def fire():
+                threading.Timer(0.5, print).start()
+            """)
+        rules = {f.rule for f in flagged}
+        assert {"thread-daemon", "thread-name",
+                "thread-unjoined"} <= rules
+        # the Timer idiom — daemon/name set as ATTRIBUTES (its
+        # constructor takes neither) + a declared fire-and-forget —
+        # must pass through analyze_file's suppression handling
+        target = tmp_path / "timer_ok.py"
+        target.write_text(
+            "import threading\n\n\n"
+            "def fire():\n"
+            "    # tfos: unjoined(tears down its own process)\n"
+            "    t = threading.Timer(0.5, print)\n"
+            "    t.daemon = True\n"
+            "    t.name = 'tfos-timer'\n"
+            "    t.start()\n")
+        findings, suppressed, bad = racecheck.analyze_file(
+            str(target), rel="timer_ok.py")
+        assert findings == [] and bad == [] and suppressed == 1
+
+    def test_emit_baseline_skeleton(self):
+        out = io.StringIO()
+        rc = racecheck.run([os.path.join(CORPUS, "race_unguarded.py")],
+                           None, emit_skeleton=True, out=out,
+                           err=io.StringIO())
+        assert rc == 1
+        doc = json.loads(out.getvalue())
+        assert doc["entries"] and all(e["reason"] == ""
+                                      for e in doc["entries"])
+
+
+# -- units ------------------------------------------------------------------
+
+class TestUnits:
+    def test_caller_lock_propagates_through_private_chain(self):
+        findings = _analyze_source("""
+            import threading
+
+            class C(object):
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def outer(self):
+                    with self._lock:
+                        self._mid()
+
+                def _mid(self):
+                    self._leaf()
+
+                def _leaf(self):
+                    self._n += 1
+
+                def write(self):
+                    with self._lock:
+                        self._n = 0
+            """)
+        assert findings == [], \
+            "two-hop locked call chain must count as guarded"
+
+    def test_mixed_reachability_flags(self):
+        findings = _analyze_source("""
+            import threading
+
+            class C(object):
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def locked(self):
+                    with self._lock:
+                        self._leaf()
+
+                def unlocked(self):
+                    self._leaf()
+
+                def _leaf(self):
+                    self._n += 1
+
+                def write(self):
+                    with self._lock:
+                        self._n = 0
+            """)
+        assert [f for f in findings
+                if f.rule == "unguarded" and "_leaf" in f.ident], \
+            "a helper reachable locked AND unlocked must flag"
+
+    def test_condition_alias_guards_the_wrapped_lock(self):
+        findings = _analyze_source("""
+            import threading
+
+            class C(object):
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cv = threading.Condition(self._lock)
+                    self._n = 0
+
+                def a(self):
+                    with self._lock:
+                        self._n += 1
+
+                def b(self):
+                    with self._cv:
+                        self._n += 1
+            """)
+        assert findings == [], \
+            "holding Condition(self._lock) holds self._lock"
+
+    def test_construction_is_exempt(self):
+        findings = _analyze_source("""
+            import threading
+
+            class C(object):
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+                    self._n += 1
+
+                def write(self):
+                    with self._lock:
+                        self._n = 0
+            """)
+        assert findings == []
+
+    def test_sync_primitives_are_exempt(self):
+        findings = _analyze_source("""
+            import threading
+
+            class C(object):
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._stop = threading.Event()
+                    self._n = 0
+
+                def locked_clear(self):
+                    with self._lock:
+                        self._stop.clear()
+                        self._n += 1
+
+                def bare_clear(self):
+                    self._stop.clear()
+            """)
+        assert findings == [], "Event.clear() is not a mutation"
+
+    def test_thread_label_prefers_literal_name(self):
+        tree = ast.parse(
+            "import threading\n"
+            "def f():\n"
+            "    threading.Thread(target=f, "
+            "name='w-{}'.format(1)).start()\n")
+        found = lifecycle.check(tree, "x.py")
+        assert any("f:thread:w-{}" in f.ident for f in found)
+
+    def test_report_emit_shapes(self):
+        out, err = io.StringIO(), io.StringIO()
+        rc = report.emit("gate", [], ok_summary="all good",
+                         out=out, err=err)
+        assert rc == 0 and "gate: all good" in out.getvalue()
+        rc = report.emit(
+            "gate", [report.Finding("r", "p.py", 3, "C.m:x", "boom")],
+            out=out, err=err)
+        assert rc == 1
+        assert "gate FAILED (1 finding(s)):" in err.getvalue()
+        assert "p.py:3: [r] boom" in err.getvalue()
+        assert "key: r:p.py:C.m:x" in err.getvalue()
+
+
+# -- lock-order details -----------------------------------------------------
+
+class TestLockOrder:
+    def test_rlock_self_nest_is_legal(self):
+        findings = _analyze_source("""
+            import threading
+
+            class C(object):
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        self._inner()
+
+                def _inner(self):
+                    with self._lock:
+                        pass
+            """)
+        assert not [f for f in findings if f.rule == "lock-self-nest"]
+
+    def test_three_lock_cycle(self):
+        findings = _analyze_source("""
+            import threading
+
+            class C(object):
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+                    self._c = threading.Lock()
+
+                def ab(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def bc(self):
+                    with self._b:
+                        with self._c:
+                            pass
+
+                def ca(self):
+                    with self._c:
+                        with self._a:
+                            pass
+            """)
+        cycles = [f for f in findings if f.rule == "lock-order"]
+        assert len(cycles) == 1, "one canonical finding per cycle"
+        assert "_a->_b->_c" in cycles[0].ident
+
+
+# -- self-check -------------------------------------------------------------
+
+class TestSelfCheck:
+    def test_live_package_is_clean_modulo_baseline(self):
+        # the exact invocation `make racecheck` runs: default paths
+        # (the installed package) + the checked-in baseline
+        assert racecheck.main([]) == 0
+
+    def test_baseline_entries_all_carry_reasons(self):
+        entries, bad = racecheck.load_baseline(
+            racecheck.DEFAULT_BASELINE)
+        assert bad == []
+        assert all(reason.strip() for reason in entries.values())

@@ -299,6 +299,190 @@ def test_controller_records_decisions_and_metrics(lm):
         f.stop()
 
 
+class _StubReservation(object):
+    """Serving-snapshot stub: N idle-but-proven replicas (fresh
+    leases, zero queues, completions > 0) — the exact evidence that
+    makes ``decide`` return DOWN."""
+
+    def __init__(self, rids):
+        self.rids = list(rids)
+
+    def serving_snapshot(self):
+        return {rid: {
+            "age": 0.1,
+            "serving": {"alive": True, "draining": False,
+                        "queue_depth": 0, "slot_occupancy": 0,
+                        "queue_wait_ewma_s": 0.0, "slots": 4},
+            "metrics": {"counters": {"tfos_serving": {
+                "counts": {"requests_completed": 7}}}},
+        } for rid in self.rids}
+
+
+class _StubFleet(object):
+    """Just enough ServingFleet surface for AutoscaleController:
+    tracked replicas, a snapshot source, and a retire verb that
+    records every invocation (the double-retire detector)."""
+
+    placement = "driver"
+    router = None
+
+    class _R(object):
+        def __init__(self, rid):
+            self.replica_id = rid
+
+    def __init__(self, rids):
+        self.replicas = [self._R(r) for r in rids]
+        self.reservation = _StubReservation(rids)
+        self.retired = []
+        self._mu = threading.Lock()
+
+    def retire_replica(self, rid, drain_timeout=None):
+        with self._mu:
+            self.retired.append(rid)
+        # hold the apply window open so an unserialized second poll
+        # would evaluate the SAME pre-retire evidence
+        time.sleep(0.05)
+        with self._mu:
+            self.replicas = [r for r in self.replicas
+                             if r.replica_id != rid]
+            self.reservation.rids.remove(rid)
+        return True
+
+
+def test_concurrent_poll_once_retires_exactly_once():
+    """Racecheck regression pin (PR 14, barrier-style like PR 10's
+    two-thread compile-claim test): the controller's decision state
+    (`_state` stamps, suppression memos) is shared between its loop
+    thread and public ``poll_once`` callers. Unserialized, two
+    concurrent polls both read last_down=None over identical idle
+    evidence, both decide DOWN, and both retire — a min_replicas=1
+    fleet shrinks to zero on one verdict. The controller lock makes
+    the second poll see the first's stamp and hold."""
+    stub = _StubFleet(["replica-0", "replica-1"])
+    ctl = autoscale.AutoscaleController(
+        stub, policy=_policy(min_replicas=1, down_cooldown_s=30.0))
+    barrier = threading.Barrier(2)
+    errors = []
+
+    def poll():
+        barrier.wait()
+        try:
+            ctl.poll_once()
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=poll, daemon=True,
+                                name="tfos-test-poll-%d" % i)
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors
+    assert len(stub.retired) == 1, \
+        "one idle verdict must retire exactly one replica, got " \
+        "{}".format(stub.retired)
+    assert len(stub.replicas) == 1
+    # and the second poll's verdict was the cooldown hold, recorded
+    # on the decision trail
+    actions = [e["action"] for e in
+               ctl.events.events("autoscale_decision")]
+    assert actions.count("down") == 1
+
+
+def test_replace_dead_lease_driver_placement_re_registers():
+    """Review-fix pin: a driver-placement replica whose LEASE died
+    (beat loop fenced/wedged — the process is right here) used to be
+    routed into fleet.replace_replica, which unconditionally raises
+    for driver fleets: the controller wedged in a permanent REPLACE
+    loop and could never scale again. The repair verb is
+    re_register."""
+    stub = _StubFleet(["replica-0"])
+    # lease present but STALE (age past dead_after_s) -> REPLACE with
+    # lease_dead, remote=False
+    stub.reservation.serving_snapshot = lambda: {"replica-0": {
+        "age": 99.0, "serving": {"alive": True, "draining": False,
+                                 "queue_depth": 0, "slot_occupancy": 0,
+                                 "queue_wait_ewma_s": 0.0, "slots": 4},
+        "metrics": {}}}
+    replica = stub.replicas[0]
+    replica.remote = False
+    calls = []
+    replica.re_register = lambda: calls.append("re_register")
+    stub._replica = lambda rid: replica \
+        if rid == replica.replica_id else None
+    ctl = autoscale.AutoscaleController(stub, policy=_policy())
+    d = ctl.poll_once()
+    assert d.action == ScaleDecision.REPLACE
+    assert calls == ["re_register"], \
+        "driver-placement dead lease must repair via re_register, " \
+        "not the always-raising replace_replica"
+    assert ctl.counters.snapshot()["counts"].get("replacements") == 1
+    assert not ctl.events.events("autoscale_replace_failed")
+
+
+class _LeaseStubReservation(object):
+    def __init__(self):
+        self.snapshot = {}
+
+    def serving_snapshot(self):
+        return dict(self.snapshot)
+
+    def lease_epoch(self, rid):
+        return (self.snapshot.get(rid) or {}).get("epoch")
+
+
+class _HoldStubRouter(object):
+    def __init__(self):
+        self.holds = []
+
+    def quiesce(self, rid, reason="", owner="operator"):
+        self.holds.append(("quiesce", rid, owner))
+
+    def readmit(self, rid, owner="operator"):
+        self.holds.append(("readmit", rid, owner))
+
+
+def test_watch_serving_releases_hold_on_lease_recovery():
+    """Review-fix pin: a lease that went stale past the watch's
+    stale_after and then RECOVERED (a beat stall, not a death) left
+    the supervisor's owner-scoped quiesce in place forever — no
+    replacement runs spawn_replica's force-clear, so a healthy
+    replica stayed administratively down (a 1-replica fleet: 503s
+    for good). Recovery must release the supervisor's own hold."""
+    from tensorflowonspark_tpu import supervisor as supervisor_mod
+
+    class _Remote(object):
+        remote = True
+        replica_id = "replica-0"
+        executor_id = "e0"
+
+    class _Fleet(object):
+        def __init__(self):
+            self.replicas = [_Remote()]
+            self.reservation = _LeaseStubReservation()
+            self.router = _HoldStubRouter()
+
+    fleet_stub = _Fleet()
+    sup = supervisor_mod.Supervisor()
+    sup._serving_watch = {"fleet": fleet_stub, "stale_after": 1.0,
+                          "reported": set()}
+    # dead lease -> supervisor quiesces under its own owner
+    fleet_stub.reservation.snapshot = {}
+    sup._check_serving_leases()
+    assert ("quiesce", "replica-0", "supervisor") \
+        in fleet_stub.router.holds
+    # lease recovers WITHOUT a replacement -> the hold must lift
+    fleet_stub.reservation.snapshot = {"replica-0": {
+        "age": 0.1, "epoch": 1,
+        "serving": {"alive": True}}}
+    sup._check_serving_leases()
+    assert ("readmit", "replica-0", "supervisor") \
+        in fleet_stub.router.holds, \
+        "recovered lease left the supervisor hold in place"
+    assert sup.events.events("serving_replica_recovered")
+
+
 def test_controller_repairs_unwatched_inprocess_engine_death(lm):
     """An in-process replica whose engine scheduler dies while its
     beat keeps flowing (lease fresh, ``alive: false``) is repaired by

@@ -326,6 +326,283 @@ def test_health_quiesce_is_administrative_no_probe_path():
     assert h.state("r", now=1e9) == h.UP
 
 
+# -- racecheck regression pins (PR 14): the fleet's shared-state
+# fixes, each pinned barrier-style like PR 10's two-thread
+# compile-claim test ------------------------------------------------------
+
+def test_new_rid_concurrent_unique():
+    """``_next_idx += 1`` was an unlocked read-modify-write shared by
+    the autoscaler thread and operator threads: two concurrent
+    spawn_replica calls could mint the SAME replica id (two engines,
+    one identity, one lease — split-brain by construction). Under the
+    fleet lock every id is unique."""
+    f = fleet.ServingFleet(None, None, replicas=1)
+    n_threads, per_thread = 4, 400
+    barrier = threading.Barrier(n_threads)
+    out = [None] * n_threads
+
+    def mint(i):
+        barrier.wait()
+        out[i] = [f._new_rid() for _ in range(per_thread)]
+
+    threads = [threading.Thread(target=mint, args=(i,), daemon=True,
+                                name="tfos-test-rid-%d" % i)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    rids = [r for chunk in out for r in chunk]
+    assert len(set(rids)) == n_threads * per_thread, \
+        "duplicate replica ids minted under concurrency"
+
+
+def test_replica_lookup_survives_concurrent_churn():
+    """``_replica`` used to iterate ``self.replicas`` while spawn /
+    retire mutated it from other threads — removing an earlier element
+    shifts the list under the iterator and a PRESENT member can be
+    skipped (lookup returns None for a replica the fleet tracks).
+    Under the lock the anchor is always found."""
+    f = fleet.ServingFleet(None, None, replicas=1)
+
+    class _R(object):
+        remote = False
+
+        def __init__(self, rid):
+            self.replica_id = rid
+
+    churners = [_R("churn-%d" % i) for i in range(8)]
+    for r in churners:
+        f._track(r)
+    anchor = _R("anchor")
+    f._track(anchor)
+    stop = threading.Event()
+    barrier = threading.Barrier(2)
+    misses = []
+
+    def churn():
+        barrier.wait()
+        while not stop.is_set():
+            for r in churners:
+                f._untrack(r)
+            for r in churners:
+                f._track(r)
+
+    def lookup():
+        barrier.wait()
+        for _ in range(3000):
+            if f._replica("anchor") is None:
+                misses.append(1)
+        stop.set()
+
+    ts = [threading.Thread(target=churn, daemon=True,
+                           name="tfos-test-churn"),
+          threading.Thread(target=lookup, daemon=True,
+                           name="tfos-test-lookup")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    stop.set()
+    assert not misses, \
+        "tracked anchor replica vanished from lookup {} time(s) " \
+        "during churn".format(len(misses))
+
+
+class _FenceServer(object):
+    """Minimal ModelServer surface for a bare Replica agent."""
+
+    replica_id = "replica-f"
+    engine = None
+    name = "model"
+
+    def __init__(self):
+        self.fence_reason = None
+
+    def start(self):
+        return ("127.0.0.1", 0)
+
+    def fence(self, reason):
+        self.fence_reason = reason
+
+    def unfence(self):
+        self.fence_reason = None
+
+    def stop(self):
+        pass
+
+
+class _FenceOnceClient(object):
+    """reservation.Client stand-in whose FIRST beat parks on a barrier
+    (so the test can line a re_register up against the in-flight
+    exchange) and then comes back FENCED; every later beat succeeds."""
+
+    barrier = None
+    fenced_once = False
+
+    def __init__(self, addr):
+        pass
+
+    def lease(self, rid):
+        return 1
+
+    def beat(self, rid, payload, epoch=None):
+        cls = _FenceOnceClient
+        if not cls.fenced_once:
+            cls.fenced_once = True
+            cls.barrier.wait(timeout=10)
+            time.sleep(0.2)  # hold the exchange open past re_register
+            raise reservation.Fenced("stale epoch", epoch=2)
+
+    def close(self):
+        pass
+
+
+def test_re_register_never_loses_to_inflight_fence(monkeypatch):
+    """Racecheck regression pin: Replica.epoch/fenced were mutated by
+    the beat thread AND re_register() with no lock. A re_register
+    landing while a FENCED beat was in flight had its reset
+    overwritten by the beat's latch — the replica ended permanently
+    fenced with a dead beat loop, while re_register reported success.
+    Serialized, the latch lands first and re_register then clears it
+    and restarts the loop."""
+    monkeypatch.setattr(fleet.reservation, "Client", _FenceOnceClient)
+    _FenceOnceClient.barrier = threading.Barrier(2)
+    _FenceOnceClient.fenced_once = False
+    server = _FenceServer()
+    replica = fleet.Replica(server, ("127.0.0.1", 1),
+                            beat_interval=0.01)
+    replica.start()
+    try:
+        # the first beat is now parked inside its exchange
+        _FenceOnceClient.barrier.wait(timeout=10)
+        replica.re_register()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and (
+                replica.fenced or not replica._thread.is_alive()):
+            time.sleep(0.02)
+        assert replica.fenced is False, \
+            "re_register's reset was overwritten by the in-flight " \
+            "fence latch"
+        assert server.fence_reason is None, \
+            "server left fenced after a successful re_register"
+        assert replica._thread.is_alive(), \
+            "beat loop dead after re_register"
+    finally:
+        replica.stop()
+
+
+def test_concurrent_executor_spawns_pick_distinct_executors(monkeypatch):
+    """Review-fix pin: the executor pick (free_executor) and the
+    dispatch/track are ONE atomic placement decision. Unserialized,
+    two concurrent spawns both read the hosting ledger before either
+    tracks its RemoteReplica and both pick the SAME free executor —
+    the second bootstrap can never run there. Under the fleet lock
+    the second pick sees the first's track and takes the other
+    executor."""
+    class _FakeResult(object):
+        def first_error(self):
+            return None
+
+    class _FakeRDD(object):
+        def foreachPartitionAsync(self, fn, **kw):
+            return _FakeResult()
+
+    class _FakeSC(object):
+        def executors_alive(self):
+            return ["e0", "e1"]
+
+        def parallelize(self, seq, n):
+            return _FakeRDD()
+
+    f = fleet.ServingFleet(None, None, replicas=1,
+                           placement="executors", sc=_FakeSC())
+    f._started = True
+    f._resv_addr = ("127.0.0.1", 0)
+    monkeypatch.setattr(
+        f, "_await_lease",
+        lambda rid, timeout, min_epoch=None: {"addr": ["127.0.0.1", 1]})
+    monkeypatch.setattr(fleet.FleetRouter, "_await_healthz",
+                        staticmethod(lambda addr, timeout: True))
+    barrier = threading.Barrier(2)
+    got = [None, None]
+    errors = []
+
+    def spawn(i):
+        barrier.wait()
+        try:
+            got[i] = f.spawn_replica(timeout=5)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    ts = [threading.Thread(target=spawn, args=(i,), daemon=True,
+                           name="tfos-test-spawn-%d" % i)
+          for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert not errors, errors
+    eids = {r.executor_id for r in got if r is not None}
+    assert eids == {"e0", "e1"}, \
+        "concurrent spawns double-placed: {}".format(eids)
+
+
+def _fake_executor_fleet(monkeypatch, executors):
+    class _FakeResult(object):
+        def first_error(self):
+            return None
+
+    class _FakeRDD(object):
+        def foreachPartitionAsync(self, fn, **kw):
+            return _FakeResult()
+
+    class _FakeSC(object):
+        def executors_alive(self):
+            return list(executors)
+
+        def parallelize(self, seq, n):
+            return _FakeRDD()
+
+    f = fleet.ServingFleet(None, None, replicas=1,
+                           placement="executors", sc=_FakeSC())
+    f._started = True
+    f._resv_addr = ("127.0.0.1", 0)
+    monkeypatch.setattr(
+        f, "_await_lease",
+        lambda rid, timeout, min_epoch=None: {"addr": ["127.0.0.1", 1]})
+    monkeypatch.setattr(fleet.FleetRouter, "_await_healthz",
+                        staticmethod(lambda addr, timeout: True))
+    return f
+
+
+def test_replacement_can_reuse_the_corpses_own_executor(monkeypatch):
+    """Review-fix pin: the executor pick used to run while the corpse
+    handle was still tracked, so the victim's own executor read as
+    hosting and was excluded — on a single-executor fleet every
+    replacement raised NoCapacity forever even after the executor
+    revived. The corpse is untracked before the pick now."""
+    f = _fake_executor_fleet(monkeypatch, ["e0"])
+    corpse = fleet.RemoteReplica("replica-0", f.reservation,
+                                 executor_id="e0")
+    f._track(corpse)
+    replacement = f.spawn_replica(replica_id="replica-0", timeout=5)
+    assert replacement.executor_id == "e0"
+    assert f._replica("replica-0") is replacement
+
+    # and a replacement that finds NO capacity keeps the dead
+    # identity TRACKED (the PR-13 contract: REPLACE must re-fire)
+    f2 = _fake_executor_fleet(monkeypatch, [])
+    corpse2 = fleet.RemoteReplica("replica-9", f2.reservation,
+                                  executor_id="gone")
+    f2._track(corpse2)
+    with pytest.raises(fleet.NoCapacity):
+        f2.spawn_replica(replica_id="replica-9", timeout=5)
+    assert f2._replica("replica-9") is corpse2, \
+        "NoCapacity untracked the corpse — the autoscaler would " \
+        "forget the dead identity"
+
+
 # -- replica identity schema (satellite) -----------------------------------
 
 def test_replica_id_stable_across_respawn(lm):

@@ -12,6 +12,7 @@ leak-proofing churn loop (cancel / disconnect / deadline-evict / drain
 returns every block — refcounts zero, free list full).
 """
 
+import threading
 import time
 
 import jax
@@ -108,6 +109,77 @@ def test_pool_prefix_chain_and_lru():
     dropped = pool.drop_cache()
     assert pool.stats()["cached"] == 0
     assert dropped >= 1
+
+
+def test_plan_admission_matches_plan_plus_capacity():
+    pool = paging.BlockPool(num_blocks=8, block_size=4)
+    tokens = list(range(1, 10))  # 9 tokens -> 2 shareable full blocks
+    ids = pool.alloc(2)
+    pool.register(tokens, 4, ids[0])
+    pool.register(tokens, 8, ids[1])
+    pool.release(ids)  # parked in the LRU, still registered
+    shared, need, lru_res, allocatable, epoch = \
+        pool.plan_admission(tokens)
+    assert (shared, need, lru_res) == pool.plan(tokens)
+    assert allocatable == pool.allocatable() == 8
+    assert epoch == pool.epoch()
+    assert shared == ids and need == 1 and lru_res == 2
+
+
+def test_plan_admission_atomic_snapshot_under_churn():
+    """Racecheck regression pin (PR 14): the admission estimate used
+    to read ``plan()`` and ``allocatable()`` in two separate pool-lock
+    acquisitions from HTTP handler threads while the scheduler thread
+    acquired/released blocks between them. The torn read counts a
+    chain as BOTH lru-resident (capacity it will consume) AND already
+    acquired (capacity already gone) — double-charging the deficit
+    (spurious shed) or masking it (admit into a certain 504).
+    ``plan_admission`` reads everything under one lock hold; the
+    invariant below distinguishes a consistent snapshot from a torn
+    one and must hold on every read under churn."""
+    pool = paging.BlockPool(num_blocks=8, block_size=4)
+    tokens = list(range(1, 10))
+    ids = pool.alloc(2)
+    pool.register(tokens, 4, ids[0])
+    pool.register(tokens, 8, ids[1])
+    pool.release(ids)
+    chain_len, total = 2, 8
+    stop = threading.Event()
+    barrier = threading.Barrier(2)
+    bad = []
+
+    def churn():
+        barrier.wait()
+        while not stop.is_set():
+            pool.acquire(ids)   # chain live: lru 0, allocatable 6
+            pool.release(ids)   # chain parked: lru 2, allocatable 8
+
+    def audit():
+        barrier.wait()
+        for _ in range(4000):
+            shared, need, lru_res, allocatable, _ = \
+                pool.plan_admission(tokens)
+            assert shared == ids, "registry churned unexpectedly"
+            # in ONE snapshot the chain is parked (in lru_res AND in
+            # allocatable) or live (in neither): lru_res + the blocks
+            # missing from capacity can never exceed the chain length.
+            # A torn read (lru_res from the parked state, allocatable
+            # from the live state) yields 2 + 2 > 2.
+            if lru_res + (total - allocatable) > chain_len:
+                bad.append((lru_res, allocatable))
+        stop.set()
+
+    ts = [threading.Thread(target=churn, daemon=True,
+                           name="tfos-test-pool-churn"),
+          threading.Thread(target=audit, daemon=True,
+                           name="tfos-test-pool-audit")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    stop.set()
+    assert not bad, \
+        "torn plan/capacity read(s) under churn: {}".format(bad[:5])
 
 
 def test_pool_register_first_writer_wins():

@@ -20,6 +20,17 @@ attribution the engine's own tracing hooks collect:
                       (one layer per decode step; multiply by layers),
                       so ``--attn-impl gather`` vs the fused default
                       attributes the kernel-vs-gather delta per step
+- ``spec_round``    — speculative decoding (PR 15, ``--speculate-k``):
+                      the fused draft+verify round the loop runs (one
+                      program; replaces ``decode_step``)
+- ``draft``/``verify`` — the round's two halves probed STANDALONE
+                      (``engine.measure_spec`` — per-op timing is
+                      invisible inside one program), plus
+                      ``draft_prefill`` at admission
+- ``dequant``       — int8 KV (PR 15, ``--kv-dtype int8``): one
+                      whole-pool dequantize at live shapes (the
+                      fast path's add-on cost, beside ``attn``'s
+                      view of what it saves)
 
 plus the engine's counters (tokens/step = effective slot occupancy,
 prefills, steps), compile stats (programs vs buckets), the request-
@@ -70,6 +81,19 @@ def main(argv=None):
                     help="paged attention formulation (default: the "
                          "engine's fused kernel; 'gather' runs the "
                          "PR 8 reference for a per-stage comparison)")
+    ap.add_argument("--speculate-k", type=int, default=None,
+                    help="speculative decoding window (>= 2): a "
+                         "weight-tied reduced-depth draft proposes k "
+                         "tokens per round, the target verifies them "
+                         "in one fused apply; adds the spec_round "
+                         "loop stage and the draft/verify probes")
+    ap.add_argument("--draft-layers", type=int, default=None,
+                    help="draft depth (with --speculate-k; default "
+                         "num_layers // 2)")
+    ap.add_argument("--kv-dtype", choices=("int8",), default=None,
+                    help="int8 paged-KV fast path: quantized pool + "
+                         "per-head scales, dequantized in-kernel; "
+                         "adds the dequant probe stage")
     ap.add_argument("--json", action="store_true",
                     help="print one JSON blob instead of the table")
     args = ap.parse_args(argv)
@@ -104,6 +128,12 @@ def main(argv=None):
     engine_kw = {}
     if args.attn_impl is not None:
         engine_kw["attn_impl"] = args.attn_impl
+    if args.speculate_k is not None:
+        engine_kw["speculate_k"] = args.speculate_k
+        if args.draft_layers is not None:
+            engine_kw["draft_layers"] = args.draft_layers
+    if args.kv_dtype is not None:
+        engine_kw["kv_dtype"] = args.kv_dtype
     jax.clear_caches()
     _run(dec, params, reqs, args.slots, "cold", out,
          **engine_kw)                                  # includes compiles
@@ -130,7 +160,10 @@ def main(argv=None):
             print("    {:<12} {}".format(key, r["hist"][key]))
         print("  compile: {}".format(r["compile"]))
         print("  lifecycle: {}".format(r["lifecycle"]))
-        print("  attn_impl: {}".format(r["attn_impl"]))
+        print("  attn_impl: {}  kv_dtype: {}".format(
+            r["attn_impl"], r["kv_dtype"]))
+        if "spec" in r:
+            print("  speculative: {}".format(r["spec"]))
         if "kv" in r:
             print("  kv blocks: {}".format(r["kv"]))
 

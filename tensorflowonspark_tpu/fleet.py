@@ -975,6 +975,14 @@ class FleetRouter(object):
                 "attn_impl": gauges.get("attn_impl"),
                 "generated_prefix_hit_blocks": gauges.get(
                     "generated_prefix_hit_blocks", 0),
+                # speed-path config (PR 15): which replicas speculate
+                # / serve int8 KV and at what live acceptance rate —
+                # a staged rollout of either knob is legible from one
+                # probe (zero schema on replicas with both off)
+                "speculate_k": gauges.get("speculate_k", 0),
+                "spec_acceptance_rate": gauges.get(
+                    "spec_acceptance_rate", 0.0),
+                "kv_dtype": gauges.get("kv_dtype"),
                 "inflight": inflight.get(rid, 0),
                 "state": self.health.state(rid, now),
             })
@@ -1403,6 +1411,9 @@ class FleetRouter(object):
                     "attn_impl": v["attn_impl"],
                     "generated_prefix_hit_blocks":
                         v["generated_prefix_hit_blocks"],
+                    "speculate_k": v["speculate_k"],
+                    "spec_acceptance_rate": v["spec_acceptance_rate"],
+                    "kv_dtype": v["kv_dtype"],
                     "inflight": v["inflight"]} for v in views}}
         return (200 if order else 503), body
 

@@ -466,6 +466,182 @@ def paged_step_fns(model, temperature=0.0, top_k=None, top_p=None):
     return prefill, decode
 
 
+# -- speculative decoding primitives (PR 15) ----------------------------
+#
+# Draft-model speculation over the SAME paged pool discipline: a
+# reduced-depth clone of the target (same vocab/embedding/head, the
+# first ``num_layers_draft`` blocks, weight-tied — see
+# :func:`draft_params`) proposes k tokens with k cheap single-token
+# steps fused into ONE scanned program (``paged_propose_tokens``); the
+# target then scores all k proposals in ONE fused multi-token apply
+# (``paged_verify_step`` — the s>1 branch of models/decoder.py, i.e.
+# the multi-token prefill machinery pointed at decode). Token-matching
+# acceptance makes the emitted stream exactly the target's: at
+# temperature=0 the verify picks ARE the plain engine's argmax chain,
+# so greedy speculative output is bitwise-identical to the plain
+# engine (pinned in tests/test_speculative.py); at temperature>0 every
+# emitted token is still a true target-model sample (the draft token
+# is only kept when it EQUALS the target's own pick at that position),
+# but the PRNG stream advances differently per accepted run length, so
+# sampled outputs are exact in distribution, not bitwise-reproducible
+# against the plain engine — serving.DecodeEngine documents this
+# honestly.
+#
+# The draft maintains its OWN cache pytree but shares the engine's
+# HOST state — block tables and cursors — so one BlockPool governs
+# both: every target write has a mirrored draft write at the same
+# (block, offset), which is what keeps prefix-cache hits valid for the
+# draft pool too.
+
+
+def draft_params(params, num_layers_draft):
+    """Weight-tied draft parameters: the target's embeddings, first
+    ``num_layers_draft`` blocks, final norm, and head — the exact
+    subtree a ``model.clone(num_layers=num_layers_draft)`` consumes.
+    No copies: the returned dict aliases the target's arrays (tying is
+    the point — no separate draft training pipeline exists, and the
+    truncated-depth model is the honest zero-extra-weights draft).
+    Raises KeyError-shaped ValueError on param trees that are not
+    DecoderLM-family (no ``block_0``/``tok_embed`` naming)."""
+    keep = {"tok_embed", "pos_embed", "ln_f", "head"}
+    keep.update("block_%d" % i for i in range(int(num_layers_draft)))
+    tied = {name: params[name] for name in keep if name in params}
+    missing = keep - set(tied)
+    if missing:
+        raise ValueError(
+            "params lack the DecoderLM-family entries {} needed for a "
+            "weight-tied draft".format(sorted(missing)))
+    return tied
+
+
+def paged_propose_tokens(model, params, cache, last, idx, tables, k,
+                         temperature=0.0, top_k=None, top_p=None,
+                         rng=None):
+    """k chained draft decode steps as ONE program: feed ``last [S]``,
+    pick, feed the pick, ... — ``lax.scan`` over k single-token paged
+    steps, each writing its K/V through the shared block tables at the
+    advancing cursors. Returns ``(cache', drafts [S, k])`` where
+    ``drafts[:, j]`` is the draft's pick after consuming the j-th fed
+    token (so the fed sequence is ``[last, d_1, ..., d_{k-1}]`` and
+    the proposals are ``d_1..d_k``)."""
+    import jax
+
+    cache = _set_paged_leaves(cache, jnp.asarray(idx, jnp.int32),
+                              jnp.asarray(tables, jnp.int32))
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    keys = jax.random.split(rng, k)
+
+    def body(carry, key):
+        cache, tok = carry
+        logits, upd = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            mutable=["cache"])
+        picked = _pick_tokens(logits[:, -1, :], key, temperature,
+                              top_k, top_p)
+        return (upd["cache"], picked), picked
+
+    (cache, _), drafts = jax.lax.scan(body, (cache, last), keys)
+    return cache, drafts.T  # [k, S] -> [S, k]
+
+
+def paged_verify_step(model, params, cache, tokens, idx, tables,
+                      temperature=0.0, top_k=None, top_p=None,
+                      rng=None):
+    """Score a whole proposal window in ONE target apply: ``tokens
+    [S, k]`` is ``[last, d_1, ..., d_{k-1}]`` per slot; the s=k fused
+    branch writes all k K/V rows through the tables and yields logits
+    at every position. Returns ``(cache', picks [S, k])`` — the
+    target's own next-token choice after each fed token. Acceptance is
+    the caller's (host-side) token match: ``d_{j+1}`` stands iff it
+    equals ``picks[:, j]``, and ``picks[:, a]`` is the correction
+    token when the match chain breaks at ``a``."""
+    cache = _set_paged_leaves(cache, jnp.asarray(idx, jnp.int32),
+                              jnp.asarray(tables, jnp.int32))
+    logits, upd = model.apply(
+        {"params": params, "cache": cache}, tokens, mutable=["cache"])
+    s, k, v = logits.shape
+    picked = _pick_tokens(logits.reshape(s * k, v), rng, temperature,
+                          top_k, top_p)
+    return upd["cache"], picked.reshape(s, k)
+
+
+def paged_spec_round(model, draft_model, params, draft_params, cache,
+                     draft_cache, last, idx, tables, k,
+                     temperature=0.0, top_k=None, top_p=None,
+                     rng=None):
+    """One whole speculative round — propose THEN verify — as a single
+    traceable computation: composed from :func:`paged_propose_tokens`
+    and :func:`paged_verify_step` (no duplicated logic), with the
+    draft's fed window wired straight into the verify feed ON DEVICE.
+    Under one jit this is ONE dispatch and ONE host sync per round
+    instead of two of each — on a CPU CI box the dispatch+sync is a
+    real fraction of a round, and on TPU it halves launch overhead.
+    Returns ``(cache', draft_cache', drafts [S, k], targets
+    [S, k])``."""
+    import jax
+
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    rng_d, rng_v = jax.random.split(rng)
+    draft_cache, drafts = paged_propose_tokens(
+        draft_model, draft_params, draft_cache, last, idx, tables, k,
+        temperature=temperature, top_k=top_k, top_p=top_p, rng=rng_d)
+    feed = jnp.concatenate([last[:, None], drafts[:, :k - 1]], axis=1)
+    cache, targets = paged_verify_step(
+        model, params, cache, feed, idx, tables,
+        temperature=temperature, top_k=top_k, top_p=top_p, rng=rng_v)
+    return cache, draft_cache, drafts, targets
+
+
+@functools.lru_cache(maxsize=32)
+def speculative_step_fns(model, draft_model, k, temperature=0.0,
+                         top_k=None, top_p=None):
+    """The jitted FUSED round fn for one (target, draft, k, sampling)
+    tuple, cache-donating, reused across engines — the speculative
+    sibling of :func:`paged_step_fns`. Compile-count contract: ONE
+    round program per engine config (k is static; the fn is
+    fixed-shape over all S slots). Call signature:
+    ``fn(params, draft_params, cache, draft_cache, last, idx, tables,
+    key) -> (cache', draft_cache', drafts, targets)``."""
+    import jax
+
+    return jax.jit(
+        lambda params, draft_params, cache, draft_cache, last, idx, \
+        tables, key:
+        paged_spec_round(model, draft_model, params, draft_params,
+                         cache, draft_cache, last, idx, tables,
+                         int(k), temperature=temperature, top_k=top_k,
+                         top_p=top_p, rng=key),
+        donate_argnums=(2, 3))
+
+
+@functools.lru_cache(maxsize=32)
+def speculative_probe_fns(model, draft_model, k, temperature=0.0,
+                          top_k=None, top_p=None):
+    """NON-donating (propose, verify) jits over the same bodies the
+    fused round composes — the measurement surface behind
+    ``DecodeEngine.measure_spec``: the hot loop runs one fused
+    program (per-op timing is invisible inside it), so the honest
+    draft-vs-verify attribution runs each half standalone at live
+    shapes, exactly the ``measure_attn`` pattern. Non-donating so a
+    probe can run against the engine's LIVE caches without consuming
+    them."""
+    import jax
+
+    propose = jax.jit(
+        lambda params, cache, last, idx, tables, key:
+        paged_propose_tokens(draft_model, params, cache, last, idx,
+                             tables, int(k), temperature=temperature,
+                             top_k=top_k, top_p=top_p, rng=key))
+    verify = jax.jit(
+        lambda params, cache, tokens, idx, tables, key:
+        paged_verify_step(model, params, cache, tokens, idx, tables,
+                          temperature=temperature, top_k=top_k,
+                          top_p=top_p, rng=key))
+    return propose, verify
+
+
 def default_buckets(total_len, lo=8):
     """Power-of-two prompt buckets up to ``total_len``: the compile-count
     bound for prefill is ``len(default_buckets(...))`` programs."""

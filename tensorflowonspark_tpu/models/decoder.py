@@ -90,6 +90,14 @@ class CausalSelfAttention(nn.Module):
     #: table directly (Pallas on TPU, blockwise lax elsewhere);
     #: "gather" materializes the logical view (PR 8's reference path)
     attn_impl: str = "fused"
+    #: KV pool storage (PR 15; paged only): "" stores K/V at the
+    #: compute dtype; "int8" stores symmetric per-head absmax codes
+    #: with float32 scales per token row of each block ("key_scale" /
+    #: "value_scale" cache vars, [P, block_size, N]) — writes quantize
+    #: (ops.paged_attention.quantize_kv), attention dequantizes
+    #: in-formulation, halving per-step KV bandwidth vs bf16 and
+    #: doubling+ pool capacity at a fixed byte budget
+    kv_dtype: str = ""
 
     @nn.compact
     def __call__(self, x):
@@ -119,13 +127,30 @@ class CausalSelfAttention(nn.Module):
                         "paged decode needs kv_blocks >= 2 (row 0 is "
                         "the scratch block), got {}".format(
                             self.kv_blocks))
+                if self.kv_dtype not in ("", "int8"):
+                    raise ValueError(
+                        "kv_dtype must be '' (compute dtype) or "
+                        "'int8', got {!r}".format(self.kv_dtype))
+                kv_q = self.kv_dtype == "int8"
                 bs_blk = self.kv_block_size
                 pool_shape = (self.kv_blocks, bs_blk) + k.shape[2:]
                 cached_key = self.variable(
-                    "cache", "cached_key", jnp.zeros, pool_shape, k.dtype)
+                    "cache", "cached_key", jnp.zeros, pool_shape,
+                    jnp.int8 if kv_q else k.dtype)
                 cached_value = self.variable(
                     "cache", "cached_value", jnp.zeros, pool_shape,
-                    v.dtype)
+                    jnp.int8 if kv_q else v.dtype)
+                if kv_q:
+                    # per-head scales, one per token row of each block,
+                    # stored block-aligned so attention's index maps
+                    # route them with the codes (ones: dequant of the
+                    # zero codes stays exactly zero)
+                    key_scale = self.variable(
+                        "cache", "key_scale", jnp.ones,
+                        pool_shape[:2] + (self.num_heads,), jnp.float32)
+                    value_scale = self.variable(
+                        "cache", "value_scale", jnp.ones,
+                        pool_shape[:2] + (self.num_heads,), jnp.float32)
                 # per-row block table [B, MB]: logical block j of row b
                 # lives in pool row table[b, j]. Sized at CREATION from
                 # the dummy pass's length (init_cache's total_len);
@@ -179,15 +204,31 @@ class CausalSelfAttention(nn.Module):
                     table, jnp.minimum(blk_idx, mb - 1), axis=1)
                 blk = jnp.where(blk_idx < mb, blk, 0)
                 off = pos % bs_blk
-                pk = cached_key.value.at[blk, off].set(k)
-                pv = cached_value.value.at[blk, off].set(v)
+                if kv_q:
+                    # int8 fast path (PR 15): quantize at write time
+                    # (per head, per token row), scatter codes AND
+                    # scales through the same table routing; attention
+                    # dequantizes in-formulation so the per-step HBM
+                    # traffic is the int8 bytes
+                    qk, sk = pa.quantize_kv(k)
+                    qv, sv = pa.quantize_kv(v)
+                    pk = cached_key.value.at[blk, off].set(qk)
+                    pv = cached_value.value.at[blk, off].set(qv)
+                    ksc = key_scale.value.at[blk, off].set(sk)
+                    vsc = value_scale.value.at[blk, off].set(sv)
+                    key_scale.value = ksc
+                    value_scale.value = vsc
+                else:
+                    pk = cached_key.value.at[blk, off].set(k)
+                    pv = cached_value.value.at[blk, off].set(v)
+                    ksc = vsc = None
                 cached_key.value = pk
                 cached_value.value = pv
                 cache_index.value = idx + s
                 ctx = pa.paged_attention(
                     q, pk, pv, table, pos, scale=head_dim ** -0.5,
                     impl=None if self.attn_impl == "fused"
-                    else "gather")
+                    else "gather", k_scale=ksc, v_scale=vsc)
             elif is_initialized and s == 1:
                 # one token per step against the cache prefix
                 idx = cache_index.value
@@ -260,6 +301,7 @@ class DecoderBlock(nn.Module):
     kv_block_size: int = 0
     kv_blocks: int = 0
     attn_impl: str = "fused"
+    kv_dtype: str = ""
 
     @nn.compact
     def __call__(self, x):
@@ -268,6 +310,7 @@ class DecoderBlock(nn.Module):
                                 kv_block_size=self.kv_block_size,
                                 kv_blocks=self.kv_blocks,
                                 attn_impl=self.attn_impl,
+                                kv_dtype=self.kv_dtype,
                                 name="attn")(y)
         x = x + y
         y = nn.LayerNorm(name="ln2")(x)
@@ -304,6 +347,11 @@ class DecoderLM(nn.Module):
     #: ignored unless kv_block_size > 0. The engine's ``attn_impl``
     #: knob clones the model with this set.
     attn_impl: str = "fused"
+    #: KV pool storage (PR 15): "" = compute dtype, "int8" = quantized
+    #: codes + per-head scales (see CausalSelfAttention.kv_dtype);
+    #: ignored unless kv_block_size > 0. The engine's ``kv_dtype``
+    #: knob clones the model with this set.
+    kv_dtype: str = ""
 
     @nn.compact
     def __call__(self, tokens):
@@ -325,8 +373,17 @@ class DecoderLM(nn.Module):
                 # full-length dummy pass: positions 0..s-1, all rows
                 x = x + pos_embed[:s][None]
             elif s == 1:
+                # mode="clip" for the same reason as the fused-prefill
+                # branch below: a speculative draft's propose scan
+                # (PR 15) advances row cursors one past another up to
+                # k-1 positions BEYOND a nearly-full row's capacity —
+                # the writes route to the scratch block, but the
+                # default fill mode would hand those rows NaN
+                # embeddings whose K/V poisons attention through the
+                # 0 x NaN contraction (the exact PR 11 bug class).
+                # In-range rows are untouched (bitwise-identical).
                 x = x + jnp.take(pos_embed, pos_idx.value,
-                                 axis=0)[:, None, :]
+                                 axis=0, mode="clip")[:, None, :]
                 pos_idx.value = pos_idx.value + s
             else:
                 # fused prefill: positions continue from each row's own
@@ -353,6 +410,7 @@ class DecoderLM(nn.Module):
                              kv_block_size=self.kv_block_size,
                              kv_blocks=self.kv_blocks,
                              attn_impl=self.attn_impl,
+                             kv_dtype=self.kv_dtype,
                              name="block_%d" % i)(x)
         x = nn.LayerNorm(name="ln_f")(x)
         return nn.Dense(self.vocab, name="head")(x)

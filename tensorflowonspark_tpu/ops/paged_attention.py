@@ -51,12 +51,48 @@ BEFORE attending (models/decoder.py), so the current token sees
 itself. Layout: ``q [B, S_q, N, D]``, pools
 ``[P, block_size, N, D]``, ``block_table [B, MB]`` int32,
 ``pos [B, S_q]`` int32; returns ``[B, S_q, N, D]``.
+
+INT8 KV (PR 15): with ``k_scale``/``v_scale`` supplied, the pools hold
+``int8`` codes and the scales (``[P, block_size, heads]`` float32 —
+one per head per token row of each block, stored block-aligned beside
+the pool) dequantize them INSIDE each formulation: the gather path
+dequantizes the materialized view, the blockwise loop and the Pallas
+kernel dequantize one block at a time right after its load — so the
+HBM traffic a decode step pays is the int8 bytes, not the float ones
+(per-step KV bandwidth halves vs bf16, quarters vs f32; the exact
+follow-up PR 11 named). Quantization itself happens at WRITE time in
+models/decoder.py via :func:`quantize_kv`. A per-(block, head) single
+scale cannot work for an incremental decode cache — a scale-raising
+write would require requantizing every code already in the block —
+which is why the scales are per token row within each block.
 """
 
 import functools
 
 import jax
 import jax.numpy as jnp
+
+
+def quantize_kv(x):
+    """``[..., D]`` float K/V -> ``(codes int8 [..., D], scales
+    float32 [...])`` — symmetric per-head (last-axis) absmax
+    quantization to 127 levels. An all-zero vector quantizes to zero
+    codes under scale 1.0 (never a 0/0). EXACT round-trip contract
+    (pinned in tests): ``quantize_kv(dequantize_kv(*quantize_kv(x)))``
+    reproduces the codes and scales bitwise — the absmax element maps
+    to ±127 exactly, so requantizing the dequantized grid is a fixed
+    point. paging.BlockPool.quantize is the numpy mirror of this
+    formulation (one contract, two runtimes)."""
+    s = jnp.max(jnp.abs(x), axis=-1).astype(jnp.float32) / 127.0
+    s = jnp.where(s > 0, s, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def dequantize_kv(q, s):
+    """Inverse of :func:`quantize_kv`: ``codes * scales`` in float32."""
+    return q.astype(jnp.float32) * s[..., None].astype(jnp.float32)
 
 
 def _nblocks(pos, block_size, table_width):
@@ -68,15 +104,23 @@ def _nblocks(pos, block_size, table_width):
                        // block_size, table_width)
 
 
-def _gather(q, k_pool, v_pool, block_table, pos, scale):
+def _gather(q, k_pool, v_pool, block_table, pos, scale, k_scale=None,
+            v_scale=None):
     """PR 8's XLA formulation, verbatim: materialize the logical
-    ``[B, L, N, D]`` view through the table, one softmax over it."""
+    ``[B, L, N, D]`` view through the table, one softmax over it
+    (int8 pools dequantize into the materialized view — the reference
+    the fused in-kernel dequant is pinned against)."""
     b, s, n, d = q.shape
     bs_blk = k_pool.shape[1]
     mb = block_table.shape[1]
     L = mb * bs_blk
-    ck = k_pool[block_table].reshape((b, L) + k_pool.shape[2:])
-    cv = v_pool[block_table].reshape((b, L) + v_pool.shape[2:])
+    ck = k_pool[block_table]
+    cv = v_pool[block_table]
+    if k_scale is not None:
+        ck = dequantize_kv(ck, k_scale[block_table])
+        cv = dequantize_kv(cv, v_scale[block_table])
+    ck = ck.reshape((b, L) + ck.shape[3:])
+    cv = cv.reshape((b, L) + cv.shape[3:])
     logits = jnp.einsum("bqnd,bknd->bnqk", q, ck,
                         preferred_element_type=jnp.float32)
     logits = logits * scale
@@ -85,7 +129,10 @@ def _gather(q, k_pool, v_pool, block_table, pos, scale):
     logits = jnp.where(visible[:, None, :, :], logits,
                        jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(cv.dtype)
-    return jnp.einsum("bnqk,bknd->bqnd", probs, cv)
+    ctx = jnp.einsum("bnqk,bknd->bqnd", probs, cv)
+    # int8 path dequantized to f32; hand back the query's dtype so the
+    # output contract matches the float pools'
+    return ctx if k_scale is None else ctx.astype(q.dtype)
 
 
 #: table width at or below which the blockwise loop uses a STATIC
@@ -99,7 +146,8 @@ def _gather(q, k_pool, v_pool, block_table, pos, scale):
 _STATIC_TRIP_MAX_BLOCKS = 8
 
 
-def _blockwise(q, k_pool, v_pool, block_table, pos, scale):
+def _blockwise(q, k_pool, v_pool, block_table, pos, scale,
+               k_scale=None, v_scale=None):
     """Online-softmax over each row's live blocks, pure ``lax``: the
     CPU tier-1 formulation of the fused kernel (and the fallback for
     any non-TPU backend). ONE ``fori_loop`` — iteration ``j`` gathers
@@ -129,6 +177,11 @@ def _blockwise(q, k_pool, v_pool, block_table, pos, scale):
                                   axis=1)[:, 0]  # [B]
         kb = k_pool[bid]                         # [B, bs, N, D]
         vb = v_pool[bid]
+        if k_scale is not None:
+            # int8 fast path: the gather above moved the int8 bytes;
+            # dequant happens here, on the one-block transient
+            kb = dequantize_kv(kb, k_scale[bid])
+            vb = dequantize_kv(vb, v_scale[bid])
         sc = jnp.einsum("bqnd,btnd->bqnt", q, kb,
                         preferred_element_type=jnp.float32)
         sc = sc * scale                          # [B, s, N, bs]
@@ -154,16 +207,24 @@ def _blockwise(q, k_pool, v_pool, block_table, pos, scale):
     return (acc / l_safe[..., None]).astype(q.dtype)
 
 
-def _paged_kernel(table_ref, nblk_ref, q_ref, pos_ref, k_ref, v_ref,
-                  o_ref, acc_ref, m_ref, l_ref, *, scale, block_size,
-                  num_heads):
+def _paged_kernel(*refs, scale, block_size, num_heads, quantized):
     """One (batch*head, block_j) program: fold this block into the
     online-softmax accumulators; emit on the last table slot. The K/V
     BlockSpec index maps already routed the RIGHT pool block here (and
     clamped dead slots to the last live block, skipping their copy), so
-    the kernel only guards compute."""
+    the kernel only guards compute. ``quantized`` adds per-head scale
+    refs riding the SAME index maps as K/V; dequant happens in-VMEM
+    right after the (int8-sized) copy — the bandwidth the fast path
+    saves is exactly the bytes the DMA no longer moves."""
     from jax.experimental import pallas as pl
 
+    if quantized:
+        (table_ref, nblk_ref, q_ref, pos_ref, k_ref, v_ref, ks_ref,
+         vs_ref, o_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        (table_ref, nblk_ref, q_ref, pos_ref, k_ref, v_ref,
+         o_ref, acc_ref, m_ref, l_ref) = refs
+        ks_ref = vs_ref = None
     bn = pl.program_id(0)
     j = pl.program_id(1)
     b = bn // num_heads
@@ -181,6 +242,9 @@ def _paged_kernel(table_ref, nblk_ref, q_ref, pos_ref, k_ref, v_ref,
         q = q_ref[0, :, 0, :].astype(jnp.float32)       # [s_q, D]
         kb = k_ref[0, :, 0, :].astype(jnp.float32)      # [bs, D]
         vb = v_ref[0, :, 0, :].astype(jnp.float32)
+        if ks_ref is not None:
+            kb = kb * ks_ref[0, :, 0][:, None]
+            vb = vb * vs_ref[0, :, 0][:, None]
         sc = jax.lax.dot_general(
             q, kb, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [s_q, bs]
@@ -210,9 +274,12 @@ def _paged_kernel(table_ref, nblk_ref, q_ref, pos_ref, k_ref, v_ref,
             .astype(o_ref.dtype)
 
 
-def _pallas(q, k_pool, v_pool, block_table, pos, scale, interpret):
+def _pallas(q, k_pool, v_pool, block_table, pos, scale, interpret,
+            k_scale=None, v_scale=None):
     """The TPU kernel: block table as scalar prefetch, K/V index maps
-    read it, dead slots clamp to the last live block (copy skipped)."""
+    read it, dead slots clamp to the last live block (copy skipped).
+    int8 pools bring their ``[P, bs, N]`` scales along on the same
+    index maps; the kernel dequantizes in VMEM."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -221,23 +288,38 @@ def _pallas(q, k_pool, v_pool, block_table, pos, scale, interpret):
     mb = block_table.shape[1]
     table = block_table.astype(jnp.int32)
     nblk = _nblocks(pos.astype(jnp.int32), bs_blk, mb)      # [B]
+    quantized = k_scale is not None
 
     def kv_index(bn, j, table_ref, nblk_ref):
         row = bn // n
         live = jnp.minimum(j, nblk_ref[row] - 1)
         return (table_ref[row, live], 0, bn % n, 0)
 
+    def scale_index(bn, j, table_ref, nblk_ref):
+        # the scales ride the exact pool-block routing K/V use (same
+        # dead-slot clamp, so their copy is skipped together)
+        row = bn // n
+        live = jnp.minimum(j, nblk_ref[row] - 1)
+        return (table_ref[row, live], 0, bn % n)
+
+    in_specs = [
+        pl.BlockSpec((1, s_q, 1, d),
+                     lambda bn, j, t, nb: (bn // n, 0, bn % n, 0)),
+        pl.BlockSpec((1, s_q),
+                     lambda bn, j, t, nb: (bn // n, 0)),
+        pl.BlockSpec((1, bs_blk, 1, d), kv_index),
+        pl.BlockSpec((1, bs_blk, 1, d), kv_index),
+    ]
+    inputs = [table, nblk, q, pos.astype(jnp.int32), k_pool, v_pool]
+    if quantized:
+        in_specs.append(pl.BlockSpec((1, bs_blk, 1), scale_index))
+        in_specs.append(pl.BlockSpec((1, bs_blk, 1), scale_index))
+        inputs.append(k_scale.astype(jnp.float32))
+        inputs.append(v_scale.astype(jnp.float32))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b * n, mb),
-        in_specs=[
-            pl.BlockSpec((1, s_q, 1, d),
-                         lambda bn, j, t, nb: (bn // n, 0, bn % n, 0)),
-            pl.BlockSpec((1, s_q),
-                         lambda bn, j, t, nb: (bn // n, 0)),
-            pl.BlockSpec((1, bs_blk, 1, d), kv_index),
-            pl.BlockSpec((1, bs_blk, 1, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, s_q, 1, d), lambda bn, j, t, nb: (bn // n, 0, bn % n, 0)),
         scratch_shapes=[
@@ -247,17 +329,19 @@ def _pallas(q, k_pool, v_pool, block_table, pos, scale, interpret):
         ],
     )
     kernel = functools.partial(_paged_kernel, scale=scale,
-                               block_size=bs_blk, num_heads=n)
+                               block_size=bs_blk, num_heads=n,
+                               quantized=quantized)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-    )(table, nblk, q, pos.astype(jnp.int32), k_pool, v_pool)
+    )(*inputs)
 
 
 def paged_attention(q, k_pool, v_pool, block_table, pos, scale=None,
-                    impl=None, interpret=None, force_pallas=False):
+                    impl=None, interpret=None, force_pallas=False,
+                    k_scale=None, v_scale=None):
     """Attend ``q`` against paged K/V through ``block_table``.
 
     ``pos [B, S_q]`` is each query's logical position (it sees key
@@ -268,22 +352,29 @@ def paged_attention(q, k_pool, v_pool, block_table, pos, scale=None,
     "gather" is PR 8's materialize-the-view reference oracle;
     "blockwise"/"pallas" force a specific fused formulation
     (``interpret``/``force_pallas`` route the kernel through the
-    Pallas interpreter for CPU tests)."""
+    Pallas interpreter for CPU tests). ``k_scale``/``v_scale``
+    (``[P, block_size, heads]`` float32, both or neither) mark the
+    pools as int8 codes and dequantize them inside the chosen
+    formulation — see the module docstring's int8-KV section."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     pos = jnp.asarray(pos, jnp.int32)
     block_table = jnp.asarray(block_table, jnp.int32)
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together")
     if impl in (None, "auto"):
         on_tpu = jax.default_backend() in ("tpu", "axon")
         impl = "pallas" if (on_tpu or force_pallas) else "blockwise"
     if impl == "gather":
-        return _gather(q, k_pool, v_pool, block_table, pos, scale)
+        return _gather(q, k_pool, v_pool, block_table, pos, scale,
+                       k_scale=k_scale, v_scale=v_scale)
     if impl == "blockwise":
-        return _blockwise(q, k_pool, v_pool, block_table, pos, scale)
+        return _blockwise(q, k_pool, v_pool, block_table, pos, scale,
+                          k_scale=k_scale, v_scale=v_scale)
     if impl == "pallas":
         if interpret is None:
             interpret = jax.default_backend() not in ("tpu", "axon")
         return _pallas(q, k_pool, v_pool, block_table, pos, scale,
-                       interpret)
+                       interpret, k_scale=k_scale, v_scale=v_scale)
     raise ValueError(
         "unknown paged-attention impl {!r}; expected one of "
         "None/'auto', 'pallas', 'blockwise', 'gather'".format(impl))

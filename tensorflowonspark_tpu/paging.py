@@ -50,6 +50,14 @@ consistent counts, not to support concurrent mutation.
 import collections
 import threading
 
+import numpy as np
+
+
+#: bytes per stored K/V element by pool dtype (bfloat16 has no numpy
+#: dtype, so an explicit table beats np.dtype here)
+_KV_ITEMSIZE = {"int8": 1, "float16": 2, "bfloat16": 2, "float32": 4,
+                "float64": 8}
+
 
 class PoolExhausted(RuntimeError):
     """``alloc`` could not supply the requested blocks even after
@@ -68,7 +76,7 @@ class BlockPool(object):
     blocks reclaimed by the LRU under allocation pressure.
     """
 
-    def __init__(self, num_blocks, block_size):
+    def __init__(self, num_blocks, block_size, kv_dtype="float32"):
         if int(num_blocks) < 1:
             raise ValueError(
                 "num_blocks must be >= 1, got {}".format(num_blocks))
@@ -77,6 +85,19 @@ class BlockPool(object):
                 "block_size must be >= 1, got {}".format(block_size))
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        #: storage dtype of the device pools this allocator governs
+        #: (PR 15): "int8" means each block additionally carries
+        #: per-head float32 scales per token row — :meth:`block_bytes`
+        #: is the byte accounting, :meth:`quantize` the host reference
+        #: of the write-path formulation. The allocator's BLOCK math
+        #: (blocks_for / plan / alloc) is dtype-independent: a block
+        #: holds block_size tokens either way, it just costs fewer
+        #: bytes quantized.
+        self.kv_dtype = str(kv_dtype)
+        if self.kv_dtype not in _KV_ITEMSIZE:
+            raise ValueError(
+                "kv_dtype must be one of {}, got {!r}".format(
+                    sorted(_KV_ITEMSIZE), kv_dtype))
         self._lock = threading.Lock()
         # LIFO free list: recently freed blocks are re-handed first
         self._free = list(range(self.num_blocks, 0, -1))
@@ -110,6 +131,44 @@ class BlockPool(object):
             return 0
         return (int(n_tokens) + self.block_size - 1) // self.block_size
 
+    def block_bytes(self, num_heads, head_dim, layers=1):
+        """Resident device bytes ONE block costs across ``layers``
+        attention layers: K + V codes at :attr:`kv_dtype`, plus the
+        per-head float32 scales int8 blocks carry alongside. The
+        number ``estimate_admission``'s byte pricing and the
+        ``serving_decode.kv_int8`` bench's fixed-byte-budget math
+        read — int8 at head_dim 16 costs 40 bytes/token/layer/KV-pair
+        vs float32's 128, so the same budget buys ~3.2x the blocks."""
+        per_token = 2 * num_heads * head_dim * _KV_ITEMSIZE[self.kv_dtype]
+        if self.kv_dtype == "int8":
+            per_token += 2 * num_heads * 4  # the float32 scales
+        return self.block_size * per_token * int(layers)
+
+    @staticmethod
+    def quantize(x):
+        """Numpy mirror of ``ops.paged_attention.quantize_kv`` — the
+        host reference the device write path is pinned against:
+        ``[..., D]`` float -> (int8 codes, float32 per-head scales),
+        symmetric absmax over the last axis, zero vectors to zero
+        codes under scale 1.0. Same exact-round-trip fixed point:
+        requantizing the dequantized grid reproduces codes and scales
+        bitwise (tests/test_speculative.py pins numpy == jnp)."""
+        x = np.asarray(x)
+        # cast BEFORE dividing, exactly like the device op: dividing
+        # in a wider input dtype (float64 numpy default) then casting
+        # double-rounds the scale, shifting codes by ±1 vs the device
+        s = np.max(np.abs(x), axis=-1).astype(np.float32) / 127.0
+        s = np.where(s > 0, s, np.float32(1.0))
+        q = np.clip(np.round(x.astype(np.float32) / s[..., None]),
+                    -127, 127).astype(np.int8)
+        return q, s
+
+    @staticmethod
+    def dequantize(q, s):
+        """Inverse of :meth:`quantize`: codes x scales, float32."""
+        return np.asarray(q, np.float32) \
+            * np.asarray(s, np.float32)[..., None]
+
     def allocatable(self):
         """Blocks an ``alloc`` could supply right now: the free list
         plus every evictable (refcount-0) cached block."""
@@ -127,6 +186,7 @@ class BlockPool(object):
             lookups = self.hits + self.misses
             return {
                 "total": self.num_blocks,
+                "kv_dtype": self.kv_dtype,
                 "free": len(self._free) + len(self._lru),
                 "cached": len(self._lru),
                 "live": len(self._ref),

@@ -529,6 +529,38 @@ class DecodeEngine(object):
         pinned token-identical at temperature=0. Surfaced through
         ``load_stats()`` / ``/healthz`` / the fleet BEAT payload so
         routers can tell kernel configs apart across a fleet.
+      speculate_k: draft-model speculation window (PR 15; paged only;
+        None = off, else >= 2). Each scheduling round a reduced-depth
+        weight-tied draft proposes k tokens (one scanned program) and
+        the target verifies the whole window in ONE fused apply —
+        each round emits 1..k tokens instead of exactly 1, cutting
+        target steps per token by the acceptance rate. Greedy
+        (temperature=0) outputs are BITWISE-identical to the plain
+        engine (token-matching acceptance emits exactly the target's
+        argmax chain — pinned in tests/test_speculative.py); at
+        temperature>0 every emitted token is a true target sample but
+        the PRNG stream differs (exact in distribution, not bitwise-
+        reproducible). Admission, eviction, preemption-continuation,
+        and drain semantics are untouched — speculation only changes
+        what happens between two decode-step boundaries. Acceptance
+        counters ``spec_proposed`` / ``spec_accepted`` /
+        ``spec_rounds`` ride the registry; the live rate rides
+        ``load_stats()`` and the fleet BEAT payload.
+      draft_layers: depth of the weight-tied draft (with speculate_k
+        only; default ``num_layers // 2``, min 1). The draft's params
+        ARE the target's first ``draft_layers`` blocks + embeddings +
+        head (``generation.draft_params`` — no separate weights, no
+        training pipeline), so acceptance measures how much of the
+        target's choice the early layers already decide.
+      kv_dtype: KV pool storage (PR 15; paged only). None (or
+        "fp32"/"float32") keeps the compute dtype; "int8" stores
+        symmetric per-head absmax codes with float32 scales per token
+        row of each block, quantizing at write time and dequantizing
+        INSIDE the attention formulation (fused kernel and blockwise
+        loop alike) — per-step KV bandwidth drops to the int8 bytes
+        and the same byte budget buys ~3.2x the blocks at head_dim
+        16. Lossy: outputs are pinned by top-1 agreement, not
+        bitwise; see docs/serving.md for the error model.
 
     Request lifecycle (PR 4): ``submit(..., deadline_s=T)`` attaches a
     completion deadline. Admission SHEDS the request
@@ -550,7 +582,8 @@ class DecodeEngine(object):
                  eos_token=None, rng=None, counters=None, timers=None,
                  max_queue=1024, metrics=None, flight=None,
                  replica_id=None, kv_block_size=None, kv_blocks=None,
-                 prefix_cache=True, attn_impl=None):
+                 prefix_cache=True, attn_impl=None, speculate_k=None,
+                 draft_layers=None, kv_dtype=None):
         import jax
 
         from tensorflowonspark_tpu import generation
@@ -572,7 +605,9 @@ class DecodeEngine(object):
             top_p=top_p, eos_token=eos_token, rng=rng,
             max_queue=max_queue, replica_id=self.replica_id,
             kv_block_size=kv_block_size, kv_blocks=kv_blocks,
-            prefix_cache=prefix_cache, attn_impl=attn_impl)
+            prefix_cache=prefix_cache, attn_impl=attn_impl,
+            speculate_k=speculate_k, draft_layers=draft_layers,
+            kv_dtype=kv_dtype)
         self._generation = generation
         total_len = int(total_len or model.max_len)
         if total_len > model.max_len:
@@ -655,6 +690,25 @@ class DecodeEngine(object):
                 kv_block_size = 0
         self.kv_block_size = int(kv_block_size)
         self._paged = self.kv_block_size > 0
+        # int8 KV knob (PR 15): None / "fp32" / "float32" keep the
+        # compute-dtype pool; "int8" stores quantized codes + per-head
+        # scales (models/decoder.py) and halves+ per-step KV bandwidth
+        if kv_dtype in ("fp32", "float32"):
+            kv_dtype = None
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                "kv_dtype must be None/'fp32'/'float32' or 'int8', "
+                "got {!r}".format(kv_dtype))
+        self._kv_quant = kv_dtype == "int8"
+        # speculative decoding knob (PR 15): k >= 2 proposal window
+        if speculate_k is not None and int(speculate_k) < 2:
+            raise ValueError(
+                "speculate_k must be >= 2 (a 1-token window is a "
+                "plain decode step plus a wasted draft), got "
+                "{}".format(speculate_k))
+        if speculate_k is None and draft_layers is not None:
+            raise ValueError("draft_layers needs speculate_k")
+        self._spec_k = 0 if speculate_k is None else int(speculate_k)
         if self._paged:
             if total_len % self.kv_block_size:
                 raise ValueError(
@@ -684,8 +738,9 @@ class DecodeEngine(object):
                     "attn_impl must be 'fused' or 'gather', got "
                     "{!r}".format(attn_impl))
             self.attn_impl = attn_impl
-            self._pool = paging.BlockPool(self.kv_blocks,
-                                          self.kv_block_size)
+            self._pool = paging.BlockPool(
+                self.kv_blocks, self.kv_block_size,
+                kv_dtype="int8" if self._kv_quant else "float32")
             self._last_prefix_evictions = 0
             self._last_prefix_hits = 0
             self._last_prefix_misses = 0
@@ -695,23 +750,68 @@ class DecodeEngine(object):
             #: the block gate — skips re-planning it until the pool
             #: changes (see the admission scan)
             self._head_block_memo = None
+            clone_kw = dict(kv_block_size=self.kv_block_size,
+                            kv_blocks=self.kv_blocks + 1,
+                            attn_impl=self.attn_impl)
+            if self._kv_quant:
+                clone_kw["kv_dtype"] = "int8"
             try:
                 # the served model is the caller's, re-speced for the
                 # pool (+1 device row: the scratch block pad writes
                 # land in). Params are layout-identical — only the
                 # cache collection's structure changes.
-                model = model.clone(kv_block_size=self.kv_block_size,
-                                    kv_blocks=self.kv_blocks + 1,
-                                    attn_impl=self.attn_impl)
+                model = model.clone(**clone_kw)
             except TypeError:
                 raise ValueError(
                     "model {} does not support paged KV (no "
-                    "kv_block_size/kv_blocks/attn_impl fields); pass "
+                    "kv_block_size/kv_blocks/attn_impl{} fields); pass "
                     "kv_block_size=0 for the contiguous cache".format(
-                        type(model).__name__))
+                        type(model).__name__,
+                        "/kv_dtype" if self._kv_quant else ""))
             self._model = model
             self._prefill_fn, self._decode_fn = generation.paged_step_fns(
                 model, self._temperature, norm_top_k, norm_top_p)
+            if self._spec_k:
+                # draft-model speculation (PR 15): a reduced-depth,
+                # weight-TIED clone of the served model proposes
+                # speculate_k tokens per round; the target verifies
+                # them in one fused multi-token apply. The draft keeps
+                # its own (smaller) pool pytree but shares the host
+                # block tables and cursors, so ONE BlockPool governs
+                # both and every target write has a mirrored draft
+                # write — which is what keeps prefix-cache hits valid
+                # against the draft pool too.
+                n_layers = getattr(model, "num_layers", None)
+                if n_layers is None:
+                    raise ValueError(
+                        "speculate_k needs a model with a num_layers "
+                        "field to derive a reduced-depth draft; {} "
+                        "has none".format(type(model).__name__))
+                if draft_layers is None:
+                    draft_layers = max(1, int(n_layers) // 2)
+                draft_layers = int(draft_layers)
+                if not 1 <= draft_layers <= int(n_layers):
+                    raise ValueError(
+                        "draft_layers must be in [1, num_layers={}], "
+                        "got {}".format(n_layers, draft_layers))
+                self.draft_layers = draft_layers
+                draft_model = model.clone(num_layers=draft_layers)
+                self._draft_model = draft_model
+                self._draft_params = generation.draft_params(
+                    params, draft_layers)
+                self._round_fn = generation.speculative_step_fns(
+                    model, draft_model, self._spec_k,
+                    self._temperature, norm_top_k, norm_top_p)
+                # measure_spec's standalone halves (lazy-compiled,
+                # non-donating): the hot loop runs ONE fused program
+                self._spec_probe_fns = generation.speculative_probe_fns(
+                    model, draft_model, self._spec_k,
+                    self._temperature, norm_top_k, norm_top_p)
+                self._draft_prefill_fn = generation.paged_step_fns(
+                    draft_model, self._temperature, norm_top_k,
+                    norm_top_p)[0]
+            else:
+                self.draft_layers = 0
         else:
             if kv_blocks is not None:
                 raise ValueError(
@@ -719,9 +819,20 @@ class DecodeEngine(object):
             if attn_impl is not None:
                 raise ValueError(
                     "attn_impl needs a paged engine (kv_block_size>0)")
+            if self._kv_quant:
+                raise ValueError(
+                    "kv_dtype='int8' needs a paged engine "
+                    "(kv_block_size>0): quantized KV lives in the "
+                    "block pool")
+            if self._spec_k:
+                raise ValueError(
+                    "speculate_k needs a paged engine "
+                    "(kv_block_size>0): the fused verify writes "
+                    "through the block tables' scratch routing")
             self.kv_blocks = 0
             self.prefix_cache = False
             self.attn_impl = "contiguous"
+            self.draft_layers = 0
             self._pool = None
             self._model = model
             self._prefill_fn, self._decode_fn = generation.slot_step_fns(
@@ -742,6 +853,13 @@ class DecodeEngine(object):
         # a cold engine never sheds (no evidence, no refusal).
         self._step_ewma = None
         self._prefill_ewma = None
+        # speculation evidence (PR 15): tokens EMITTED per round per
+        # active slot (EWMA, [1, speculate_k]) — the acceptance-scaled
+        # divisor estimate_admission prices service time with (a
+        # speculative engine's _step_ewma measures the whole
+        # draft+verify ROUND, which emits several tokens). None until
+        # the first round; 1.0-equivalent on a plain engine.
+        self._tokens_round_ewma = None
         # queue-wait EWMA rides the fleet BEAT lease: the router's
         # least-loaded policy wants "how long does work wait HERE",
         # which gauges alone (depth, occupancy) don't price
@@ -767,7 +885,22 @@ class DecodeEngine(object):
             # boundary crossings and completion advance it
             self._slot_registered = [0] * self.slots
             self._attn_probe = None  # measure_attn's cached jit
+            self._dequant_probe = None  # measure_dequant's cached jit
         self._cache = generation.init_cache(model, self.slots, total_len)
+        #: resolved pool storage dtype — the pinned schema string
+        #: load_stats / /healthz / the fleet BEAT payload carry
+        #: ("int8" on the quantized fast path, the compute dtype name
+        #: otherwise; one source of truth: the live cache leaves)
+        self.kv_dtype = next(
+            (str(leaf.dtype) for path, leaf in
+             jax.tree_util.tree_leaves_with_path(self._cache)
+             if generation._leaf_name(path) == "cached_key"), "none")
+        if self._spec_k:
+            # the draft's own cache pytree (draft_layers/num_layers of
+            # the target's KV bytes); tables and cursors stay host-
+            # shared, so this is pool storage only
+            self._draft_cache = generation.init_cache(
+                self._draft_model, self.slots, total_len)
         self._publish_kv_gauges()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="tfos-decode-engine")
@@ -861,6 +994,13 @@ class DecodeEngine(object):
         strangers."""
         step = self._step_ewma or 0.0
         prefill = self._prefill_ewma or 0.0
+        # speculation-adjusted per-token cost (PR 15): a speculative
+        # round costs _step_ewma but emits tokens-per-round EWMA
+        # tokens per slot, so the effective per-token step time is the
+        # ratio — shed decisions stay honest when k is on instead of
+        # pricing every token at the (heavier) round cost
+        tpr = max(self._tokens_round_ewma or 1.0, 1.0)
+        step = step / tpr
         backlog = extra_tokens + sum(h.max_new_tokens
                                      for h in self._queue)
         remaining = []
@@ -1050,6 +1190,19 @@ class DecodeEngine(object):
         # engines report the zero schema (attn_impl "contiguous") so
         # consumers need no presence checks.
         stats["attn_impl"] = self.attn_impl
+        # speculative decoding + int8 KV config (PR 15): which fast
+        # paths serve this replica, and the LIVE acceptance rate —
+        # mirrored into /healthz and the fleet BEAT payload so
+        # heterogeneous rollouts (some replicas speculating, some
+        # quantized) stay legible from one probe. Engines with both
+        # features off report the zero schema (speculate_k 0, rate
+        # 0.0, the pool's compute dtype) — no presence checks needed.
+        proposed = self.counters.get("spec_proposed")
+        stats["speculate_k"] = self._spec_k
+        stats["spec_acceptance_rate"] = round(
+            self.counters.get("spec_accepted") / proposed, 4) \
+            if proposed else 0.0
+        stats["kv_dtype"] = self.kv_dtype
         if self._paged:
             ps = self._pool.stats()
             stats["kv_blocks_total"] = ps["total"]
@@ -1068,18 +1221,39 @@ class DecodeEngine(object):
 
     def kv_cache_bytes(self):
         """Resident KV-cache bytes: the block pool (paged — including
-        the scratch row) or the contiguous per-slot regions. The number
-        the ``bench.py serving_decode.paged`` leg holds fixed while
-        scaling concurrency."""
+        the scratch row, and the per-head scales an int8 pool carries
+        alongside its codes) or the contiguous per-slot regions, plus
+        the draft model's pool when speculating. The number the
+        ``bench.py serving_decode.paged`` / ``.kv_int8`` legs hold
+        fixed while scaling concurrency."""
         import jax
 
+        caches = [self._cache]
+        if self._spec_k:
+            caches.append(self._draft_cache)
         total = 0
+        for cache in caches:
+            for path, leaf in jax.tree_util.tree_leaves_with_path(cache):
+                if self._generation._leaf_name(path) in (
+                        "cached_key", "cached_value",
+                        "key_scale", "value_scale"):
+                    total += leaf.size * leaf.dtype.itemsize
+        return total
+
+    def _first_cache_leaves(self, *names):
+        """First cache leaf per name (one layer's pool/scale arrays) —
+        the live-shape source the measure_* probes run against. Keys
+        missing from the cache (e.g. scales on a float engine) map to
+        None."""
+        import jax
+
+        found = dict.fromkeys(names)
         for path, leaf in jax.tree_util.tree_leaves_with_path(
                 self._cache):
-            if self._generation._leaf_name(path) in (
-                    "cached_key", "cached_value"):
-                total += leaf.size * leaf.dtype.itemsize
-        return total
+            name = self._generation._leaf_name(path)
+            if name in found and found[name] is None:
+                found[name] = leaf
+        return found
 
     def measure_attn(self, reps=3, depth=None):
         """Time ONE decode-shaped call of this engine's attention
@@ -1114,14 +1288,10 @@ class DecodeEngine(object):
 
         pa = importlib.import_module(
             "tensorflowonspark_tpu.ops.paged_attention")
-        kp = vp = None
-        for path, leaf in jax.tree_util.tree_leaves_with_path(
-                self._cache):
-            name = self._generation._leaf_name(path)
-            if name == "cached_key" and kp is None:
-                kp = leaf
-            elif name == "cached_value" and vp is None:
-                vp = leaf
+        leaves = self._first_cache_leaves(
+            "cached_key", "cached_value", "key_scale", "value_scale")
+        kp, vp = leaves["cached_key"], leaves["cached_value"]
+        ks, vs = leaves["key_scale"], leaves["value_scale"]
         n, d = kp.shape[2], kp.shape[3]
         depth = int(depth) if depth is not None else self.total_len // 2
         depth = max(1, min(depth, self.total_len))
@@ -1135,15 +1305,61 @@ class DecodeEngine(object):
         pos = jnp.full((self.slots, 1), depth - 1, jnp.int32)
         if self._attn_probe is None:
             impl = "gather" if self.attn_impl == "gather" else None
-            self._attn_probe = jax.jit(
-                lambda q, k, v, t, p: pa.paged_attention(
-                    q, k, v, t, p, impl=impl))
-        self._attn_probe(q, kp, vp, tables, pos).block_until_ready()
+            if self._kv_quant:
+                # the int8 probe times the REAL fast path: int8 loads
+                # + in-formulation dequant against the live scales
+                self._attn_probe = jax.jit(
+                    lambda q, k, v, t, p, ksc, vsc: pa.paged_attention(
+                        q, k, v, t, p, impl=impl, k_scale=ksc,
+                        v_scale=vsc))
+            else:
+                self._attn_probe = jax.jit(
+                    lambda q, k, v, t, p: pa.paged_attention(
+                        q, k, v, t, p, impl=impl))
+        args = (q, kp, vp, tables, pos) + ((ks, vs)
+                                           if self._kv_quant else ())
+        self._attn_probe(*args).block_until_ready()
         for _ in range(max(1, int(reps))):
             with self.timers.timed("attn"):
-                self._attn_probe(q, kp, vp, tables,
-                                 pos).block_until_ready()
+                self._attn_probe(*args).block_until_ready()
         return self.timers.per_ms().get("attn")
+
+    def measure_dequant(self, reps=3):
+        """Time ONE whole-pool dequantize (codes x scales for K and V)
+        at the engine's live int8 pool shapes, recorded as the
+        ``dequant`` stage in ``self.timers`` — the honest attribution
+        of what the int8 fast path ADDS to a step, standing beside
+        what ``measure_attn`` shows it saves. Standalone probe for the
+        same reason as ``measure_attn``: the dequant lives inside the
+        fused kernel and XLA exposes no per-op timing. One layer's
+        pool per call; multiply by ``num_layers`` for a per-step
+        bound (the kernel only touches LIVE blocks, so this
+        whole-pool number is the worst case). Returns mean ms per
+        call, or None on a non-int8 engine."""
+        if not self._kv_quant:
+            return None
+        import importlib
+
+        import jax
+
+        pa = importlib.import_module(
+            "tensorflowonspark_tpu.ops.paged_attention")
+        leaves = self._first_cache_leaves(
+            "cached_key", "cached_value", "key_scale", "value_scale")
+        kp, vp = leaves["cached_key"], leaves["cached_value"]
+        ks, vs = leaves["key_scale"], leaves["value_scale"]
+        if self._dequant_probe is None:
+            # BOTH pools: a step's attention dequantizes K and V, so a
+            # K-only probe would under-report the add-on by 2x
+            self._dequant_probe = jax.jit(
+                lambda k, ksc, v, vsc: (pa.dequantize_kv(k, ksc),
+                                        pa.dequantize_kv(v, vsc)))
+        jax.block_until_ready(self._dequant_probe(kp, ks, vp, vs))
+        for _ in range(max(1, int(reps))):
+            with self.timers.timed("dequant"):
+                jax.block_until_ready(
+                    self._dequant_probe(kp, ks, vp, vs))
+        return self.timers.per_ms().get("dequant")
 
     def outstanding(self):
         """Queued + in-flight request count (the number drain waits on)."""
@@ -1219,9 +1435,15 @@ class DecodeEngine(object):
         def n_programs(fn):
             size = getattr(fn, "_cache_size", None)
             return size() if callable(size) else None
-        return {"decode_programs": n_programs(self._decode_fn),
-                "prefill_programs": n_programs(self._prefill_fn),
-                "buckets": len(self.buckets)}
+        stats = {"decode_programs": n_programs(self._decode_fn),
+                 "prefill_programs": n_programs(self._prefill_fn),
+                 "buckets": len(self.buckets)}
+        if self._spec_k:
+            # a speculative engine's loop runs the fused round instead
+            # of the plain decode fn (decode_programs stays 0); same
+            # ONE-program-per-engine-config contract
+            stats["spec_round_programs"] = n_programs(self._round_fn)
+        return stats
 
     def stop(self):
         """Stop the scheduler; queued and in-flight requests fail fast
@@ -1432,19 +1654,23 @@ class DecodeEngine(object):
                 # an in-process fleet)
                 chaos.on_decode_step(steps, self.replica_id)
                 t0 = time.monotonic()
-                with self.timers.timed("decode_step"):
-                    if self._paged:
-                        self._cache, toks = self._decode_fn(
-                            self.params, self._cache,
-                            jnp.asarray(self._last),
-                            jnp.asarray(self._idx),
-                            jnp.asarray(self._tables), self._next_key())
-                    else:
-                        self._cache, toks = self._decode_fn(
-                            self.params, self._cache,
-                            jnp.asarray(self._last),
-                            jnp.asarray(self._idx), self._next_key())
-                    toks = np.asarray(toks)  # the per-step host sync
+                if self._spec_k:
+                    drafts, targets = self._spec_round(jnp)
+                else:
+                    with self.timers.timed("decode_step"):
+                        if self._paged:
+                            self._cache, toks = self._decode_fn(
+                                self.params, self._cache,
+                                jnp.asarray(self._last),
+                                jnp.asarray(self._idx),
+                                jnp.asarray(self._tables),
+                                self._next_key())
+                        else:
+                            self._cache, toks = self._decode_fn(
+                                self.params, self._cache,
+                                jnp.asarray(self._last),
+                                jnp.asarray(self._idx), self._next_key())
+                        toks = np.asarray(toks)  # the per-step host sync
                 t1 = time.monotonic()
                 self._step_ewma = self._ewma(self._step_ewma, t1 - t0)
                 self._hist_step.observe(t1 - t0)
@@ -1455,16 +1681,24 @@ class DecodeEngine(object):
                 steps += 1
                 self.counters.inc("decode_steps")
                 with self.timers.timed("host_schedule"):
-                    for s in active:
-                        # the step just WROTE the fed token at _idx[s]:
-                        # advance the cursor, then deliver the emission
-                        self._idx[s] += 1
-                        self._deliver(s, int(toks[s]))
-                    self.counters.inc("tokens", len(active))
+                    if self._spec_k:
+                        delivered = self._spec_deliver(active, drafts,
+                                                       targets)
+                    else:
+                        for s in active:
+                            # the step just WROTE the fed token at
+                            # _idx[s]: advance the cursor, then
+                            # deliver the emission
+                            self._idx[s] += 1
+                            self._deliver(s, int(toks[s]))
+                        delivered = len(active)
+                    self.counters.inc("tokens", delivered)
                     # decode_tokens excludes prefill-emitted firsts, so
                     # rate("decode_tokens", "decode_steps") is true
-                    # decode occupancy (bounded by slots)
-                    self.counters.inc("decode_tokens", len(active))
+                    # decode occupancy (bounded by slots; under
+                    # speculation, tokens per ROUND — the acceptance
+                    # win read straight off the counters)
+                    self.counters.inc("decode_tokens", delivered)
                     # re-publish occupancy AFTER deliveries: when the
                     # last slot frees on a completion the loop parks in
                     # cv.wait, and a gauge frozen at the pre-step value
@@ -1500,6 +1734,122 @@ class DecodeEngine(object):
         # nothing is queued or occupied anymore
         self.counters.gauge("queue_depth", 0)
         self.counters.gauge("slot_occupancy", 0)
+
+    # -- speculative decoding round (PR 15; scheduler thread only) -------
+
+    def _spec_round(self, jnp):
+        """Device half of one speculative round, as ONE fused program
+        (one dispatch, one host sync): the draft proposes
+        ``speculate_k`` tokens per slot via a scanned program, and the
+        target scores the whole window — ``[last, d_1..d_{k-1}]``,
+        wired draft→verify on device — in one fused multi-token apply
+        against the paged pool (the PR 2 multi-token prefill branch
+        pointed at decode). Both writes ride the shared block tables
+        at the shared cursors, so the draft pool mirrors the target
+        pool position for position. Returns ``(drafts [S, k],
+        targets [S, k])`` host arrays. Per-half wall attribution
+        comes from :meth:`measure_spec`'s standalone probes — per-op
+        timing is invisible inside one program."""
+        with self.timers.timed("spec_round"):
+            self._cache, self._draft_cache, drafts, targets = \
+                self._round_fn(
+                    self.params, self._draft_params, self._cache,
+                    self._draft_cache, jnp.asarray(self._last),
+                    jnp.asarray(self._idx), jnp.asarray(self._tables),
+                    self._next_key())
+            drafts = np.asarray(drafts)   # the per-round host sync
+            targets = np.asarray(targets)
+        return drafts, targets
+
+    def measure_spec(self, reps=3, depth=None):
+        """Time the speculative round's two halves SEPARATELY — the
+        draft propose scan and the target verify apply — at the
+        engine's pool shapes with every slot ``depth`` tokens deep
+        (default ``total_len // 2``), recording ``draft`` and
+        ``verify`` stage samples in ``self.timers`` so bench/profile
+        stage tables attribute the round through the same
+        metrics_report helpers as every other stage. Same honest-
+        attribution rationale as :meth:`measure_attn`: the hot loop
+        runs ONE fused program and XLA exposes no per-op timing, so
+        each half runs standalone (non-donating jits over the very
+        bodies the fused round composes). Call while the engine is
+        idle — it reads the live cache pytrees. Returns
+        ``{"draft": ms, "verify": ms}`` or None on a non-speculative
+        engine."""
+        if not self._spec_k:
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        k = self._spec_k
+        depth = int(depth) if depth is not None else self.total_len // 2
+        depth = max(1, min(depth, self.total_len - k))
+        bps = self._blocks_per_slot
+        tables = jnp.asarray(
+            (np.arange(self.slots)[:, None] * bps
+             + np.arange(bps)[None, :]) % self.kv_blocks + 1, jnp.int32)
+        idx = jnp.full((self.slots,), depth, jnp.int32)
+        last = jnp.zeros((self.slots,), jnp.int32)
+        feed = jnp.zeros((self.slots, k), jnp.int32)
+        key = jax.random.PRNGKey(0)
+        propose, verify = self._spec_probe_fns
+        propose(self._draft_params, self._draft_cache, last, idx,
+                tables, key)[1].block_until_ready()
+        verify(self.params, self._cache, feed, idx, tables,
+               key)[1].block_until_ready()
+        for _ in range(max(1, int(reps))):
+            with self.timers.timed("draft"):
+                propose(self._draft_params, self._draft_cache, last,
+                        idx, tables, key)[1].block_until_ready()
+            with self.timers.timed("verify"):
+                verify(self.params, self._cache, feed, idx, tables,
+                       key)[1].block_until_ready()
+        per = self.timers.per_ms()
+        return {"draft": per.get("draft"), "verify": per.get("verify")}
+
+    def _spec_deliver(self, active, drafts, targets):
+        """Host half: token-matching acceptance + per-token delivery.
+        ``a`` = longest prefix where the draft's proposal equals the
+        target's own pick; the round emits ``targets[:a+1]`` (``a``
+        accepted draft tokens — which ARE the target picks — plus the
+        target's correction), or all k on a full match. Every emitted
+        token is therefore a target-model choice: at temperature=0
+        exactly the plain engine's argmax chain (bitwise pin), at
+        temperature>0 a true target sample (exact in distribution,
+        PRNG stream not bitwise-reproducible — docs/serving.md states
+        this honestly). Rejected positions' K/V is garbage PAST the
+        new cursor, overwritten by the next round's window before the
+        visibility mask can reach it — the same discipline as
+        bucket-pad scratch writes. Counters tally only the EMITTABLE
+        window ``min(k, remaining)`` — a request one token from its
+        length cap gets one useful proposal, and counting the whole
+        k-window would skew the fleet-visible acceptance rate on
+        short-request workloads (tail positions beyond ``remaining``
+        were never even granted real blocks). Counter arithmetic
+        (pinned): ``spec_rounds <= spec_proposed <= k * spec_rounds``
+        and ``spec_accepted <= spec_proposed``."""
+        k = self._spec_k
+        delivered = 0
+        for s in active:
+            handle = self._slot_req[s]
+            window = min(k, max(1, handle.max_new_tokens
+                                - len(handle._tokens)))
+            a = 0
+            while a < window and drafts[s, a] == targets[s, a]:
+                a += 1
+            self.counters.inc("spec_rounds")
+            self.counters.inc("spec_proposed", window)
+            self.counters.inc("spec_accepted", a)
+            for tok in targets[s, :min(a + 1, window)]:
+                if self._slot_req[s] is None:
+                    break  # completed mid-window (EOS / length)
+                self._idx[s] += 1
+                self._deliver(s, int(tok))
+                delivered += 1
+        if active:
+            self._tokens_round_ewma = self._ewma(
+                self._tokens_round_ewma, delivered / len(active))
+        return delivered
 
     # -- paged-KV block management (PR 8; scheduler thread only) ---------
 
@@ -1608,42 +1958,59 @@ class DecodeEngine(object):
             handle.max_new_tokens)
 
     def _grow_active_blocks(self):
-        """Ensure every active slot owns the block its NEXT write lands
-        in, allocating one as the sequence crosses a block boundary —
-        the lazy-growth half of paging (a sequence consumes blocks as
-        it grows, never ``max_len`` up front). Under exhaustion the
-        YOUNGEST admission is preempted (LIFO victims), so the oldest
-        request always progresses: no preemption livelock, and
-        ``validate``'s worst-case-fits-the-pool bound guarantees the
-        oldest alone can always finish."""
+        """Ensure every active slot owns the blocks this round's
+        writes land in, allocating as the sequence crosses block
+        boundaries — the lazy-growth half of paging (a sequence
+        consumes blocks as it grows, never ``max_len`` up front). A
+        PLAIN round writes one position, so the lookahead is 1; a
+        SPECULATIVE round writes up to ``speculate_k`` positions, so
+        growth covers ``min(k, tokens the request can still emit)`` —
+        writes past that clamp are rejected-proposal garbage that may
+        land in scratch (table entry 0) because no cursor will ever
+        make them visible. Under exhaustion the YOUNGEST admission is
+        preempted (LIFO victims), so the oldest request always
+        progresses: no preemption livelock, and ``validate``'s
+        worst-case-fits-the-pool bound guarantees the oldest alone
+        can always satisfy its own lookahead."""
         bs = self.kv_block_size
+        look = self._spec_k or 1
         for s in sorted(self._active_slots(),
                         key=lambda v: self._slot_seq[v]):
-            if self._slot_req[s] is None:
+            handle = self._slot_req[s]
+            if handle is None:
                 continue  # preempted by an earlier slot's growth
-            bi = int(self._idx[s]) // bs
-            if bi < len(self._slot_blocks[s]):
+            # publish every fully-written block into the prefix
+            # registry (generated-prefix registration, PR 11) while
+            # the slot still references them — checked every round,
+            # not only when growth is needed: speculative lookahead
+            # pre-allocates blocks AHEAD of the cursor, so a crossing
+            # no longer implies a growth event (a crossing-gated call
+            # would delay registration — and the prefix hit a twin
+            # admission could have had — by up to a block). Cheap: an
+            # early return when nothing new completed.
+            self._register_generated(s, handle)
+            cover = min(look,
+                        max(1, handle.max_new_tokens
+                            - len(handle._tokens)))
+            need = min((int(self._idx[s]) + cover - 1) // bs + 1,
+                       self._blocks_per_slot)
+            if len(self._slot_blocks[s]) >= need:
                 continue
-            # the crossing means every block before ``bi`` is fully
-            # written: publish the newly-completed one(s) into the
-            # prefix registry (generated-prefix registration, PR 11)
-            # while the slot still references them
-            self._register_generated(s, self._slot_req[s])
-            while True:
+            while self._slot_req[s] is not None \
+                    and len(self._slot_blocks[s]) < need:
                 try:
                     with self.timers.timed("block_alloc"):
                         new_id = self._pool.alloc(1)[0]
                 except paging.PoolExhausted:
                     victim = max(self._active_slots(),
                                  key=lambda v: self._slot_seq[v])
+                    # preempting s itself clears its slot_req and
+                    # ends the while
                     self._preempt(victim)
-                    if victim == s:
-                        break  # this slot itself yielded
                     continue
+                self._tables[s][len(self._slot_blocks[s])] = new_id
                 self._slot_blocks[s].append(new_id)
-                self._tables[s][bi] = new_id
-                self._publish_kv_gauges()
-                break
+            self._publish_kv_gauges()
 
     def _admit_paged(self, slot, handle):
         """Paged admission: point the slot's block table at any
@@ -1719,6 +2086,20 @@ class DecodeEngine(object):
                          prefix_blocks=len(shared))
         handle._decode_t0 = t1
         self.counters.inc("prefills")
+        if self._spec_k:
+            # mirror the tail into the DRAFT pool (PR 15): the draft
+            # attends the same prefix through the same table row, so
+            # its cache must hold the prompt's K/V too (a prefix-cache
+            # hit skips both prefills together — shared blocks were
+            # mirrored when their original writer prefilled/decoded).
+            # The draft's own first-token pick is discarded; this call
+            # exists for its writes.
+            with self.timers.timed("draft_prefill"):
+                self._draft_cache, _ = self._draft_prefill_fn(
+                    self._draft_params, self._draft_cache,
+                    jnp.asarray(row), jnp.asarray(toks),
+                    jnp.int32(len(tail)), jnp.int32(start),
+                    self._next_key())
         if self.prefix_cache:
             # publish every FULL block of the admitted sequence (now
             # holding valid K/V) under its token-chain key;
@@ -2457,7 +2838,9 @@ class ModelServer(object):
                 for key in ("kv_blocks_total", "kv_blocks_free",
                             "prefix_hit_rate", "attn_impl",
                             "generated_prefix_hit_blocks",
-                            "generated_prefix_registered"):
+                            "generated_prefix_registered",
+                            "speculate_k", "spec_acceptance_rate",
+                            "kv_dtype"):
                     body[key] = load[key]
             if self._draining:
                 # draining outranks the liveness checks below: mid-
@@ -2520,6 +2903,13 @@ class ModelServer(object):
             info += ('# TYPE tfos_serving_attn_impl gauge\n'
                      'tfos_serving_attn_impl{{impl="{}"}} 1\n'
                      .format(impl))
+        kv_dtype = getattr(engine, "kv_dtype", None)
+        if kv_dtype is not None:
+            # and for the KV storage dtype (PR 15): which replicas run
+            # the int8 fast path during a quantization rollout
+            info += ('# TYPE tfos_serving_kv_dtype gauge\n'
+                     'tfos_serving_kv_dtype{{dtype="{}"}} 1\n'
+                     .format(kv_dtype))
         if info:
             text = text.replace("# EOF\n", info + "# EOF\n")
         return text

@@ -318,13 +318,38 @@ METRIC_FAMILIES = {
                         "tfos_serving_prefix_hit_blocks; preemption "
                         "continuations re-hitting their own blocks "
                         "excluded)"),
+    # -- speculative decoding + int8 paged KV (PR 15) --
+    "tfos_serving_spec_proposed":
+        ("counter", "", "draft tokens proposed by speculative rounds, "
+                        "clamped to each request's emittable window "
+                        "min(speculate_k, remaining) — so between 1x "
+                        "and speculate_k x tfos_serving_spec_rounds"),
+    "tfos_serving_spec_accepted":
+        ("counter", "", "proposed draft tokens the target's verify "
+                        "accepted (<= proposed; accepted/proposed is "
+                        "the live acceptance rate load_stats and the "
+                        "BEAT payload carry)"),
+    "tfos_serving_spec_rounds":
+        ("counter", "", "speculative draft+verify rounds run, counted "
+                        "per active slot (a round over 3 slots counts "
+                        "3)"),
+    "tfos_serving_kv_dtype":
+        ("gauge", "dtype", "constant 1 carrying the engine's KV pool "
+                           "storage dtype (int8 fast path vs the "
+                           "compute dtype) — info-pattern join key "
+                           "for quantization rollouts across a "
+                           "fleet"),
     "tfos_serving_queue_depth":
         ("gauge", "", "requests waiting for a slot"),
     "tfos_serving_slot_occupancy":
         ("gauge", "", "slots holding an in-flight sequence"),
     "tfos_serving_stage_seconds":
         ("counter", "stage", "scheduler wall seconds per stage "
-                             "(prefill / decode_step / host_schedule)"),
+                             "(prefill / decode_step / host_schedule; "
+                             "speculative engines add spec_round / "
+                             "draft_prefill plus the draft and verify "
+                             "probes, int8 engines the dequant "
+                             "probe)"),
     "tfos_serving_stage_samples":
         ("counter", "stage", "samples behind tfos_serving_stage_seconds"),
     "tfos_serving_replica_info":

@@ -112,6 +112,93 @@ def test_fused_never_reads_dead_blocks(impl):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(clean))
 
 
+def _int8_case(seed, **kw):
+    """A float case quantized into int8 pools + per-head scales — all
+    three impls dequantize the SAME codes, so their outputs must agree
+    to accumulation noise (the int8 parity contract; the float-vs-int8
+    ERROR is the engine-level agreement test's business)."""
+    q, kp, vp, table, pos = _case(seed, **kw)
+    qk, sk = pa.quantize_kv(kp)
+    qv, sv = pa.quantize_kv(vp)
+    return q, qk, qv, sk, sv, table, pos
+
+
+def test_quantize_kv_round_trip_exact():
+    """The exact-round-trip fixed point: requantizing the dequantized
+    grid reproduces codes AND scales bitwise (the absmax element maps
+    to ±127 exactly), zero vectors quantize to zero codes under scale
+    1.0, and the numpy mirror in paging.BlockPool agrees bitwise with
+    the device op."""
+    from tensorflowonspark_tpu import paging
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(5, 8, 4, 16).astype(np.float32)
+    x[1, 2, 3] = 0.0  # an all-zero head vector
+    q1, s1 = pa.quantize_kv(jnp.asarray(x))
+    deq = pa.dequantize_kv(q1, s1)
+    q2, s2 = pa.quantize_kv(deq)
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    assert np.asarray(s1)[1, 2, 3] == 1.0
+    assert not np.asarray(q1)[1, 2, 3].any()
+    # max quantization error is bounded by scale/2 per element
+    err = np.abs(np.asarray(deq) - x)
+    assert np.all(err <= np.asarray(s1)[..., None] / 2 + 1e-7)
+    # host mirror == device op, bitwise
+    hq, hs = paging.BlockPool.quantize(x)
+    np.testing.assert_array_equal(hq, np.asarray(q1))
+    np.testing.assert_array_equal(hs, np.asarray(s1))
+    np.testing.assert_array_equal(
+        paging.BlockPool.dequantize(hq, hs), np.asarray(deq))
+    # and for float64 input: both sides must cast BEFORE dividing, or
+    # the double-rounded scale shifts codes by ±1 between runtimes
+    x64 = rng.randn(3, 4, 16)
+    hq64, hs64 = paging.BlockPool.quantize(x64)
+    dq64, ds64 = pa.quantize_kv(jnp.asarray(x64))
+    np.testing.assert_array_equal(hq64, np.asarray(dq64))
+    np.testing.assert_array_equal(hs64, np.asarray(ds64))
+
+
+@pytest.mark.parametrize("s_q", [1, 4])
+def test_int8_blockwise_and_pallas_match_gather(s_q):
+    """int8 parity across formulations: gather dequantizes the
+    materialized view, blockwise and the Pallas kernel (interpret —
+    the tier-1 path for the in-kernel dequant) one block at a time;
+    same codes, same scales, so outputs agree to accumulation
+    noise."""
+    for seed in range(3):
+        q, qk, qv, sk, sv, table, pos = _int8_case(seed, s_q=s_q)
+        ref = pa.paged_attention(q, qk, qv, table, pos, impl="gather",
+                                 k_scale=sk, v_scale=sv)
+        blk = pa.paged_attention(q, qk, qv, table, pos,
+                                 impl="blockwise", k_scale=sk,
+                                 v_scale=sv)
+        pal = pa.paged_attention(q, qk, qv, table, pos, impl="pallas",
+                                 interpret=True, k_scale=sk,
+                                 v_scale=sv)
+        np.testing.assert_allclose(np.asarray(blk), np.asarray(ref),
+                                   atol=2e-6, rtol=2e-6)
+        np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                                   atol=2e-6, rtol=2e-6)
+
+
+def test_int8_scales_validated_and_close_to_float():
+    """One-sided scales are a loud error, and the dequantized
+    attention lands close to the float original (the per-head absmax
+    grid is fine enough that attention outputs move by quantization
+    noise, not structure)."""
+    q, kp, vp, table, pos = _case(9)
+    qk, sk = pa.quantize_kv(kp)
+    qv, sv = pa.quantize_kv(vp)
+    with pytest.raises(ValueError, match="together"):
+        pa.paged_attention(q, qk, qv, table, pos, k_scale=sk)
+    ref = pa.paged_attention(q, kp, vp, table, pos, impl="gather")
+    i8 = pa.paged_attention(q, qk, qv, table, pos, impl="gather",
+                            k_scale=sk, v_scale=sv)
+    np.testing.assert_allclose(np.asarray(i8), np.asarray(ref),
+                               atol=0.08, rtol=0.08)
+
+
 def test_auto_dispatch_and_bad_impl():
     """Off-TPU the auto path IS the blockwise formulation (bitwise);
     unknown impls fail loudly."""
@@ -148,5 +235,23 @@ def test_pallas_tpu_compiles_and_matches():
     ref = pa.paged_attention(q, kp, vp, table, pos, impl="gather")
     pal = pa.paged_attention(q, kp, vp, table, pos, impl="pallas",
                              interpret=False)
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                               atol=5e-6, rtol=5e-6)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(jax.default_backend() not in ("tpu", "axon"),
+                    reason="real Mosaic compile needs a TPU backend "
+                           "(tier-1 covers the int8 dequant via "
+                           "interpret mode)")
+def test_pallas_tpu_int8_compiles_and_matches():
+    """On-chip record for the int8 fast path: the in-kernel dequant
+    (int8 loads + scale refs riding the K/V index maps) must lower
+    through real Mosaic and match the gather dequant reference."""
+    q, qk, qv, sk, sv, table, pos = _int8_case(8, s_q=1)
+    ref = pa.paged_attention(q, qk, qv, table, pos, impl="gather",
+                             k_scale=sk, v_scale=sv)
+    pal = pa.paged_attention(q, qk, qv, table, pos, impl="pallas",
+                             interpret=False, k_scale=sk, v_scale=sv)
     np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
                                atol=5e-6, rtol=5e-6)

@@ -61,7 +61,13 @@ Prints ONE JSON line. Fields:
                          the 1-core CPU box the replicas share one
                          core, so scaling there measures the router's
                          overhead floor, not capacity (chip runs are
-                         the capacity claim).
+                         the capacity claim). The ``affinity`` subleg
+                         (PR 16) pins prefix/session-aware routing:
+                         warm turn-2 TTFT p50 at 4 replicas >= 3x
+                         better than the load-only baseline published
+                         beside it, and hot-session-skew p99 within
+                         1.5x of pure load balancing (the load
+                         guard).
 - ``recovery``         — the supervision plane (PR 3): MTTR of an
                          injected mid-job trainer SIGKILL under
                          ``cluster.run(..., supervise=...)``, with the
@@ -1160,6 +1166,143 @@ def _autoscale_leg(dec, params, slots=4):
         }
 
 
+def _affinity_leg(slots=4, n_replicas=4, sessions=16,
+                  prefix_len=192, turn1_new=24, turn2_new=2):
+    """serving_fleet.affinity (PR 16): prefix-aware routing vs the
+    load-only baseline on the SAME multi-turn workload. Two claims:
+
+    ``multi_turn`` — ``sessions`` conversations each run turn-1 then a
+    turn-2 continuation (turn-1 output + fresh tokens) against a
+    ``n_replicas`` fleet, once with affinity routing and once with the
+    router's ``affinity_enabled=False`` baseline (fresh engines each
+    run, so caches start equally empty). Turn-2 client wall at
+    max_new=``turn2_new`` is the fleet-wide warm-TTFT proxy; the
+    published pin is affinity p50 >= 3x better than the baseline p50
+    (the baseline lands warm only when least-loaded happens to pick
+    the caching replica — the ~1/N the motivation cites).
+
+    ``hot_skew`` — one session receives a concurrent burst (every
+    request naming the SAME warm replica) alongside background
+    singles; the pin is affinity-routed overall p99 within 1.5x of
+    pure load balancing, because the load guard diverts the burst's
+    overflow instead of letting the warm replica become a hotspot
+    (`affinity_breaks{load_guard}` counts the diversions).
+
+    Both runs prewarm through one throwaway engine touching every
+    prefill bucket the workload hits (including the warm TAIL bucket —
+    the warm path's own compile), so compile time cancels out. The leg
+    builds the larger serving model at every box size: warm-vs-cold is
+    a PREFILL ratio, and the smoke model's prefill is so cheap the
+    fixed per-request floor (HTTP, admission, decode steps) would
+    drown the signal being measured."""
+    import concurrent.futures
+    import json as json_mod
+    import math
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu import fleet as fleet_mod
+    from tensorflowonspark_tpu import serving
+
+    train, dec = _serving_model(True)
+    params = train.init(jax.random.PRNGKey(0),
+                        np.zeros((1, dec.max_len), np.int32))["params"]
+    rs = np.random.RandomState(3)
+    turn1 = [[int(t) for t in rs.randint(1, dec.vocab, prefix_len)]
+             for _ in range(sessions)]
+    # turn-1 outputs are deterministic (greedy decode), so one
+    # throwaway engine both precomputes every turn-2 prompt and
+    # prewarms every prefill bucket either fleet will hit
+    with serving.DecodeEngine(dec, params, slots=slots) as warm_eng:
+        outs = [warm_eng.submit(p, turn1_new).result(600)
+                for p in turn1]
+        turn2 = [out + [int(t) for t in rs.randint(1, dec.vocab, 2)]
+                 for out in outs]
+        for p2 in turn2:
+            warm_eng.submit(p2, 1).result(600)
+
+    def pctl(walls, q):
+        if not walls:
+            return None
+        walls = sorted(walls)
+        return walls[min(len(walls) - 1,
+                         int(math.ceil(q * len(walls))) - 1)]
+
+    def run(affinity):
+        with fleet_mod.ServingFleet(
+                dec, params, replicas=n_replicas,
+                engine_kw={"slots": slots},
+                router_kw={"affinity_enabled": affinity}) as f:
+            url = f.url("/v1/models/model:generate")
+
+            def turn(session, prompt, max_new):
+                body = json_mod.dumps(
+                    {"prompt": prompt, "max_new_tokens": max_new,
+                     "session": session}).encode()
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"})
+                t0 = time.monotonic()
+                with urllib.request.urlopen(req, timeout=600) as r:
+                    r.read()
+                return time.monotonic() - t0
+
+            with concurrent.futures.ThreadPoolExecutor(8) as pool:
+                # turn-1: establish per-session caches (and, under
+                # affinity, the session -> replica map entries).
+                # CONCURRENT so backlog spreads the sessions across
+                # the fleet — the scatter that makes turn-2 routing
+                # matter at all
+                list(pool.map(
+                    lambda i: turn("s{}".format(i), turn1[i],
+                                   turn1_new), range(sessions)))
+            # turn-2: SEQUENTIAL, one in flight — each wall is a clean
+            # TTFT proxy (prefill + fixed floor), not a measurement of
+            # the box's CPU contention under 8 concurrent prefills
+            t2_walls = [turn("s{}".format(i), turn2[i], turn2_new)
+                        for i in range(sessions)]
+            # hot-session skew: seed one hot conversation warm, then
+            # burst it concurrently alongside unique-session singles
+            turn("hot", turn1[0], turn1_new)
+            burst = [("hot", turn2[0]) for _ in range(3 * n_replicas)] \
+                + [("bg{}".format(i), turn1[i])
+                   for i in range(1, n_replicas + 1)]
+            with concurrent.futures.ThreadPoolExecutor(
+                    len(burst)) as pool:
+                skew_walls = list(pool.map(
+                    lambda sp: turn(sp[0], sp[1], turn2_new), burst))
+            counts = f.router.counters.snapshot()["counts"]
+            breaks = dict(f.router._affinity_breaks)
+            return {
+                "turn2_ttft_p50_ms":
+                    round(pctl(t2_walls, 0.5) * 1e3, 1),
+                "skew_p99_ms": round(pctl(skew_walls, 0.99) * 1e3, 1),
+                "affinity_hits": counts.get("affinity_hits", 0),
+                "affinity_breaks": breaks,
+                "map_entries": len(f.router.affinity),
+            }
+
+    warm = run(True)
+    cold = run(False)
+    out = {
+        "replicas": n_replicas, "slots_per_replica": slots,
+        "sessions": sessions,
+        "workload": {"prefix_len": prefix_len, "turn1_new": turn1_new,
+                     "turn2_new": turn2_new},
+        "affinity": warm,
+        "load_only_baseline": cold,
+    }
+    if cold["turn2_ttft_p50_ms"] and warm["turn2_ttft_p50_ms"]:
+        out["warm_ttft_speedup"] = round(
+            cold["turn2_ttft_p50_ms"] / warm["turn2_ttft_p50_ms"], 2)
+    if cold["skew_p99_ms"] and warm["skew_p99_ms"]:
+        out["skew_p99_vs_balance"] = round(
+            warm["skew_p99_ms"] / cold["skew_p99_ms"], 2)
+    return out
+
+
 def _serving_fleet_bench(on_tpu, replica_counts=(1, 2, 4)):
     """Aggregate serving throughput at 1 vs 2 vs 4 router-fronted
     replicas on the shared mixed-length workload. Returns the
@@ -1214,6 +1357,16 @@ def _serving_fleet_bench(on_tpu, replica_counts=(1, 2, 4)):
             print("serving_fleet.autoscale failed: {}".format(e),
                   file=sys.stderr)
             block["autoscale"] = {"error": str(e)}
+    # prefix/session-affinity leg (PR 16): warm turn-2 TTFT vs the
+    # load-only baseline + hot-skew load-guard check.
+    # TFOS_BENCH_AFFINITY=0 skips just this leg.
+    if os.environ.get("TFOS_BENCH_AFFINITY", "1") == "1":
+        try:
+            block["affinity"] = _affinity_leg()
+        except Exception as e:  # noqa: BLE001 - report, not die
+            print("serving_fleet.affinity failed: {}".format(e),
+                  file=sys.stderr)
+            block["affinity"] = {"error": str(e)}
     return block
 
 

@@ -215,8 +215,13 @@ def main(argv=None):
                 return serving.retry_call(attempt)
 
             t0 = time.monotonic()
-            outs = [post({"prompt": p, "max_new_tokens": mn})["tokens"]
-                    for p, mn in reqs]
+            # each request carries a session id (PR 16): the router
+            # pins follow-up turns of a conversation to the replica
+            # whose prefix cache is warm for it — same wire contract,
+            # one optional field
+            outs = [post({"prompt": p, "max_new_tokens": mn,
+                          "session": "demo-{}".format(i)})["tokens"]
+                    for i, (p, mn) in enumerate(reqs)]
             wall = time.monotonic() - t0
             mismatches = 0
             for (p, mn), got in zip(reqs, outs):
@@ -231,6 +236,8 @@ def main(argv=None):
                            "requests": len(reqs), "tokens": tokens,
                            "tokens_per_sec": round(tokens / wall, 1),
                            "failovers": counts.get("failovers", 0),
+                           "affinity_hits": counts.get(
+                               "affinity_hits", 0),
                            "solo_mismatches": mismatches}
             print("fleet    ", fleet_stats)
         finally:

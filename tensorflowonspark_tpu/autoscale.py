@@ -146,8 +146,28 @@ def replica_view(rid, info):
         "completed": int(counts.get("requests_completed") or 0),
         "ttft_p99_s": tracing.snapshot_quantile(ttft, 0.99)
         if ttft else None,
+        # prefix warmth (PR 16): the beat-carried chain digest,
+        # summarized as summed resident depth — the signal that makes
+        # sustained-idle retirement prefer the COLDEST replica, so a
+        # scale-down doesn't destroy the fleet's hottest cache
+        "prefix_warmth": _digest_warmth(gauges.get("prefix_digest")),
+        "generated_prefix_hit_blocks": int(
+            gauges.get("generated_prefix_hit_blocks") or 0),
         "executor": (info.get("host") or {}).get("executor"),
     }
+
+
+def _digest_warmth(digest):
+    """Scalar warmth of one beat-carried prefix digest: summed chain
+    depths (blocks of resident, reusable prefix). Zero for contiguous
+    replicas' zero schema or malformed entries — cold by definition."""
+    warmth = 0
+    for entry in digest or []:
+        try:
+            warmth += max(0, int(entry[1]))
+        except (TypeError, ValueError, IndexError):
+            continue
+    return warmth
 
 
 def _load_key(view):
@@ -155,6 +175,19 @@ def _load_key(view):
     retiree should strand as little in-flight work as possible)."""
     return (view["queue_depth"] + view["slot_occupancy"],
             view["queue_wait_ewma_s"], view["replica_id"])
+
+
+def _retire_key(view):
+    """Scale-down victim ordering (PR 16): coldest cache first —
+    summed digest depth, then the generated-prefix hit tally (a
+    replica actively serving multi-turn reuse is the last thing to
+    retire) — with :func:`_load_key` breaking warmth ties, so among
+    equally cold replicas the retiree still strands the least
+    in-flight work. ``view.get`` defaults keep the key total for
+    hand-built test views."""
+    return (int(view.get("prefix_warmth") or 0),
+            int(view.get("generated_prefix_hit_blocks") or 0)) \
+        + _load_key(view)
 
 
 def decide(policy, views, state, now):
@@ -257,10 +290,11 @@ def decide(policy, views, state, now):
                 "idle inside down-cooldown ({:.1f}s < {:.1f}s)".format(
                     now - last_scale, policy.down_cooldown_s),
                 evidence=evidence)
-        victim = min(live, key=_load_key)
+        victim = min(live, key=_retire_key)
         return ScaleDecision(
             ScaleDecision.DOWN,
-            "idle (occupancy {:.0%} <= {:.0%}, empty queues)".format(
+            "idle (occupancy {:.0%} <= {:.0%}, empty queues; "
+            "retiring coldest cache)".format(
                 occupancy, policy.occupancy_low),
             replica_id=victim["replica_id"], evidence=evidence)
     return ScaleDecision(ScaleDecision.HOLD, "within SLO",

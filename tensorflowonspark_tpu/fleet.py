@@ -50,6 +50,7 @@ In-process quickstart (the shape ``cluster.serving_fleet`` wraps)::
         f.rolling_drain()                  # zero-loss weight upgrade
 """
 
+import collections
 import http.client
 import json
 import logging
@@ -59,7 +60,8 @@ import threading
 import time
 import uuid
 
-from tensorflowonspark_tpu import chaos, reservation, serving, tracing
+from tensorflowonspark_tpu import chaos, paging, reservation, serving, \
+    tracing
 
 logger = logging.getLogger(__name__)
 
@@ -138,6 +140,203 @@ def route_order(views, stale_after=DEFAULT_STALE_AFTER):
     healthy.sort()
     probing.sort()
     return [rid for _, rid in healthy] + [rid for _, rid in probing]
+
+
+# -- prefix/session affinity (PR 16; pure policy + TTL'd map) --------------
+
+#: seconds a session -> replica affinity entry stays trusted without a
+#: fresh dispatch renewing it. Long enough to span a human turn gap,
+#: short enough that an entry pointing at a replica whose cache has
+#: since churned (or that left the fleet quietly) self-heals
+DEFAULT_AFFINITY_TTL = 30.0
+
+#: the load guard: extra backlog (queued + occupied + router-inflight)
+#: a WARM replica may carry over the least-loaded routable one and
+#: still win the request. Past this, affinity loses to load — a warm
+#: replica must never become a hotspot amplifier
+DEFAULT_LOAD_GUARD = 4
+
+
+def digest_match(view, tokens):
+    """Matched prefix depth — in FULL blocks, 0 = cold — of a prompt's
+    ``tokens`` against one replica view's beat-carried prefix digest.
+    Pure: hashes the prompt's chain prefixes with the SAME
+    :func:`paging.chain_digest` the pool published with, deepest
+    first, and returns the first (deepest) resident chain. Each
+    view's own ``prefix_digest_block_size`` governs the chain
+    boundaries, so a heterogeneous fleet (mixed block sizes, or
+    contiguous replicas publishing the zero schema) matches
+    correctly per replica."""
+    digest = view.get("prefix_digest") or []
+    block_size = int(view.get("prefix_digest_block_size") or 0)
+    if not digest or block_size <= 0 or not tokens:
+        return 0
+    depths = {}
+    for entry in digest:
+        try:
+            depths[str(entry[0])] = max(depths.get(str(entry[0]), 0),
+                                        int(entry[1]))
+        except (TypeError, ValueError, IndexError):
+            continue
+    if not depths:
+        return 0
+    shareable = max(0, (len(tokens) - 1) // block_size)
+    for j in range(min(shareable, max(depths.values())), 0, -1):
+        if paging.chain_digest(tokens, j * block_size) in depths:
+            return j
+    return 0
+
+
+def affinity_plan(views, digest_matches=None, session_hint=None,
+                  stale_after=DEFAULT_STALE_AFTER,
+                  load_guard=DEFAULT_LOAD_GUARD):
+    """:func:`affinity_order` plus the bookkeeping the router's
+    counters need: ``(order, info)`` where ``info`` carries
+    ``promoted`` (warm replicas that won their preference),
+    ``guarded`` (warm replicas the load guard demoted back to their
+    load-order position), and ``hint_routable`` (whether the session's
+    remembered replica survived :func:`route_order`'s health gates at
+    all — False is the failover-COLD signal: the warm replica is dead,
+    draining, or stale, and the request must proceed cold rather than
+    error)."""
+    base = route_order(views, stale_after)
+    matches = digest_matches or {}
+    hint = str(session_hint) if session_hint is not None else None
+    info = {"promoted": [], "guarded": [],
+            "hint_routable": hint is not None and hint in base}
+    if not base:
+        return base, info
+    by_rid = {str(v.get("replica_id")): v for v in views}
+
+    def _backlog(rid):
+        v = by_rid.get(rid) or {}
+        return (int(v.get("queue_depth") or 0)
+                + int(v.get("slot_occupancy") or 0)
+                + int(v.get("inflight") or 0))
+
+    coldest = min(_backlog(rid) for rid in base)
+    warm = []
+    for pos, rid in enumerate(base):
+        depth = int(matches.get(rid) or 0)
+        is_hint = hint is not None and rid == hint
+        if not is_hint and depth <= 0:
+            continue
+        view = by_rid.get(rid) or {}
+        if view.get("state") == ReplicaHealth.PROBE:
+            # a half-open replica's warmth must not defeat the
+            # last-resort ranking its unverified recovery earned
+            continue
+        # session affinity outranks digest warmth (the session's
+        # replica holds the conversation's GENERATED chain, which a
+        # digest truncated at top-K may not show); among digest
+        # matches, deeper resident prefix = more prefill skipped
+        warm.append((0 if is_hint else 1, -depth, pos, rid))
+    warm.sort()
+    for _, _, _, rid in warm:
+        view = by_rid.get(rid) or {}
+        slots = int(view.get("slots") or 0)
+        saturated = slots > 0 \
+            and int(view.get("slot_occupancy") or 0) >= slots \
+            and int(view.get("queue_depth") or 0) > 0
+        if saturated or _backlog(rid) - coldest > load_guard:
+            # the load guard: a warm replica carrying materially more
+            # backlog than the least-loaded routable one loses the
+            # request COLD — affinity is a preference, never a
+            # hotspot amplifier
+            info["guarded"].append(rid)
+            continue
+        info["promoted"].append(rid)
+    promoted = info["promoted"]
+    order = promoted + [rid for rid in base if rid not in promoted]
+    return order, info
+
+
+def affinity_order(views, digest_matches=None, session_hint=None,
+                   stale_after=DEFAULT_STALE_AFTER,
+                   load_guard=DEFAULT_LOAD_GUARD):
+    """Pure prefix/session-aware dispatch order, composed WITH
+    :func:`route_order` (never around it — health, staleness, and
+    drain exclusions always win): warm replicas (the session's
+    remembered replica first, then digest matches by descending
+    resident depth) are promoted ahead of the load ranking, EXCEPT
+    any whose backlog exceeds the least-loaded routable replica's by
+    more than ``load_guard`` (or whose slots are saturated with a
+    standing queue) — those keep their plain load-order position.
+    Replicas excluded by :func:`route_order` never appear, however
+    warm: a dead or draining warm replica fails over cold by
+    construction."""
+    return affinity_plan(views, digest_matches, session_hint,
+                         stale_after, load_guard)[0]
+
+
+class AffinityMap(object):
+    """TTL'd, capacity-bounded ``session/prefix key -> replica_id``
+    map — the router's dispatch memory. Thread-safe (dispatch threads
+    note and look up concurrently; drain/retire purge from control
+    threads); every read of an entry validates its TTL, so a stale
+    entry is evidence-free and self-evicts rather than steering a
+    conversation at a replica whose cache has long since churned.
+    Capacity is LRU over NOTE recency: the map must stay bounded no
+    matter how many one-shot sessions pass through."""
+
+    def __init__(self, capacity=2048, ttl_s=DEFAULT_AFFINITY_TTL,
+                 now=time.monotonic):
+        self.capacity = max(1, int(capacity))
+        self.ttl_s = float(ttl_s)
+        self._now = now
+        self._lock = threading.Lock()
+        self._entries = collections.OrderedDict()  # key -> (rid, stamp)
+
+    def note(self, key, replica_id, now=None):
+        """Record (or renew) ``key``'s affinity for ``replica_id``,
+        evicting the least-recently-noted entry past capacity."""
+        if key is None:
+            return
+        now = now if now is not None else self._now()
+        with self._lock:
+            self._entries.pop(str(key), None)
+            self._entries[str(key)] = (str(replica_id), now)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
+    def lookup(self, key, now=None):
+        """``key``'s remembered replica id, or None (unknown or
+        expired — expired entries are evicted on the spot)."""
+        if key is None:
+            return None
+        now = now if now is not None else self._now()
+        with self._lock:
+            entry = self._entries.get(str(key))
+            if entry is None:
+                return None
+            rid, stamp = entry
+            if now - stamp > self.ttl_s:
+                self._entries.pop(str(key), None)
+                return None
+            return rid
+
+    def evict(self, key):
+        """Drop ``key``; True when an entry actually existed (the
+        caller's once-per-incident counter guard)."""
+        with self._lock:
+            return self._entries.pop(str(key), None) is not None
+
+    def purge_replica(self, replica_id):
+        """Drop every entry pointing at ``replica_id`` — retirement /
+        rolling drain make the replica's cache unreachable (or gone),
+        so steering sessions at it would be pure harm. Returns the
+        purge count."""
+        rid = str(replica_id)
+        with self._lock:
+            stale = [k for k, (r, _) in self._entries.items()
+                     if r == rid]
+            for key in stale:
+                self._entries.pop(key)
+            return len(stale)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
 
 
 class ReplicaHealth(object):
@@ -879,7 +1078,11 @@ class FleetRouter(object):
                  connect_timeout=DEFAULT_CONNECT_TIMEOUT,
                  base_delay=0.05, max_delay=2.0,
                  hedge_quantile=None, hedge_min_delay=0.05,
-                 hedge_min_samples=20):
+                 hedge_min_samples=20,
+                 affinity_ttl=DEFAULT_AFFINITY_TTL,
+                 affinity_capacity=2048,
+                 load_guard=DEFAULT_LOAD_GUARD,
+                 affinity_enabled=True):
         self.reservation = reservation_server
         self.name = name
         self.replicas = list(replicas or [])
@@ -907,6 +1110,20 @@ class FleetRouter(object):
             else float(hedge_quantile)
         self.hedge_min_delay = float(hedge_min_delay)
         self.hedge_min_samples = int(hedge_min_samples)
+        #: prefix/session-aware dispatch (PR 16): the TTL'd
+        #: session -> replica memory fed by dispatch history, and the
+        #: load-guard bound affinity_order enforces so a warm replica
+        #: past the backlog threshold loses to the least-loaded cold
+        #: one (the hotspot-amplifier stop)
+        self.load_guard = int(load_guard)
+        #: False = pure least-loaded routing (the honest baseline the
+        #: bench's affinity leg publishes alongside the warm numbers)
+        self.affinity_enabled = bool(affinity_enabled)
+        self.affinity = AffinityMap(capacity=affinity_capacity,
+                                    ttl_s=affinity_ttl)
+        #: reason -> count behind tfos_fleet_affinity_breaks{reason}
+        #: (written under _obs_lock like every other router tally)
+        self._affinity_breaks = {}
         self.health = ReplicaHealth(fail_threshold=fail_threshold,
                                     cooldown=cooldown,
                                     max_cooldown=max_cooldown)
@@ -983,6 +1200,17 @@ class FleetRouter(object):
                 "spec_acceptance_rate": gauges.get(
                     "spec_acceptance_rate", 0.0),
                 "kv_dtype": gauges.get("kv_dtype"),
+                # prefix-warmth signal (PR 16): the beat-carried
+                # top-K chain digest affinity_order prices, the slot
+                # count the load guard's saturation check reads, and
+                # the truncation-honesty flag (zero schema —
+                # empty/0/False — on contiguous replicas)
+                "slots": gauges.get("slots", 0),
+                "prefix_digest": gauges.get("prefix_digest") or [],
+                "prefix_digest_block_size": gauges.get(
+                    "prefix_digest_block_size", 0),
+                "digest_truncated": bool(
+                    gauges.get("digest_truncated")),
                 "inflight": inflight.get(rid, 0),
                 "state": self.health.state(rid, now),
             })
@@ -1038,13 +1266,22 @@ class FleetRouter(object):
         # retrying an AMBIGUOUS timeout (did it run before the
         # response was lost?) safe
         request_id = uuid.uuid4().hex
+        # affinity inputs (PR 16), parsed ONCE per client request: the
+        # optional session key and the (first) prompt's tokens, which
+        # every attempt's affinity_order matches against the replicas'
+        # beat-carried digests. Parse failures leave both None — an
+        # unparseable body routes load-only and the upstream answers
+        # its own 400; the router must not pre-judge it
+        session, prompt_tokens = self._affinity_inputs(raw_body) \
+            if self.affinity_enabled else (None, None)
         status = None
         try:
             try:
                 status, body, headers = serving.retry_call(
                     lambda: self._attempt_hedged(
                         raw_body, tried, upstream_spent, client_gone,
-                        trace, attempts_made, request_id),
+                        trace, attempts_made, request_id,
+                        session=session, prompt_tokens=prompt_tokens),
                     attempts=self.attempts, base_delay=self.base_delay,
                     max_delay=self.max_delay)
                 retry_after = None
@@ -1073,6 +1310,51 @@ class FleetRouter(object):
                     max(wall - upstream_spent[0], 0.0))
         return status, body, retry_after
 
+    @staticmethod
+    def _affinity_inputs(raw_body):
+        """(session, prompt_tokens) best-effort parsed from a
+        ``:generate`` body — the affinity keys. ``prompt_tokens`` is
+        the FIRST prompt of a nested body (a multi-prompt body shares
+        one dispatch, so one representative chain is what the digest
+        match can use); None for anything malformed."""
+        try:
+            parsed = json.loads(raw_body or b"{}")
+        except (ValueError, UnicodeDecodeError):
+            return None, None
+        if not isinstance(parsed, dict):
+            return None, None
+        session = parsed.get("session")
+        if not isinstance(session, str) or not session:
+            session = None
+        prompts = parsed.get("prompt")
+        tokens = None
+        if isinstance(prompts, list) and prompts:
+            first = prompts[0] if isinstance(prompts[0], (list, tuple)) \
+                else prompts
+            if first and all(isinstance(t, int)
+                             and not isinstance(t, bool)
+                             for t in first):
+                tokens = list(first)
+        return session, tokens
+
+    def _affinity_break(self, reason):
+        """Tally one affinity break (warm preference not honored) under
+        ``reason`` — the tfos_fleet_affinity_breaks{reason} series."""
+        with self._obs_lock:
+            self._affinity_breaks[reason] = \
+                self._affinity_breaks.get(reason, 0) + 1
+
+    def _affinity_failover(self, session, rid, hint):
+        """A health-relevant upstream failure on ``rid``: when it was
+        the session's WARM target, evict the map entry (the failover
+        proceeds COLD — the dedup key already makes the retry safe)
+        and count the break once per incident (evict() reports whether
+        an entry actually existed)."""
+        if session is None or hint is None or rid != hint:
+            return
+        if self.affinity.evict(session):
+            self._affinity_break("failover_cold")
+
     def _hedge_delay(self):
         """Seconds to wait before hedging, derived from the router's
         own upstream-latency histogram at ``hedge_quantile`` (floored
@@ -1090,7 +1372,8 @@ class FleetRouter(object):
         return max(float(q), self.hedge_min_delay)
 
     def _attempt_hedged(self, raw_body, tried, upstream_spent,
-                        client_gone, trace, attempts_made, request_id):
+                        client_gone, trace, attempts_made, request_id,
+                        session=None, prompt_tokens=None):
         """One retry_call step, possibly racing TWO upstream attempts:
         the primary starts immediately; if it is still running after
         :meth:`_hedge_delay`, a hedge attempt goes to a DIFFERENT
@@ -1107,10 +1390,16 @@ class FleetRouter(object):
         if hedge_delay is None:
             return self._attempt(raw_body, tried, upstream_spent,
                                  client_gone, trace, attempts_made,
-                                 request_id)
+                                 request_id, session=session,
+                                 prompt_tokens=prompt_tokens)
         cv = threading.Condition()
         outcomes = []  # (label, "ok"|"err", payload) in arrival order
         lose = threading.Event()
+        # label -> (replica_id, warm) recorded by each attempt at pick
+        # time: the race loop — not the attempts — owns the affinity
+        # map under hedging, because only it knows which attempt WON
+        # (an attempt that merely completed must not note the map)
+        picked = {}
 
         def _run(label, skip_if_no_alternative=False):
             try:
@@ -1127,7 +1416,10 @@ class FleetRouter(object):
                 out = self._attempt(raw_body, tried, upstream_spent,
                                     client_gone, trace, attempts_made,
                                     request_id, lose=lose,
-                                    hedge=skip_if_no_alternative)
+                                    hedge=skip_if_no_alternative,
+                                    session=session,
+                                    prompt_tokens=prompt_tokens,
+                                    picked=picked, label=label)
                 with cv:
                     outcomes.append((label, "ok", out))
                     cv.notify_all()
@@ -1168,6 +1460,17 @@ class FleetRouter(object):
                     with self._obs_lock:
                         self.counters.inc("hedge_wins")
                     self.flight.instant("hedge_won", trace=trace)
+                if session is not None:
+                    rid, warm = picked.get(label, (None, False))
+                    if label == "hedge" and not warm:
+                        # a COLD hedge won the race: the answer stands,
+                        # but remembering the cold replica would poison
+                        # the session's affinity — count the break and
+                        # leave the map alone (the warm entry, if any,
+                        # survives for the next turn)
+                        self._affinity_break("hedge_cold_win")
+                    elif rid is not None:
+                        self.affinity.note(session, rid)
                 return payload
             if isinstance(payload, _HedgeLost):
                 live -= 1  # hedge had nowhere to go; primary decides
@@ -1190,17 +1493,27 @@ class FleetRouter(object):
 
     def _attempt(self, raw_body, tried, upstream_spent,
                  client_gone=None, trace=0, attempts_made=None,
-                 request_id=None, lose=None, hedge=False):
-        """One dispatch attempt: pick the best untried replica, POST,
-        classify the outcome. Raises Retriable to make retry_call fail
-        over; anything else returns verbatim for the client. ``lose``
-        (hedging): an event that aborts this attempt because its rival
-        already won — the teardown path is the client-disconnect one,
-        but it is accounted as a lost hedge, not a disconnect.
-        ``hedge``: this attempt exists only to race a DIFFERENT
-        replica, so it must never take the clear-and-retry-same-replica
-        fallback — with no alternative at pick time it withdraws
-        (:class:`_HedgeLost`) and leaves the primary to decide."""
+                 request_id=None, lose=None, hedge=False,
+                 session=None, prompt_tokens=None, picked=None,
+                 label=None):
+        """One dispatch attempt: pick the best untried replica —
+        prefix/session-aware via :func:`affinity_plan` (PR 16), so the
+        session's remembered replica or the deepest digest match wins
+        unless the load guard demotes it — POST, classify the outcome.
+        Raises Retriable to make retry_call fail over; anything else
+        returns verbatim for the client. ``lose`` (hedging): an event
+        that aborts this attempt because its rival already won — the
+        teardown path is the client-disconnect one, but it is
+        accounted as a lost hedge, not a disconnect. ``hedge``: this
+        attempt exists only to race a DIFFERENT replica, so it must
+        never take the clear-and-retry-same-replica fallback — with no
+        alternative at pick time it withdraws (:class:`_HedgeLost`)
+        and leaves the primary to decide; because affinity ordering
+        applies to every pick, a hedge naturally lands on the
+        next-warmest untried alternative. ``picked``/``label``
+        (hedging): pick-time ``(replica_id, warm)`` reported back so
+        the race loop — the only place that knows which attempt WON —
+        can own the affinity-map note."""
         if client_gone is not None and client_gone():
             # vanished before we even picked: don't burn a slot.
             # Under hedging (lose is not None) the OUTER race loop
@@ -1214,9 +1527,28 @@ class FleetRouter(object):
         t_pick = time.monotonic()
         snapshot = self._snapshot()
         views = self.replica_views(now, snapshot)
+        hint = self.affinity.lookup(session) \
+            if session is not None else None
+        matches = {}
+        if prompt_tokens:
+            for view in views:
+                depth = digest_match(view, prompt_tokens)
+                if depth:
+                    matches[str(view.get("replica_id"))] = depth
+        full_order, plan = affinity_plan(
+            views, matches, hint, self.stale_after, self.load_guard)
+        if hint is not None and not plan["hint_routable"]:
+            # the session's warm replica is dead, draining, or stale:
+            # the request proceeds COLD (never an error — the colder
+            # candidates below serve it), and the map entry goes now,
+            # so the next turn doesn't re-court the corpse. evict()
+            # reports whether an entry still existed — the
+            # once-per-incident guard for the break counter.
+            if self.affinity.evict(session):
+                self._affinity_break("failover_cold")
+            hint = None
         with self._obs_lock:
-            order = [rid for rid in route_order(views, self.stale_after)
-                     if rid not in tried]
+            order = [rid for rid in full_order if rid not in tried]
             if not order and tried:
                 if hedge:
                     # the hedge's whole point is a DIFFERENT replica;
@@ -1231,7 +1563,7 @@ class FleetRouter(object):
                 # can retry one (it may have recovered — bounded by
                 # retry_call's attempt budget either way)
                 tried.clear()
-                order = route_order(views, self.stale_after)
+                order = list(full_order)
             if order:
                 tried.add(order[0])
             self.timers.add("pick", time.monotonic() - t_pick)
@@ -1241,6 +1573,20 @@ class FleetRouter(object):
             raise NoReplicaAvailable(
                 "no routable replica ({} known)".format(len(views)))
         rid = order[0]
+        warm = rid == hint or bool(matches.get(rid))
+        if picked is not None and label is not None:
+            picked[label] = (rid, warm)
+        if warm:
+            # the request landed on a replica whose cache plausibly
+            # holds its prefix (session memory or digest match) — the
+            # fleet-wide warm-TTFT signal the bench pins
+            with self._obs_lock:
+                self.counters.inc("affinity_hits")
+        elif any(g not in tried for g in plan["guarded"]):
+            # warm candidates existed but the load guard sent the
+            # request to a colder, less-loaded replica — affinity
+            # yielded to load, by design
+            self._affinity_break("load_guard")
         addr = (snapshot.get(rid) or {}).get("addr")
         if not addr:
             raise ReplicaUnavailable(
@@ -1288,6 +1634,7 @@ class FleetRouter(object):
         except (OSError, http.client.HTTPException) as e:
             self.health.note_failure(rid, time.monotonic(),
                                      reason=str(e))
+            self._affinity_failover(session, rid, hint)
             with self._obs_lock:
                 self.counters.inc("failovers")
             raise ReplicaUnavailable(
@@ -1309,6 +1656,7 @@ class FleetRouter(object):
             # hard-downs the fenced address
             self.health.note_failure(rid, time.monotonic(),
                                      reason="Fenced")
+            self._affinity_failover(session, rid, hint)
             with self._obs_lock:
                 self.counters.inc("failovers")
                 self.counters.inc("fenced_upstreams")
@@ -1321,9 +1669,13 @@ class FleetRouter(object):
                 # the one transient that is replica UNHEALTHINESS;
                 # Shed/QueueFull are load, Draining self-excludes via
                 # its beat — penalizing those would eject replicas for
-                # doing admission control correctly
+                # doing admission control correctly. Same split for
+                # affinity: only health-relevant failures evict the
+                # session's map entry — a warm replica shedding load
+                # is still the warm replica next turn
                 self.health.note_failure(rid, time.monotonic(),
                                          reason=kind)
+                self._affinity_failover(session, rid, hint)
             with self._obs_lock:
                 self.counters.inc("failovers")
             retry_after = headers.get("Retry-After")
@@ -1335,6 +1687,12 @@ class FleetRouter(object):
                 "replica {} answered {} ({})".format(rid, status, kind),
                 retry_after=0.0 if more else retry_after)
         self.health.note_success(rid)
+        if session is not None and lose is None:
+            # un-hedged attempts ARE the winner, so they note the map
+            # themselves; hedged attempts leave it to the race loop
+            # (only it knows which rival actually won — and a cold
+            # hedge win must count a break, not poison the map)
+            self.affinity.note(session, rid)
         return status, body, headers
 
     @staticmethod
@@ -1403,6 +1761,7 @@ class FleetRouter(object):
         body = {"status": "ok" if order else "unavailable",
                 "model": self.name,
                 "routable": len(order),
+                "affinity_entries": len(self.affinity),
                 "replicas": {v["replica_id"]: {
                     "state": v["state"], "age": v["age"],
                     "alive": v["alive"], "draining": v["draining"],
@@ -1414,6 +1773,11 @@ class FleetRouter(object):
                     "speculate_k": v["speculate_k"],
                     "spec_acceptance_rate": v["spec_acceptance_rate"],
                     "kv_dtype": v["kv_dtype"],
+                    # per-replica warmth at a glance: how many chains
+                    # the replica's digest publishes, and whether the
+                    # top-K bound cut any (PR 16)
+                    "prefix_digest_chains": len(v["prefix_digest"]),
+                    "digest_truncated": v["digest_truncated"],
                     "inflight": v["inflight"]} for v in views}}
         return (200 if order else 503), body
 
@@ -1427,10 +1791,21 @@ class FleetRouter(object):
         snapshot = self._snapshot()
         views = self.replica_views(now, snapshot)
         order = set(route_order(views, self.stale_after))
+        # read the map size BEFORE taking _obs_lock (the AffinityMap
+        # has its own lock; never nest the two)
+        affinity_entries = len(self.affinity)
         with self._obs_lock:
             self.counters.gauge("replicas", len(views))
             self.counters.gauge("replicas_routable", len(order))
+            self.counters.gauge("affinity_entries", affinity_entries)
+            breaks = dict(self._affinity_breaks)
         lines = []
+        if breaks:
+            lines.append("# TYPE tfos_fleet_affinity_breaks counter")
+            for reason in sorted(breaks):
+                lines.append(
+                    'tfos_fleet_affinity_breaks{{reason="{}"}} {}'
+                    .format(reason, breaks[reason]))
         for family, key in (
                 ("tfos_fleet_replica_up",
                  lambda v: 1 if v["replica_id"] in order else 0),
@@ -1565,6 +1940,10 @@ class FleetRouter(object):
             rid = replica.replica_id
             t0 = time.monotonic()
             self.quiesce(rid, "rolling drain", owner="rolling-drain")
+            # the respawned engine comes back with an EMPTY prefix
+            # cache: sessions remembered against the old incarnation
+            # would steer at cold blocks — purge them now (PR 16)
+            self.affinity.purge_replica(rid)
             clean = recovered = False
             try:
                 clean = replica.drain_engine(timeout=drain_timeout)
@@ -2155,6 +2534,10 @@ class ServingFleet(object):
         if self.router is not None:
             self.router.quiesce(rid, "retiring (scale-down)",
                                 owner="autoscale")
+            # a retired replica's cache leaves the fleet with it:
+            # purge its affinity entries so no session is steered at
+            # an identity that no longer serves (PR 16)
+            self.router.affinity.purge_replica(rid)
         clean = False
         try:
             clean = replica.drain_engine(timeout=drain_timeout)

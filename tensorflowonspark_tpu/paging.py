@@ -48,6 +48,7 @@ consistent counts, not to support concurrent mutation.
 """
 
 import collections
+import hashlib
 import threading
 
 import numpy as np
@@ -57,6 +58,31 @@ import numpy as np
 #: dtype, so an explicit table beats np.dtype here)
 _KV_ITEMSIZE = {"int8": 1, "float16": 2, "bfloat16": 2, "float32": 4,
                 "float64": 8}
+
+#: default chain budget of :meth:`BlockPool.prefix_digest` — the
+#: BOUNDED part of the fleet's prefix-warmth signal. A beat payload
+#: must stay small at any pool size, so a pool with thousands of
+#: registered chains still publishes at most this many (the hottest),
+#: with ``truncated`` flagging what was cut.
+PREFIX_DIGEST_TOP_K = 32
+
+#: hex chars of the truncated chain hash a digest entry carries: 16
+#: hex = 64 bits, so accidental collisions across a fleet's worth of
+#: resident chains are negligible while the entry stays compact
+_DIGEST_HASH_HEX = 16
+
+
+def chain_digest(tokens, n_tokens):
+    """Truncated stable hash of the EXACT chain key ``tokens[:n_tokens]``
+    — the wire form of a prefix chain in the beat-carried digest. Both
+    sides of the fleet's warmth matching use this one function (the
+    pool when publishing, the router when probing a prompt's chain
+    prefixes against a replica's digest), so the two can never drift.
+    Canonical serialization is the comma-joined decimal token ids:
+    content-addressed like the registry itself, independent of process,
+    platform, and hash seed (sha1, not ``hash()``)."""
+    key = ",".join(str(int(t)) for t in list(tokens)[:int(n_tokens)])
+    return hashlib.sha1(key.encode("ascii")).hexdigest()[:_DIGEST_HASH_HEX]
 
 
 class PoolExhausted(RuntimeError):
@@ -116,6 +142,12 @@ class BlockPool(object):
         # one — the multi-turn reuse signal load_stats surfaces
         self.generated_registered = 0
         self.generated_hits = 0
+        # per-block hit tally (PR 16): how often each REGISTERED block
+        # was found resident by a chain walk — the heat signal
+        # :meth:`prefix_digest` ranks its top-K hottest chains by.
+        # Dropped with the registration (eviction / drop_cache), so a
+        # recycled block id never inherits a prior chain's heat.
+        self._chain_hits = {}
         # mutation epoch: bumped by every state change that could alter
         # an admission verdict (alloc/release/acquire/register/
         # drop_cache). The engine's blocked-head memo keys on it — a
@@ -198,6 +230,36 @@ class BlockPool(object):
                 "generated_hits": self.generated_hits,
             }
 
+    def prefix_digest(self, top_k=PREFIX_DIGEST_TOP_K):
+        """Compact, bounded digest of the RESIDENT prefix-chain
+        registry — the per-replica warmth signal the serving beat
+        carries and the fleet router's prefix-aware dispatch matches
+        prompts against (PR 16).
+
+        ``{'block_size', 'top': [[hash, depth], ...], 'truncated'}``:
+        each entry is one registered chain as its truncated
+        :func:`chain_digest` plus its depth in FULL blocks, hottest
+        first (per-block hit tally desc, then depth desc — a deep
+        resident conversation outranks a shallow one at equal heat —
+        then the chain key itself, so the ordering is deterministic
+        for a given registry state). Generated-origin chains are
+        included exactly like prompt-origin ones: a turn-2 prompt
+        matches the chain decode just extended. At most ``top_k``
+        entries are published no matter how many chains are resident;
+        ``truncated`` says whether anything was cut — the honesty flag
+        that lets a router distinguish "cold" from "warm beyond what
+        the digest shows"."""
+        top_k = max(1, int(top_k))
+        with self._lock:
+            chains = [(self._chain_hits.get(bid, 0),
+                       len(key) // self.block_size, key)
+                      for bid, key in self._key_of.items()]
+        chains.sort(key=lambda c: (-c[0], -c[1], c[2]))
+        top = [[chain_digest(key, len(key)), depth]
+               for _, depth, key in chains[:top_k]]
+        return {"block_size": self.block_size, "top": top,
+                "truncated": len(chains) > top_k}
+
     def epoch(self):
         """Mutation counter: changes whenever alloc / release /
         acquire / register / drop_cache changed pool state. Equal
@@ -255,6 +317,8 @@ class BlockPool(object):
             ids, shareable = self._walk_locked(tokens)
             self.hits += len(ids)
             self.misses += shareable - len(ids)
+            for bid in ids:
+                self._chain_hits[bid] = self._chain_hits.get(bid, 0) + 1
             if count_generated:
                 self.generated_hits += sum(
                     1 for bid in ids
@@ -344,6 +408,7 @@ class BlockPool(object):
                 key = self._key_of.pop(bid)
                 self._by_key.pop(key)
                 self._origin.pop(bid, None)
+                self._chain_hits.pop(bid, None)
                 self._free.append(bid)
             return len(dropped)
 
@@ -384,6 +449,7 @@ class BlockPool(object):
                 key = self._key_of.pop(bid)
                 self._by_key.pop(key)
                 self._origin.pop(bid, None)
+                self._chain_hits.pop(bid, None)
                 self.evictions += 1
                 ids.append(bid)
             for bid in ids:

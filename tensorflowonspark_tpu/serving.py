@@ -224,13 +224,20 @@ class GenerationHandle(object):
     """
 
     def __init__(self, prompt, max_new_tokens, deadline=None,
-                 trace=None):
+                 trace=None, session=None):
         # constructed by DecodeEngine AFTER validate() normalized both
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
         self.deadline = deadline  # absolute monotonic, or None
         self.submitted = time.monotonic()
         self.completed = None
+        #: optional conversation identity (PR 16): an opaque client
+        #: string riding the :generate payload end to end. The engine
+        #: never interprets it — it exists so the fleet router's
+        #: session-affinity map can key on it, and so per-request
+        #: observability (flight spans, logs) can attribute work to a
+        #: conversation.
+        self.session = str(session) if session is not None else None
         #: request trace id: every span this request's lifecycle emits
         #: into the FlightRecorder lands on this timeline row. An
         #: externally minted id (the fleet router's ``X-TFOS-Trace``
@@ -947,7 +954,8 @@ class DecodeEngine(object):
                     "{} (kv_blocks)".format(need, self.kv_blocks))
         return prompt, max_new
 
-    def submit(self, prompt, max_new_tokens, deadline_s=None):
+    def submit(self, prompt, max_new_tokens, deadline_s=None,
+               session=None):
         """Queue one request; returns its :class:`GenerationHandle`.
 
         Validation happens HERE, on the caller's thread, so a malformed
@@ -959,9 +967,14 @@ class DecodeEngine(object):
         measured rates (:class:`Shed`), and an admitted request past
         its deadline is evicted at the next decode-step boundary
         (:class:`DeadlineExceeded` from ``result``/``stream``).
+
+        ``session``: opaque conversation id threaded onto the handle
+        (the fleet router's affinity key); the engine itself does not
+        interpret it.
         """
         return self._submit_many([self.validate(prompt, max_new_tokens)],
-                                 deadline_s=deadline_s)[0]
+                                 deadline_s=deadline_s,
+                                 session=session)[0]
 
     def estimate_admission(self, max_new_tokens, prompt=None):
         """{'queue_wait_s', 'service_s'} — what admitting a request of
@@ -1033,7 +1046,8 @@ class DecodeEngine(object):
         return {"queue_wait_s": wait,
                 "service_s": prefill + max_new * step}
 
-    def _submit_many(self, vetted, deadline_s=None, trace=None):
+    def _submit_many(self, vetted, deadline_s=None, trace=None,
+                     session=None):
         """Atomically queue a whole vetted body: either every request is
         admitted or none is (QueueFull / Shed / stopped / draining /
         broken raise before any handle exists), so a mid-batch refusal
@@ -1122,11 +1136,13 @@ class DecodeEngine(object):
             for prompt, max_new in vetted:
                 handle = GenerationHandle(prompt, max_new,
                                           deadline=deadline,
-                                          trace=trace)
+                                          trace=trace,
+                                          session=session)
                 self.flight.instant("admit", trace=handle.trace,
                                     prompt_len=len(prompt),
                                     max_new=max_new,
-                                    deadline_s=deadline_s)
+                                    deadline_s=deadline_s,
+                                    session=handle.session or "")
                 if max_new == 0:
                     handle._finish()
                     self._trace_finish(handle, "finish",
@@ -1211,12 +1227,29 @@ class DecodeEngine(object):
             stats["generated_prefix_hit_blocks"] = ps["generated_hits"]
             stats["generated_prefix_registered"] = \
                 ps["generated_registered"]
+            # prefix-chain digest (PR 16): the top-K hottest resident
+            # chains as [truncated hash, depth-in-blocks] pairs, the
+            # bounded warmth signal the fleet router's prefix-aware
+            # dispatch matches prompts against. Rides every beat —
+            # bounded at paging.PREFIX_DIGEST_TOP_K entries, so the
+            # lease payload stays small at any pool size;
+            # digest_truncated is the honesty flag for what was cut.
+            dig = self._pool.prefix_digest()
+            stats["prefix_digest"] = dig["top"]
+            stats["prefix_digest_block_size"] = dig["block_size"]
+            stats["digest_truncated"] = dig["truncated"]
         else:
             stats["kv_blocks_total"] = 0
             stats["kv_blocks_free"] = 0
             stats["prefix_hit_rate"] = 0.0
             stats["generated_prefix_hit_blocks"] = 0
             stats["generated_prefix_registered"] = 0
+            # contiguous engines publish the zero schema — an empty
+            # digest, never an absent key (consumers need no presence
+            # checks, matching every other load_stats field)
+            stats["prefix_digest"] = []
+            stats["prefix_digest_block_size"] = 0
+            stats["digest_truncated"] = False
         return stats
 
     def kv_cache_bytes(self):
@@ -1862,13 +1895,21 @@ class DecodeEngine(object):
             # EXPORTS the kv gauges (as zeros), so dashboards keyed on
             # the catalog rows see data, not absence
             for gauge in ("kv_blocks_total", "kv_blocks_free",
-                          "kv_blocks_cached"):
+                          "kv_blocks_cached", "prefix_digest_chains",
+                          "prefix_digest_truncated"):
                 self.counters.gauge(gauge, 0)
             return
         stats = self._pool.stats()
         self.counters.gauge("kv_blocks_total", stats["total"])
         self.counters.gauge("kv_blocks_free", stats["free"])
         self.counters.gauge("kv_blocks_cached", stats["cached"])
+        # digest exposition (PR 16): how many chains the beat-carried
+        # digest currently publishes, and whether the top-K bound cut
+        # anything (the truncation-honesty flag, scrapeable)
+        dig = self._pool.prefix_digest()
+        self.counters.gauge("prefix_digest_chains", len(dig["top"]))
+        self.counters.gauge("prefix_digest_truncated",
+                            1 if dig["truncated"] else 0)
         # roll the pool's own monotonic tallies into the counters —
         # the pool's chain walk is the ONE place hit/miss/eviction
         # semantics live (no re-derived formulas to desync)
@@ -2674,6 +2715,12 @@ class ModelServer(object):
                 raise _BadRequest("deadline_s must be a number")
             if not deadline_s > 0:
                 raise _BadRequest("deadline_s must be > 0")
+        # optional conversation identity (PR 16): an opaque string the
+        # fleet router keys its session-affinity map on; threaded onto
+        # the body's GenerationHandles, never interpreted here
+        session = payload.get("session")
+        if session is not None and not isinstance(session, str):
+            raise _BadRequest("session must be a string")
         try:
             # vet the WHOLE body before submitting any of it: a 400 must
             # not leave earlier prompts of the same body decoding for a
@@ -2685,7 +2732,7 @@ class ModelServer(object):
         # Shed as 503) with nothing queued, instead of part of the body
         # decoding for a client that got an error
         handles = engine._submit_many(vetted, deadline_s=deadline_s,
-                                      trace=trace)
+                                      trace=trace, session=session)
         try:
             tokens = [self._await_handle(h, handles, client_gone)
                       for h in handles]

@@ -318,6 +318,15 @@ METRIC_FAMILIES = {
                         "tfos_serving_prefix_hit_blocks; preemption "
                         "continuations re-hitting their own blocks "
                         "excluded)"),
+    # -- prefix-chain digest export (PR 16): BEAT-carried warmth --
+    "tfos_serving_prefix_digest_chains":
+        ("gauge", "", "resident prefix chains the engine's bounded "
+                      "top-K digest currently publishes in its BEAT "
+                      "payload (0 on a contiguous engine)"),
+    "tfos_serving_prefix_digest_truncated":
+        ("gauge", "", "1 when the registry holds more chains than the "
+                      "digest's top-K bound (the published digest is "
+                      "an honest subset), else 0"),
     # -- speculative decoding + int8 paged KV (PR 15) --
     "tfos_serving_spec_proposed":
         ("counter", "", "draft tokens proposed by speculative rounds, "
@@ -416,6 +425,24 @@ METRIC_FAMILIES = {
     "tfos_fleet_replica_inflight":
         ("gauge", "replica", "requests the router holds open against "
                              "each replica"),
+    # -- prefix-aware routing + session affinity (PR 16) --
+    "tfos_fleet_affinity_hits":
+        ("counter", "", "dispatches whose first-pick replica was WARM "
+                        "for the request (session-affinity hint or "
+                        "beat-digest prefix match promoted it over "
+                        "pure least-loaded order)"),
+    "tfos_fleet_affinity_breaks":
+        ("counter", "reason", "times affinity was deliberately NOT "
+                              "honored: load_guard (warm replica past "
+                              "the backlog guard lost to a colder "
+                              "one), failover_cold (warm replica dead/"
+                              "fenced/draining — served cold, map "
+                              "entry evicted), hedge_cold_win (a cold "
+                              "hedge beat the warm primary; map left "
+                              "unpoisoned)"),
+    "tfos_fleet_affinity_entries":
+        ("gauge", "", "live session -> replica entries in the "
+                      "router's TTL'd affinity map"),
     # -- executor-hosted serving + SLO autoscaler (PR 13) --
     "tfos_serving_replica_host":
         ("gauge", "replica_id,executor", "constant 1 joining each "

@@ -162,6 +162,38 @@ def test_decide_idle_scales_down_least_loaded():
     assert d.replica_id == "r1", "victim must be the least loaded"
 
 
+def test_decide_down_retires_coldest_cache_first():
+    """PR 16: sustained-idle retirement prefers the replica whose
+    prefix digest shows the LEAST resident cache — scale-in must not
+    destroy the fleet's hottest conversations. Load (the old key)
+    only breaks warmth ties."""
+    warm = _view(rid="r0")
+    warm["prefix_warmth"] = 7  # summed digest depths
+    cold = _view(rid="r1", qwait=0.3)  # more loaded, but cache-cold
+    cold["prefix_warmth"] = 0
+    d = decide(_policy(), [warm, cold], {}, now=100.0)
+    assert d.action == ScaleDecision.DOWN
+    assert d.replica_id == "r1", "victim must be the coldest cache"
+    assert "coldest" in d.reason
+
+
+def test_decide_down_warmth_tie_breaks_by_generated_hits_then_load():
+    """Equal digest warmth: a replica actively serving multi-turn
+    reuse (generated-prefix hits) is retired LAST; with both warmth
+    signals tied, the least-loaded replica goes (the original key)."""
+    a = _view(rid="r0", qwait=0.1)
+    a["prefix_warmth"] = 3
+    a["generated_prefix_hit_blocks"] = 5
+    b = _view(rid="r1", qwait=0.2)
+    b["prefix_warmth"] = 3
+    b["generated_prefix_hit_blocks"] = 0
+    d = decide(_policy(), [a, b], {}, now=100.0)
+    assert d.replica_id == "r1"
+    b["generated_prefix_hit_blocks"] = 5
+    d = decide(_policy(), [a, b], {}, now=100.0)
+    assert d.replica_id == "r0", "all-warmth tie falls back to load"
+
+
 def test_decide_down_clamped_at_min():
     d = decide(_policy(), [_view()], {}, now=100.0)
     assert d.action == ScaleDecision.HOLD
@@ -246,7 +278,10 @@ def test_replica_view_extracts_gauges_ttft_and_host():
     info = {"age": 0.2, "addr": ["127.0.0.1", 1], "epoch": 2,
             "serving": {"alive": True, "draining": False,
                         "queue_depth": 4, "slot_occupancy": 2,
-                        "slots": 8, "queue_wait_ewma_s": 0.125},
+                        "slots": 8, "queue_wait_ewma_s": 0.125,
+                        "prefix_digest": [["ab12", 2], ["cd34", 3],
+                                          ["bad"], None],
+                        "generated_prefix_hit_blocks": 4},
             "metrics": {"counters": {"tfos_serving": {
                 "counts": {"requests_completed": 7}}},
                 "hists": {"tfos_serving_ttft_seconds":
@@ -258,6 +293,9 @@ def test_replica_view_extracts_gauges_ttft_and_host():
     assert view["completed"] == 7
     assert view["executor"] == 3
     assert view["ttft_p99_s"] == pytest.approx(hist.quantile(0.99))
+    # digest warmth (PR 16): summed depths, malformed entries skipped
+    assert view["prefix_warmth"] == 5
+    assert view["generated_prefix_hit_blocks"] == 4
 
 
 def test_replica_view_no_lease_reads_dead():

@@ -35,7 +35,7 @@ import numpy as np
 import pytest
 
 from tensorflowonspark_tpu import (chaos, cluster, fleet, generation,
-                                   reservation, serving)
+                                   paging, reservation, serving)
 from tensorflowonspark_tpu.models.decoder import DecoderLM
 
 V, H, NH, L, MAXLEN = 17, 32, 4, 2, 48
@@ -252,6 +252,142 @@ def test_route_order_probe_ranks_after_every_healthy():
 def test_route_order_empty_when_nothing_routable():
     assert fleet.route_order([_view("a", age=99.0)]) == []
     assert fleet.route_order([]) == []
+
+
+# -- prefix/session affinity (PR 16; pure policy) --------------------------
+
+
+def _digest_view(rid, chains=(), block_size=16, slots=0, **kw):
+    """A replica view carrying a beat digest: ``chains`` is a list of
+    (tokens, depth_blocks) pairs hashed the way the pool publishes."""
+    v = _view(rid, **kw)
+    v["slots"] = slots
+    v["prefix_digest_block_size"] = block_size
+    v["prefix_digest"] = [
+        [paging.chain_digest(tokens, depth * block_size), depth]
+        for tokens, depth in chains]
+    v["digest_truncated"] = False
+    return v
+
+
+def test_digest_match_deepest_resident_chain():
+    prompt = list(range(50))
+    view = _digest_view("a", chains=[(prompt, 1), (prompt, 2)])
+    # the deepest RESIDENT chain wins, capped by the prompt's own
+    # shareable depth ((len-1)//block — a tail token never shares)
+    assert fleet.digest_match(view, prompt) == 2
+    assert fleet.digest_match(view, prompt[:17]) == 1
+    assert fleet.digest_match(view, prompt[:16]) == 0  # all tail
+    assert fleet.digest_match(view, [9] * 50) == 0     # different chain
+    # zero schema (contiguous replica) and malformed entries are cold
+    assert fleet.digest_match(_view("b"), prompt) == 0
+    broken = _digest_view("c", chains=[(prompt, 1)])
+    broken["prefix_digest"] = [["x"], None, ["h", "deep"]]
+    assert fleet.digest_match(broken, prompt) == 0
+
+
+def test_digest_match_respects_each_views_block_size():
+    """Depth is counted in each view's OWN block size: the same
+    resident token span reads as depth 2 on an 8-block replica and
+    depth 1 on a 16-block one, and a prompt too short to fill a
+    view's chain misses it entirely."""
+    prompt = list(range(33))
+    v8 = _digest_view("a", chains=[(prompt, 2)], block_size=8)
+    v16 = _digest_view("b", chains=[(prompt, 2)], block_size=16)
+    assert fleet.digest_match(v8, prompt) == 2
+    assert fleet.digest_match(v16, prompt) == 2
+    # 17 tokens share 2 full 8-blocks -> the SAME 16-token span the
+    # 8-block replica registered; the 16-block replica's resident
+    # chain is 32 tokens deep, which this prompt cannot reach
+    assert fleet.digest_match(v8, prompt[:17]) == 2
+    assert fleet.digest_match(v16, prompt[:17]) == 0
+
+
+def test_affinity_order_promotes_hint_then_deepest_digest():
+    prompt = list(range(40))
+    views = [_view("a"),
+             _digest_view("b", chains=[(prompt, 2)], queue_depth=1),
+             _digest_view("c", chains=[(prompt, 1)], queue_depth=2)]
+    matches = {rid: fleet.digest_match(v, prompt)
+               for rid, v in (("b", views[1]), ("c", views[2]))}
+    # digest only: deeper resident chain leads, cold least-loaded next
+    assert fleet.affinity_order(views, matches) == ["b", "c", "a"]
+    # a session hint outranks even a deeper digest match elsewhere
+    assert fleet.affinity_order(views, matches, session_hint="c") == \
+        ["c", "b", "a"]
+    # no affinity inputs -> exactly route_order
+    assert fleet.affinity_order(views) == fleet.route_order(views)
+
+
+def test_affinity_load_guard_demotes_overloaded_warm_replica():
+    prompt = list(range(40))
+    warm = _digest_view("warm", chains=[(prompt, 2)], queue_depth=3,
+                        slot_occupancy=2)  # backlog 5 over coldest 0
+    views = [_view("cold"), warm]
+    matches = {"warm": 2}
+    order, info = fleet.affinity_plan(views, matches)
+    assert order == ["cold", "warm"]
+    assert info["guarded"] == ["warm"] and info["promoted"] == []
+    # inside the guard the warm replica still wins
+    warm2 = _digest_view("warm", chains=[(prompt, 2)], queue_depth=2,
+                         slot_occupancy=2)
+    order, info = fleet.affinity_plan([_view("cold"), warm2], matches)
+    assert order == ["warm", "cold"] and info["promoted"] == ["warm"]
+    # slot saturation with a standing queue guards regardless of the
+    # backlog delta (queue growth on a full replica is the hotspot)
+    sat = _digest_view("warm", chains=[(prompt, 2)], slots=2,
+                       slot_occupancy=2, queue_depth=1)
+    order, info = fleet.affinity_plan(
+        [_view("cold", queue_depth=2), sat], matches)
+    assert info["guarded"] == ["warm"]
+    assert order == fleet.route_order([_view("cold", queue_depth=2),
+                                       sat])
+
+
+def test_affinity_never_promotes_probe_and_fails_over_cold():
+    prompt = list(range(40))
+    probe = _digest_view("probe", chains=[(prompt, 3)],
+                         state=fleet.ReplicaHealth.PROBE)
+    views = [_view("cold", queue_depth=5), probe]
+    # an unverified half-open replica keeps its last-resort rank,
+    # however warm its digest claims it is
+    assert fleet.affinity_order(views, {"probe": 3},
+                                session_hint="probe") == \
+        ["cold", "probe"]
+    # a draining/dead/stale warm replica is not in the base order at
+    # all: the request proceeds COLD and the plan says why
+    gone = _digest_view("gone", chains=[(prompt, 3)], draining=True)
+    order, info = fleet.affinity_plan([_view("cold"), gone],
+                                      {"gone": 3}, session_hint="gone")
+    assert order == ["cold"]
+    assert info["hint_routable"] is False
+
+
+def test_affinity_map_ttl_capacity_and_purge():
+    clock = [100.0]
+    m = fleet.AffinityMap(capacity=2, ttl_s=5.0, now=lambda: clock[0])
+    m.note("s1", "replica-0")
+    assert m.lookup("s1") == "replica-0"
+    # TTL: an expired entry is evidence-free and self-evicts on read
+    clock[0] += 5.1
+    assert m.lookup("s1") is None and len(m) == 0
+    # capacity is LRU over note recency
+    m.note("a", "r0")
+    m.note("b", "r1")
+    m.note("a", "r0")  # renew: b is now the least recently noted
+    m.note("c", "r2")
+    assert m.lookup("b") is None
+    assert m.lookup("a") == "r0" and m.lookup("c") == "r2"
+    # evict reports whether an entry existed (once-per-incident guard)
+    assert m.evict("a") is True
+    assert m.evict("a") is False
+    # purge_replica drops every session pinned to a retiring replica
+    m2 = fleet.AffinityMap(capacity=8, ttl_s=5.0, now=lambda: clock[0])
+    m2.note("x", "r9")
+    m2.note("y", "r9")
+    m2.note("z", "r2")
+    assert m2.purge_replica("r9") == 2
+    assert m2.lookup("x") is None and m2.lookup("z") == "r2"
 
 
 # -- ReplicaHealth (half-open state machine, injected time) ----------------
@@ -1147,3 +1283,105 @@ def test_failover_request_yields_one_stitched_cross_replica_trace(
         assert len(upstreams) == 2
         assert {u["args"]["replica"] for u in upstreams} == \
             {"replica-0", "replica-1"}
+
+
+# -- prefix/session affinity (PR 16; e2e + chaos) --------------------------
+
+
+def test_session_affinity_sticky_routing_and_schema(lm):
+    """A conversation carrying a ``session`` id sticks to the replica
+    that served its first turn (the dispatch-history side of the
+    affinity map — no digest needed), turn-2 stays bitwise-solo, and
+    the new observability schema renders: affinity counters on the
+    router, digest gauges per replica."""
+    dec, params = lm
+    with fleet.ServingFleet(dec, params, replicas=2, name="lm",
+                            engine_kw={"slots": 2},
+                            beat_interval=0.05) as f:
+        url = f.url("/v1/models/lm:generate")
+        p1 = list(range(1, 14))
+        status, body = _post(url, {"prompt": p1, "max_new_tokens": 8,
+                                   "session": "conv-1"})
+        assert status == 200
+        t1 = body["tokens"]
+        rid = f.router.affinity.lookup("conv-1")
+        assert rid in ("replica-0", "replica-1")
+        # turn 2: continuation of turn 1 under the same session id
+        p2 = t1 + [3]
+        want = _solo(dec, params, p2, 6)
+        for _ in range(3):
+            status, body = _post(url, {"prompt": p2,
+                                       "max_new_tokens": 6,
+                                       "session": "conv-1"})
+            assert status == 200 and body["tokens"] == want
+            assert f.router.affinity.lookup("conv-1") == rid
+        counts = f.router.counters.snapshot()["counts"]
+        assert counts.get("affinity_hits", 0) >= 3
+        # a sessionless request neither reads nor grows the map
+        status, _ = _post(url, {"prompt": [5, 6], "max_new_tokens": 2})
+        assert status == 200 and len(f.router.affinity) == 1
+        status, body = _get(f.url("/healthz"))
+        health = json.loads(body)
+        assert health["affinity_entries"] == 1
+        assert all("prefix_digest_chains" in v
+                   for v in health["replicas"].values())
+        _, text = _get(f.url("/metrics"))
+        assert "tfos_fleet_affinity_entries 1" in text
+        assert "tfos_serving_prefix_digest_chains" in text
+        # session type errors are the replica's 400, not a router crash
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(url, {"prompt": [1, 2], "max_new_tokens": 2,
+                        "session": 7})
+        assert err.value.code == 400
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_affinity_kill_warm_replica_fails_over_cold(lm):
+    """The PR 16 failover contract, end to end: a conversation's warm
+    replica is killed mid-session; the next turn completes 200 served
+    COLD with bitwise solo-identical tokens at temp=0, zero duplicate
+    completions, and the affinity map entry for the dead replica is
+    evicted (counted as ``affinity_breaks{failover_cold}``) before
+    the session rebinds to its new home."""
+    dec, params = lm
+    with fleet.ServingFleet(dec, params, replicas=3, name="lm",
+                            engine_kw={"slots": 2},
+                            beat_interval=0.05) as f:
+        f.supervise()
+        url = f.url("/v1/models/lm:generate")
+        # warm the shared decode programs (sessionless: no map entry)
+        _post(url, {"prompt": [1, 2, 3], "max_new_tokens": 2})
+        p1 = list(range(1, 14))
+        status, body = _post(url, {"prompt": p1, "max_new_tokens": 8,
+                                   "session": "conv"})
+        assert status == 200
+        t1 = body["tokens"]
+        warm_rid = f.router.affinity.lookup("conv")
+        assert warm_rid is not None
+        # kill the WARM replica's scheduler on its next decode steps
+        chaos.arm("kill_scheduler_at_step=3,only={}".format(warm_rid))
+        p2 = t1 + [3]
+        status, body = _post(url, {"prompt": p2, "max_new_tokens": 16,
+                                   "session": "conv"}, timeout=180)
+        assert status == 200
+        assert body["tokens"] == _solo(dec, params, p2, 16)
+        # served COLD: the session moved off the dead replica, through
+        # an explicit eviction (failover_cold), then rebound
+        new_rid = f.router.affinity.lookup("conv")
+        assert new_rid is not None and new_rid != warm_rid
+        with f.router._obs_lock:
+            breaks = dict(f.router._affinity_breaks)
+        assert breaks.get("failover_cold", 0) >= 1
+        # zero duplicate completions: every client request completed
+        # exactly once across the whole fleet (the dead replica's
+        # aborted attempt never produced a second completion)
+        total = sum(r.engine.counters.snapshot()["counts"]
+                    .get("requests_completed", 0) for r in f.replicas)
+        assert total == 3
+        # the killed replica recovers under supervision and can be
+        # routed again — affinity healing is just future dispatches
+        assert chaos.poll_until(
+            lambda: warm_rid in fleet.route_order(
+                f.router.replica_views(), f.router.stale_after),
+            timeout=60), "killed replica never readmitted"

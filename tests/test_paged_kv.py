@@ -626,3 +626,92 @@ def test_leak_churn_cancel_disconnect_evict_drain(lm):
         assert stats["cached"] == 0 and stats["free"] == 12
     finally:
         eng.stop()
+
+
+# -- prefix-chain digest export (PR 16) ---------------------------------
+
+
+def test_prefix_digest_deterministic_and_hit_ranked():
+    """The digest is a pure function of registry state: same chains +
+    same tallies -> identical output, every full-block boundary is its
+    own matchable entry, and observed heat reorders the top."""
+    pool = paging.BlockPool(8, 4)
+    prompt = list(range(12))
+    ids = pool.alloc(3)
+    pool.register(prompt, 4, ids[0])
+    pool.register(prompt, 8, ids[1])
+    pool.register(prompt, 12, ids[2])
+    d1 = pool.prefix_digest()
+    assert d1 == pool.prefix_digest()  # deterministic
+    assert d1["block_size"] == 4 and d1["truncated"] is False
+    # one entry per registered boundary, hash = chain_digest of the
+    # chain's token prefix (what the router recomputes from a prompt)
+    assert sorted(e[1] for e in d1["top"]) == [1, 2, 3]
+    by_depth = {depth: h for h, depth in d1["top"]}
+    for depth in (1, 2, 3):
+        assert by_depth[depth] == paging.chain_digest(prompt, 4 * depth)
+    # equal heat: deeper chains lead
+    assert [e[1] for e in d1["top"]] == [3, 2, 1]
+    # a DIFFERENT hot chain outranks the deep cold one once hit
+    other = [90 + i for i in range(4)]
+    oid = pool.alloc(1)
+    pool.register(other, 4, oid[0])
+    for _ in range(3):
+        assert pool.match_prefix(other + [7]) == oid
+    top = pool.prefix_digest()["top"]
+    assert top[0] == [paging.chain_digest(other, 4), 1]
+
+
+def test_prefix_digest_top_k_truncation_honest():
+    """A 1000-chain registry publishes exactly top-K entries with the
+    ``truncated`` flag raised — the bound is enforced AND admitted."""
+    pool = paging.BlockPool(1001, 2)
+    for i in range(1000):
+        bid = pool.alloc(1)
+        pool.register([i, 0], 2, bid[0])
+    d = pool.prefix_digest()
+    assert len(d["top"]) == paging.PREFIX_DIGEST_TOP_K
+    assert d["truncated"] is True
+    small = pool.prefix_digest(top_k=5)
+    assert len(small["top"]) == 5 and small["truncated"] is True
+
+
+def test_prefix_digest_zero_schema_contiguous_engine(lm):
+    """A contiguous (kv_block_size=0) engine's load_stats carries the
+    zero digest schema — same keys, empty content — so a router can
+    treat paged and contiguous replicas uniformly."""
+    dec, params = lm
+    with serving.DecodeEngine(dec, params, slots=1,
+                              kv_block_size=0) as eng:
+        stats = eng.load_stats()
+        assert stats["prefix_digest"] == []
+        assert stats["prefix_digest_block_size"] == 0
+        assert stats["digest_truncated"] is False
+        gauges = eng.counters.snapshot()["gauges"]
+        assert gauges["prefix_digest_chains"] == 0
+        assert gauges["prefix_digest_truncated"] == 0
+
+
+def test_prefix_digest_includes_generated_chains(lm):
+    """A decode-boundary registration (PR 11 generated-origin chain)
+    appears in the digest exactly like a prompt chain: the turn-2
+    prompt's chain hash is publishable the moment decode crosses the
+    block boundary."""
+    dec, params = lm
+    rng = np.random.RandomState(29)
+    p1 = rng.randint(0, V, size=11).tolist()
+    with serving.DecodeEngine(dec, params, slots=2,
+                              kv_block_size=8) as eng:
+        t1 = eng.submit(p1, 13).result(300)  # 24 tokens, 23 written
+        assert eng._pool.stats()["generated_registered"] == 1
+        stats = eng.load_stats()
+        assert stats["prefix_digest_block_size"] == 8
+        hashes = {e[0] for e in stats["prefix_digest"]}
+        # the depth-2 chain ends inside GENERATED content (block 8..16
+        # was filled by decode) yet its hash is derived the same way
+        assert paging.chain_digest(t1, 16) in hashes
+        assert paging.chain_digest(t1, 8) in hashes
+        assert stats["digest_truncated"] is False
+        gauges = eng.counters.snapshot()["gauges"]
+        assert gauges["prefix_digest_chains"] == len(
+            stats["prefix_digest"])

@@ -1303,6 +1303,211 @@ def _affinity_leg(slots=4, n_replicas=4, sessions=16,
     return out
 
 
+def _disagg_leg(slots=4, n_prefill=1, n_decode=2, bombers=6,
+                chat_sessions=8, chat_turns=4, chat_new=16,
+                long_len=224, chat_len=12, block_size=16,
+                kv_blocks=256):
+    """serving_fleet.disagg (PR 17): prefill/decode disaggregation
+    under prompt bombardment, against co-located serving of the SAME
+    total width on the SAME workload.
+
+    The workload is the disaggregation motivation in miniature: a
+    steady chat plane (short prompts, ``chat_new`` decode steps each —
+    the latency-sensitive stream) while ``bombers`` threads hammer the
+    fleet with FRESH long prompts (never repeated, so every one is a
+    cold prefill somewhere). Co-located, each long prefill runs on the
+    scheduler thread of whatever mixed replica catches it, stalling
+    every in-flight chat stream there for the whole prefill; split,
+    the prefill tier absorbs the long prompts and ships the filled
+    int8 KV blocks to the decode tier, whose own prefill collapses to
+    a block-table splice hit — chat decode never waits behind a
+    stranger's prompt.
+
+    Published pins: chat per-token p99 (request wall / tokens
+    generated — the decode-interactivity proxy; wall includes the
+    chat's own short prefill in BOTH configs) disaggregated vs
+    co-located, the same comparison at a doubled prefill tier (TTFT
+    scaling with prefill width, read off the long-prompt walls), and
+    the shipped-bytes accounting: physical int8 wire bytes (codes +
+    per-head scales, via the very pack path the ship moves) against
+    the same blocks packed from an fp pool — the PR 15 economics,
+    measured end to end rather than asserted."""
+    import concurrent.futures
+    import json as json_mod
+    import math
+    import threading
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu import fleet as fleet_mod
+    from tensorflowonspark_tpu import frames, serving
+
+    train, dec = _serving_model(True)
+    params = train.init(jax.random.PRNGKey(0),
+                        np.zeros((1, dec.max_len), np.int32))["params"]
+    engine_kw = {"slots": slots, "kv_block_size": block_size,
+                 "kv_blocks": kv_blocks, "kv_dtype": "int8"}
+    rs = np.random.RandomState(17)
+    chats = [[int(t) for t in rs.randint(1, dec.vocab, chat_len)]
+             for _ in range(chat_sessions)]
+    warm_longs = [[int(t) for t in rs.randint(1, dec.vocab, long_len)]
+                  for _ in range(2)]
+    # prewarm through one throwaway engine with the SAME pool config:
+    # every prefill bucket both fleets will hit (chat + long), so
+    # compile time cancels out of the comparison
+    with serving.DecodeEngine(dec, params, **engine_kw) as warm_eng:
+        warm_eng.submit(chats[0], chat_new).result(600)
+        warm_eng.submit(warm_longs[0], 4).result(600)
+
+    def pctl(walls, q):
+        if not walls:
+            return None
+        walls = sorted(walls)
+        return walls[min(len(walls) - 1,
+                         int(math.ceil(q * len(walls))) - 1)]
+
+    def run(tiers):
+        fleet_kw = dict(engine_kw=dict(engine_kw), name="model")
+        if tiers:
+            fleet_kw["tiers"] = dict(tiers)
+        else:
+            fleet_kw["replicas"] = n_prefill + n_decode
+        with fleet_mod.ServingFleet(dec, params, **fleet_kw) as f:
+            url = f.url("/v1/models/model:generate")
+
+            def call(prompt, max_new, session=None):
+                payload = {"prompt": prompt, "max_new_tokens": max_new}
+                if session is not None:
+                    payload["session"] = session
+                req = urllib.request.Request(
+                    url, data=json_mod.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"})
+                t0 = time.monotonic()
+                with urllib.request.urlopen(req, timeout=600) as r:
+                    r.read()
+                return time.monotonic() - t0
+
+            stop = threading.Event()
+            long_walls = []
+            walls_lock = threading.Lock()
+
+            def bombard(i):
+                # FRESH prompts per iteration: every long prefill is
+                # cold somewhere, the sustained pressure the split is
+                # for (a repeating prompt set would warm every cache
+                # and measure nothing after the first lap)
+                brs = np.random.RandomState(100 + i)
+                while not stop.is_set():
+                    prompt = [int(t) for t in
+                              brs.randint(1, dec.vocab, long_len)]
+                    try:
+                        w = call(prompt, 4)
+                    except Exception:  # noqa: BLE001 - teardown race
+                        break
+                    with walls_lock:
+                        long_walls.append(w)
+
+            threads = [threading.Thread(target=bombard, args=(i,),
+                                        daemon=True)
+                       for i in range(bombers)]
+            for t in threads:
+                t.start()
+            time.sleep(0.5)  # bombardment reaches steady state
+
+            def chat_plane(i):
+                walls = []
+                for _ in range(chat_turns):
+                    walls.append(call(chats[i], chat_new,
+                                      session="chat{}".format(i)))
+                return walls
+
+            with concurrent.futures.ThreadPoolExecutor(
+                    chat_sessions) as pool:
+                per_turn = list(pool.map(chat_plane,
+                                         range(chat_sessions)))
+            stop.set()
+            for t in threads:
+                t.join(timeout=600)
+            chat_walls = [w for walls in per_turn for w in walls]
+            per_token = [w / chat_new for w in chat_walls]
+            counts = f.router.counters.snapshot()["counts"]
+            shipped_bytes = shipped_blocks = spliced_blocks = 0
+            for r in f.replicas:
+                kv = r.server.engine.kv_counters.snapshot()["counts"]
+                shipped_bytes += kv.get("ship_bytes", 0)
+                shipped_blocks += kv.get("ship_blocks", 0)
+                spliced_blocks += kv.get("spliced_blocks", 0)
+            return {
+                "chat_per_token_p50_ms":
+                    round(pctl(per_token, 0.5) * 1e3, 2),
+                "chat_per_token_p99_ms":
+                    round(pctl(per_token, 0.99) * 1e3, 2),
+                "long_prompt_p50_ms":
+                    round(pctl(long_walls, 0.5) * 1e3, 1)
+                    if long_walls else None,
+                "long_prompts_served": len(long_walls),
+                "prefill_dispatches":
+                    counts.get("prefill_dispatches", 0),
+                "prefill_ships": counts.get("prefill_ships", 0),
+                "shipped_bytes": shipped_bytes,
+                "shipped_blocks": shipped_blocks,
+                "spliced_blocks": spliced_blocks,
+            }
+
+    colocated = run(None)
+    disagg = run({"prefill": n_prefill, "decode": n_decode})
+    wide = run({"prefill": 2 * n_prefill, "decode": n_decode})
+
+    # shipped-bytes accounting, through the very pack path the ship
+    # moves: the same prompt's resident blocks from an int8 pool vs an
+    # fp pool of identical geometry. Physical wire bytes (codes +
+    # per-head scales + frame header) — never the logical dequantized
+    # size (that's the satellite-1 accounting bug this PR fixes).
+    probe = warm_longs[1]
+    wire = {}
+    for dtype in ("int8", None):
+        kw = dict(engine_kw, kv_dtype=dtype, slots=2, kv_blocks=64)
+        with serving.DecodeEngine(dec, params, **kw) as eng:
+            eng.submit(probe, 1).result(600)
+            exported = eng.export_prefix(probe)
+            assert exported is not None
+            buffers, meta = exported
+            wire[dtype or "fp"] = {
+                "bytes": frames.frame_bytes(buffers),
+                "blocks": len(meta["origins"]),
+            }
+    per_block_int8 = wire["int8"]["bytes"] / wire["int8"]["blocks"]
+    per_block_fp = wire["fp"]["bytes"] / wire["fp"]["blocks"]
+    out = {
+        "replicas_total": n_prefill + n_decode,
+        "tiers": {"prefill": n_prefill, "decode": n_decode},
+        "workload": {"bombers": bombers, "long_len": long_len,
+                     "chat_sessions": chat_sessions,
+                     "chat_turns": chat_turns, "chat_len": chat_len,
+                     "chat_new": chat_new},
+        "colocated": colocated,
+        "disaggregated": disagg,
+        "prefill_x2": wide,
+        "ship_wire": {
+            "int8_bytes_per_block": round(per_block_int8, 1),
+            "fp_bytes_per_block": round(per_block_fp, 1),
+            "int8_vs_fp_pool": round(per_block_int8 / per_block_fp, 4),
+        },
+    }
+    if colocated["chat_per_token_p99_ms"] \
+            and disagg["chat_per_token_p99_ms"]:
+        out["chat_p99_speedup"] = round(
+            colocated["chat_per_token_p99_ms"]
+            / disagg["chat_per_token_p99_ms"], 2)
+    if disagg["long_prompt_p50_ms"] and wide["long_prompt_p50_ms"]:
+        out["long_p50_prefill_x2_speedup"] = round(
+            disagg["long_prompt_p50_ms"]
+            / wide["long_prompt_p50_ms"], 2)
+    return out
+
+
 def _serving_fleet_bench(on_tpu, replica_counts=(1, 2, 4)):
     """Aggregate serving throughput at 1 vs 2 vs 4 router-fronted
     replicas on the shared mixed-length workload. Returns the
@@ -1367,6 +1572,17 @@ def _serving_fleet_bench(on_tpu, replica_counts=(1, 2, 4)):
             print("serving_fleet.affinity failed: {}".format(e),
                   file=sys.stderr)
             block["affinity"] = {"error": str(e)}
+    # prefill/decode disaggregation leg (PR 17): chat per-token p99
+    # under prompt bombardment vs co-located, TTFT scaling with
+    # prefill-tier width, and the int8 ship-wire byte accounting.
+    # TFOS_BENCH_DISAGG=0 skips just this leg.
+    if os.environ.get("TFOS_BENCH_DISAGG", "1") == "1":
+        try:
+            block["disagg"] = _disagg_leg()
+        except Exception as e:  # noqa: BLE001 - report, not die
+            print("serving_fleet.disagg failed: {}".format(e),
+                  file=sys.stderr)
+            block["disagg"] = {"error": str(e)}
     return block
 
 

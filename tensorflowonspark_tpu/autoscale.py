@@ -105,20 +105,26 @@ class AutoscalePolicy(object):
 
 class ScaleDecision(object):
     """One evaluated decision: ``action`` (hold/up/down/replace),
-    the human reason, the replica it targets (down/replace), and the
-    evidence views it priced."""
+    the human reason, the replica it targets (down/replace), the
+    evidence views it priced, and — for tiered fleets (PR 17) — the
+    tier the decision sizes (None on homogeneous fleets; a spawn
+    applied from a tiered decision carries it to
+    ``ServingFleet.spawn_replica(tier=...)``)."""
 
     HOLD, UP, DOWN, REPLACE = "hold", "up", "down", "replace"
 
-    def __init__(self, action, reason, replica_id=None, evidence=None):
+    def __init__(self, action, reason, replica_id=None, evidence=None,
+                 tier=None):
         self.action = action
         self.reason = reason
         self.replica_id = replica_id
         self.evidence = evidence or {}
+        self.tier = tier
 
     def __repr__(self):
-        return "ScaleDecision({}, {!r}, replica={})".format(
-            self.action, self.reason, self.replica_id)
+        return "ScaleDecision({}, {!r}, replica={}{})".format(
+            self.action, self.reason, self.replica_id,
+            ", tier={}".format(self.tier) if self.tier else "")
 
 
 def replica_view(rid, info):
@@ -154,6 +160,10 @@ def replica_view(rid, info):
         "generated_prefix_hit_blocks": int(
             gauges.get("generated_prefix_hit_blocks") or 0),
         "executor": (info.get("host") or {}).get("executor"),
+        # disaggregation tier (PR 17): partitions decide() into
+        # independent per-tier sizing pools ("mixed" — every pre-tier
+        # replica — keeps the fleet one pool)
+        "tier": str(gauges.get("tier") or "mixed"),
     }
 
 
@@ -190,17 +200,33 @@ def _retire_key(view):
         + _load_key(view)
 
 
+def _state_key(base, tier):
+    """Cooldown-stamp key: per-tier sub-state (``last_up:prefill``)
+    for tiered pools, the legacy flat key for homogeneous fleets —
+    each tier's hysteresis runs independently (a prefill burst must
+    not block a decode scale-down, and vice versa)."""
+    return base if tier is None else "{}:{}".format(base, tier)
+
+
 def decide(policy, views, state, now):
     """PURE scaling decision: per-replica ``views`` (see
     :func:`replica_view`), controller ``state`` ({"last_up",
-    "last_down"} monotonic stamps or None), injected ``now`` ->
-    :class:`ScaleDecision`. Never mutates ``state`` — the controller
-    stamps it only when an action actually applies.
+    "last_down"} monotonic stamps or None, plus per-tier
+    ``last_up:<tier>`` sub-keys on tiered fleets), injected ``now``
+    -> :class:`ScaleDecision`. Never mutates ``state`` — the
+    controller stamps it only when an action actually applies.
 
     Rule order: replacement (repair) outranks scaling; breaches
     outrank idleness; every scale respects the clamps, its cooldown,
-    and the no-evidence gate."""
+    and the no-evidence gate. Tiered fleets (PR 17) are sized PER
+    TIER: each tier is its own pool with its own cooldown sub-state
+    and its own min/max clamp (the policy's bounds apply to each tier
+    independently — a saturated prefill tier scales on its backlog
+    while an idle decode tier shrinks on its slots, in the same
+    poll cycle's priority order: any UP beats any DOWN)."""
     # -- repair: a dead member is replaced, cooldowns notwithstanding
+    # (tier-blind — a corpse is repaired whatever it served; the
+    # fleet's spawn path re-derives its tier from the identity)
     for view in views:
         if view["draining"]:
             continue
@@ -212,14 +238,43 @@ def decide(policy, views, state, now):
                 "lease expired (age {})".format(view["age"])
                 if lease_dead else "engine dead under a live lease",
                 replica_id=view["replica_id"],
-                evidence={"views": views})
+                evidence={"views": views},
+                tier=view.get("tier"))
+    tiers = sorted({str(v.get("tier") or "mixed") for v in views})
+    if len(tiers) <= 1:
+        return _decide_pool(policy, views, state, now)
+    decisions = [
+        _decide_pool(policy,
+                     [v for v in views
+                      if str(v.get("tier") or "mixed") == tier],
+                     state, now, tier=tier)
+        for tier in tiers]
+    for decision in decisions:
+        if decision.action == ScaleDecision.UP:
+            return decision
+    for decision in decisions:
+        if decision.action != ScaleDecision.HOLD:
+            return decision
+    return ScaleDecision(
+        ScaleDecision.HOLD,
+        "; ".join("{}: {}".format(d.tier, d.reason)
+                  for d in decisions),
+        evidence={"tiers": {d.tier: d.evidence for d in decisions}})
+
+
+def _decide_pool(policy, views, state, now, tier=None):
+    """One pool's scaling verdict (the whole fleet, or one tier of a
+    tiered fleet): the breach/idle policy table over ``views``, with
+    cooldown stamps read from the pool's own sub-state."""
     live = [v for v in views
             if v["age"] is not None and v["age"] <= policy.dead_after_s
             and v["alive"] and not v["draining"]]
     evidence = {"views": views, "live": len(live)}
+    if tier is not None:
+        evidence["tier"] = tier
     if not live:
         return ScaleDecision(ScaleDecision.HOLD, "no live replicas",
-                             evidence=evidence)
+                             evidence=evidence, tier=tier)
     total_slots = sum(v["slots"] for v in live) or 1
     occupancy = sum(v["slot_occupancy"] for v in live) / float(total_slots)
     queue = sum(v["queue_depth"] for v in live)
@@ -234,7 +289,7 @@ def decide(policy, views, state, now):
     # and holds no work must not scale on the absence of evidence
     if completed == 0 and queue == 0 and occupancy == 0.0:
         return ScaleDecision(ScaleDecision.HOLD, "cold (no evidence)",
-                             evidence=evidence)
+                             evidence=evidence, tier=tier)
     # breach terms are gated on STANDING work (queue > 0): the
     # queue-wait EWMA and TTFT histogram are history — they hold their
     # last burst's values while the fleet sits idle, and a breach that
@@ -258,30 +313,32 @@ def decide(policy, views, state, now):
             return ScaleDecision(
                 ScaleDecision.HOLD,
                 "SLO breach but at max_replicas ({}): {}".format(
-                    policy.max_replicas, reason), evidence=evidence)
-        last_up = state.get("last_up")
+                    policy.max_replicas, reason), evidence=evidence,
+                tier=tier)
+        last_up = state.get(_state_key("last_up", tier))
         if last_up is not None and now - last_up < policy.up_cooldown_s:
             return ScaleDecision(
                 ScaleDecision.HOLD,
                 "SLO breach inside up-cooldown ({:.1f}s < {:.1f}s)"
                 .format(now - last_up, policy.up_cooldown_s),
-                evidence=evidence)
+                evidence=evidence, tier=tier)
         return ScaleDecision(ScaleDecision.UP, reason,
-                             evidence=evidence)
+                             evidence=evidence, tier=tier)
     if queue == 0 and occupancy <= policy.occupancy_low:
         if len(live) <= policy.min_replicas:
             return ScaleDecision(
                 ScaleDecision.HOLD, "idle at min_replicas",
-                evidence=evidence)
+                evidence=evidence, tier=tier)
         if completed == 0:
             # live gauges can read idle while every request so far
             # shed/failed — never shrink a fleet that has not proven
             # it can serve
             return ScaleDecision(
                 ScaleDecision.HOLD, "idle but zero completions",
-                evidence=evidence)
-        stamps = [t for t in (state.get("last_up"),
-                              state.get("last_down")) if t is not None]
+                evidence=evidence, tier=tier)
+        stamps = [t for t in (state.get(_state_key("last_up", tier)),
+                              state.get(_state_key("last_down", tier)))
+                  if t is not None]
         last_scale = max(stamps) if stamps else None
         if last_scale is not None \
                 and now - last_scale < policy.down_cooldown_s:
@@ -289,16 +346,17 @@ def decide(policy, views, state, now):
                 ScaleDecision.HOLD,
                 "idle inside down-cooldown ({:.1f}s < {:.1f}s)".format(
                     now - last_scale, policy.down_cooldown_s),
-                evidence=evidence)
+                evidence=evidence, tier=tier)
         victim = min(live, key=_retire_key)
         return ScaleDecision(
             ScaleDecision.DOWN,
             "idle (occupancy {:.0%} <= {:.0%}, empty queues; "
             "retiring coldest cache)".format(
                 occupancy, policy.occupancy_low),
-            replica_id=victim["replica_id"], evidence=evidence)
+            replica_id=victim["replica_id"], evidence=evidence,
+            tier=tier)
     return ScaleDecision(ScaleDecision.HOLD, "within SLO",
-                         evidence=evidence)
+                         evidence=evidence, tier=tier)
 
 
 class AutoscaleController(object):
@@ -421,15 +479,16 @@ class AutoscaleController(object):
         while no capacity exists — are logged once: the trail shows
         state changes, not a poll-rate heartbeat that would churn the
         EventLog ring out of its real history."""
-        key = (decision.action, decision.reason, decision.replica_id)
+        key = (decision.action, decision.reason, decision.replica_id,
+               decision.tier)
         if key == self._last_record:
             return
         self._last_record = key
         self.events.record(
             "autoscale_decision", action=decision.action,
             reason=decision.reason, replica=decision.replica_id,
-            replicas_live=live, replicas_target=target,
-            evidence=decision.evidence)
+            tier=decision.tier, replicas_live=live,
+            replicas_target=target, evidence=decision.evidence)
         if decision.action != ScaleDecision.HOLD:
             self.flight.instant(
                 "autoscale_" + decision.action,
@@ -461,6 +520,7 @@ class AutoscaleController(object):
     def _apply_up(self, decision, now):
         from tensorflowonspark_tpu import fleet as fleet_mod
 
+        up_key = _state_key("last_up", decision.tier)
         if self.fleet.placement == "executors" \
                 and self.fleet.free_executor() is None:
             # the regrow-probe gate: capacity must EXIST; a blocked
@@ -468,31 +528,35 @@ class AutoscaleController(object):
             self.counters.inc("scale_up_blocked")
             self._note_once("autoscale_blocked",
                             reason="no free executor")
-            self._state["last_up"] = now  # re-probe after the cooldown
+            self._state[up_key] = now  # re-probe after the cooldown
             return
         try:
+            # a tiered decision's spawn lands IN that tier (PR 17):
+            # sizing the prefill pool must grow a prefill replica
             replica = self.fleet.spawn_replica(
-                timeout=self.spawn_timeout)
+                timeout=self.spawn_timeout, tier=decision.tier)
         except fleet_mod.NoCapacity as e:
             self.counters.inc("scale_up_blocked")
             self._note_once("autoscale_blocked", reason=str(e))
-            self._state["last_up"] = now
+            self._state[up_key] = now
             return
-        self._state["last_up"] = now
+        self._state[up_key] = now
         self.counters.inc("scale_ups")
         self._applied("autoscale_scaled_up",
                       replica=replica.replica_id,
+                      tier=decision.tier,
                       executor=getattr(replica, "executor_id", None))
 
     def _apply_down(self, decision, now):
         clean = self.fleet.retire_replica(
             decision.replica_id, drain_timeout=self.drain_timeout)
-        self._state["last_down"] = now
+        self._state[_state_key("last_down", decision.tier)] = now
         self.counters.inc("scale_downs")
         if not clean:
             self.counters.inc("unclean_retirements")
         self._applied("autoscale_scaled_down",
                       replica=decision.replica_id,
+                      tier=decision.tier,
                       drained_clean=bool(clean))
 
     def _supervisor_watches(self, replica):

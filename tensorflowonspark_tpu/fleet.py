@@ -142,6 +142,24 @@ def route_order(views, stale_after=DEFAULT_STALE_AFTER):
     return [rid for _, rid in healthy] + [rid for _, rid in probing]
 
 
+def view_tier(view):
+    """One replica view's serving tier (PR 17): ``"prefill"``,
+    ``"decode"``, or ``"mixed"`` — absent/falsy gauges (every pre-tier
+    replica) read as ``"mixed"``, the full-service default."""
+    return str(view.get("tier") or "mixed")
+
+
+def decode_eligible(views):
+    """The views a ``:generate`` may land on: everything EXCEPT
+    dedicated prefill-tier replicas, which exist to fill KV blocks and
+    ship them — routing a decode stream onto one would burn its
+    compute budget on the slow phase the split exists to isolate.
+    Degenerate fleets (every replica prefill-tier — a misconfiguration
+    mid-rollout) fall back to all views: serving slowly beats 503."""
+    eligible = [v for v in views if view_tier(v) != "prefill"]
+    return eligible if eligible else views
+
+
 # -- prefix/session affinity (PR 16; pure policy + TTL'd map) --------------
 
 #: seconds a session -> replica affinity entry stays trusted without a
@@ -1082,7 +1100,8 @@ class FleetRouter(object):
                  affinity_ttl=DEFAULT_AFFINITY_TTL,
                  affinity_capacity=2048,
                  load_guard=DEFAULT_LOAD_GUARD,
-                 affinity_enabled=True):
+                 affinity_enabled=True, two_stage=True,
+                 prefill_timeout=120.0):
         self.reservation = reservation_server
         self.name = name
         self.replicas = list(replicas or [])
@@ -1119,6 +1138,19 @@ class FleetRouter(object):
         #: False = pure least-loaded routing (the honest baseline the
         #: bench's affinity leg publishes alongside the warm numbers)
         self.affinity_enabled = bool(affinity_enabled)
+        #: two-stage dispatch (PR 17): when the fleet holds BOTH a
+        #: prefill tier and decode-eligible replicas, each :generate
+        #: first places its prompt on a prefill replica (digest-aware,
+        #: the deepest prefix match re-prefills the least) which ships
+        #: the filled KV blocks to the chosen decode replica; the
+        #: decode dispatch then PREFERS that replica so the splice is
+        #: actually consumed. Strictly best-effort: every failure in
+        #: the stage degrades to plain single-stage dispatch.
+        self.two_stage = bool(two_stage)
+        #: bound on one staged :prefill call (covers prefill compute +
+        #: the KV ship; generous because a missed stage only costs a
+        #: cold decode-side prefill, never a failed request)
+        self.prefill_timeout = float(prefill_timeout)
         self.affinity = AffinityMap(capacity=affinity_capacity,
                                     ttl_s=affinity_ttl)
         #: reason -> count behind tfos_fleet_affinity_breaks{reason}
@@ -1200,6 +1232,14 @@ class FleetRouter(object):
                 "spec_acceptance_rate": gauges.get(
                     "spec_acceptance_rate", 0.0),
                 "kv_dtype": gauges.get("kv_dtype"),
+                # disaggregation plane (PR 17): which tier the replica
+                # serves (two-stage dispatch routes :prefill at the
+                # prefill tier, :generate around it) and the lease
+                # fencing epoch its KV shipments are stamped with —
+                # the splice side refuses epochs at or below a
+                # broadcast fence floor
+                "tier": gauges.get("tier") or "mixed",
+                "epoch": info.get("epoch"),
                 # prefix-warmth signal (PR 16): the beat-carried
                 # top-K chain digest affinity_order prices, the slot
                 # count the load guard's saturation check reads, and
@@ -1273,7 +1313,15 @@ class FleetRouter(object):
         # unparseable body routes load-only and the upstream answers
         # its own 400; the router must not pre-judge it
         session, prompt_tokens = self._affinity_inputs(raw_body) \
-            if self.affinity_enabled else (None, None)
+            if self.affinity_enabled or self.two_stage else (None, None)
+        # two-stage dispatch (PR 17): prefill placement + KV ship run
+        # BEFORE the decode attempt, so by the time the :generate
+        # lands, the decode replica's pool already holds the prompt's
+        # blocks (its own prefill collapses to a prefix-cache hit).
+        # `prefer` pins the decode pick to the ship target; None (no
+        # tiers, stage failed, nothing shippable) means plain dispatch
+        prefer = self._stage_prefill(prompt_tokens, session, trace) \
+            if self.two_stage and prompt_tokens else None
         status = None
         try:
             try:
@@ -1281,7 +1329,8 @@ class FleetRouter(object):
                     lambda: self._attempt_hedged(
                         raw_body, tried, upstream_spent, client_gone,
                         trace, attempts_made, request_id,
-                        session=session, prompt_tokens=prompt_tokens),
+                        session=session, prompt_tokens=prompt_tokens,
+                        prefer=prefer),
                     attempts=self.attempts, base_delay=self.base_delay,
                     max_delay=self.max_delay)
                 retry_after = None
@@ -1337,6 +1386,135 @@ class FleetRouter(object):
                 tokens = list(first)
         return session, tokens
 
+    def _stage_prefill(self, prompt_tokens, session, trace):
+        """Stage one of two-stage dispatch (PR 17): place the prompt
+        on a prefill-tier replica and have it ship the filled KV
+        blocks to the decode replica stage two will prefer. Returns
+        that decode replica_id (the dispatch preference) or None —
+        no prefill tier, nothing shippable, or any failure: the stage
+        is strictly best-effort, and every exit degrades to plain
+        single-stage dispatch (the decode side re-prefills cold).
+
+        Placement is the tentpole's routing contract: prefill-side,
+        the DEEPEST digest match wins (it re-prefills the least);
+        decode-side, :func:`affinity_plan` over the decode tier picks
+        exactly where stage two will route, so the shipped prefix
+        registers in the prefix cache of the replica that consumes
+        it — and a decode replica already holding the prefix skips
+        the stage entirely (nothing to ship)."""
+        t0 = time.monotonic()
+        try:
+            snapshot = self._snapshot()
+            views = self.replica_views(time.monotonic(), snapshot)
+            prefill_views = [v for v in views
+                             if view_tier(v) == "prefill"]
+            decode_views = decode_eligible(
+                [v for v in views if view_tier(v) != "prefill"])
+            prefill_order = route_order(prefill_views,
+                                        self.stale_after)
+            if not prefill_order or not decode_views:
+                return None
+            # stage 1: prefill placement, deepest digest match first
+            p_matches = {}
+            for view in prefill_views:
+                depth = digest_match(view, prompt_tokens)
+                if depth:
+                    p_matches[str(view.get("replica_id"))] = depth
+            p_rid = max(prefill_order,
+                        key=lambda r: (p_matches.get(r, 0),
+                                       -prefill_order.index(r)))
+            p_view = next(v for v in prefill_views
+                          if str(v.get("replica_id")) == p_rid)
+            block = int(p_view.get("prefix_digest_block_size") or 0)
+            if block <= 0 or len(prompt_tokens) < block:
+                # an unpaged prefill replica exports nothing, and a
+                # sub-block prompt ships zero full blocks — skip the
+                # round trip instead of prefilling for no shipment
+                return None
+            # stage 2: decode placement — the same affinity plan the
+            # decode attempt will run, so ship target == route target
+            hint = self.affinity.lookup(session) \
+                if session is not None else None
+            d_matches = {}
+            for view in decode_views:
+                depth = digest_match(view, prompt_tokens)
+                if depth:
+                    d_matches[str(view.get("replica_id"))] = depth
+            d_order, _ = affinity_plan(
+                decode_views, d_matches, hint, self.stale_after,
+                self.load_guard)
+            if not d_order:
+                return None
+            d_rid = d_order[0]
+            if d_matches.get(d_rid):
+                # the decode replica already holds this prefix (an
+                # earlier shipment, or its own serving history) —
+                # prefer it, ship nothing
+                with self._obs_lock:
+                    self.counters.inc("prefill_skips")
+                return d_rid
+            d_view = next(v for v in decode_views
+                          if str(v.get("replica_id")) == d_rid)
+            p_addr = (snapshot.get(p_rid) or {}).get("addr")
+            d_addr = (snapshot.get(d_rid) or {}).get("addr")
+            if not p_addr or not d_addr:
+                return None
+            body = json.dumps({
+                "prompt": list(prompt_tokens),
+                "session": session,
+                # the prefill replica stamps its shipment with its OWN
+                # lease epoch; the decode side's fence floor (raised
+                # when a replica is replaced or retired) is what keeps
+                # an orphaned shipment from a dead incarnation out
+                "src_epoch": p_view.get("epoch"),
+                "ship": {"addr": "{}:{}".format(d_addr[0], d_addr[1]),
+                         "replica_id": d_rid,
+                         "epoch": d_view.get("epoch")},
+            }).encode()
+            with self._obs_lock:
+                self.counters.inc("prefill_dispatches")
+            status, rbody, _hdrs = _http_request(
+                tuple(p_addr), "POST",
+                "/v1/models/{}:prefill".format(self.name), body=body,
+                timeout=self.prefill_timeout,
+                connect_timeout=self.connect_timeout,
+                extra_headers={"X-TFOS-Trace": str(trace)},
+                net_src="router", net_dst=p_rid)
+            out = {}
+            if status == 200:
+                try:
+                    out = json.loads(rbody)
+                except ValueError:
+                    out = {}
+            if status == 200 and out.get("shipped"):
+                with self._obs_lock:
+                    self.counters.inc("prefill_ships")
+                self.flight.instant(
+                    "prefill_staged", trace=trace, prefill=p_rid,
+                    decode=d_rid, blocks=out.get("blocks", 0),
+                    bytes=out.get("bytes", 0),
+                    transport=out.get("transport", ""))
+                return d_rid
+            # prefilled-but-not-shipped (or upstream refusal): the
+            # decode preference still stands when the prefill side
+            # answered at all — a cold decode there is no worse than
+            # a cold decode anywhere else
+            with self._obs_lock:
+                self.counters.inc("prefill_misses")
+            return d_rid if status == 200 else None
+        except (OSError, ValueError, KeyError, StopIteration,
+                TimeoutError, http.client.HTTPException) as e:
+            # includes chaos.NetPartitioned (a ConnectionError): a
+            # partitioned prefill tier must never fail the request —
+            # the decode side serves cold, correctly
+            with self._obs_lock:
+                self.counters.inc("prefill_errors")
+            logger.debug("prefill stage skipped: %s", e)
+            return None
+        finally:
+            with self._obs_lock:
+                self.timers.add("prefill", time.monotonic() - t0)
+
     def _affinity_break(self, reason):
         """Tally one affinity break (warm preference not honored) under
         ``reason`` — the tfos_fleet_affinity_breaks{reason} series."""
@@ -1373,7 +1551,7 @@ class FleetRouter(object):
 
     def _attempt_hedged(self, raw_body, tried, upstream_spent,
                         client_gone, trace, attempts_made, request_id,
-                        session=None, prompt_tokens=None):
+                        session=None, prompt_tokens=None, prefer=None):
         """One retry_call step, possibly racing TWO upstream attempts:
         the primary starts immediately; if it is still running after
         :meth:`_hedge_delay`, a hedge attempt goes to a DIFFERENT
@@ -1391,7 +1569,8 @@ class FleetRouter(object):
             return self._attempt(raw_body, tried, upstream_spent,
                                  client_gone, trace, attempts_made,
                                  request_id, session=session,
-                                 prompt_tokens=prompt_tokens)
+                                 prompt_tokens=prompt_tokens,
+                                 prefer=prefer)
         cv = threading.Condition()
         outcomes = []  # (label, "ok"|"err", payload) in arrival order
         lose = threading.Event()
@@ -1408,7 +1587,7 @@ class FleetRouter(object):
                     # replica; with nobody else routable, joining the
                     # primary's replica would just clear `tried` and
                     # confuse failover bookkeeping
-                    views = self.replica_views()
+                    views = decode_eligible(self.replica_views())
                     if not [r for r in route_order(views,
                                                    self.stale_after)
                             if r not in tried]:
@@ -1419,7 +1598,8 @@ class FleetRouter(object):
                                     hedge=skip_if_no_alternative,
                                     session=session,
                                     prompt_tokens=prompt_tokens,
-                                    picked=picked, label=label)
+                                    picked=picked, label=label,
+                                    prefer=prefer)
                 with cv:
                     outcomes.append((label, "ok", out))
                     cv.notify_all()
@@ -1495,7 +1675,7 @@ class FleetRouter(object):
                  client_gone=None, trace=0, attempts_made=None,
                  request_id=None, lose=None, hedge=False,
                  session=None, prompt_tokens=None, picked=None,
-                 label=None):
+                 label=None, prefer=None):
         """One dispatch attempt: pick the best untried replica —
         prefix/session-aware via :func:`affinity_plan` (PR 16), so the
         session's remembered replica or the deepest digest match wins
@@ -1526,7 +1706,11 @@ class FleetRouter(object):
         now = time.monotonic()
         t_pick = time.monotonic()
         snapshot = self._snapshot()
-        views = self.replica_views(now, snapshot)
+        # :generate routes AROUND the prefill tier (PR 17): its
+        # replicas fill and ship KV blocks; decode streams belong to
+        # the decode/mixed tiers (decode_eligible keeps the all-
+        # prefill degenerate fleet servable)
+        views = decode_eligible(self.replica_views(now, snapshot))
         hint = self.affinity.lookup(session) \
             if session is not None else None
         matches = {}
@@ -1537,6 +1721,15 @@ class FleetRouter(object):
                     matches[str(view.get("replica_id"))] = depth
         full_order, plan = affinity_plan(
             views, matches, hint, self.stale_after, self.load_guard)
+        if prefer is not None and prefer in full_order \
+                and prefer not in tried:
+            # two-stage dispatch already shipped this prompt's KV
+            # blocks to `prefer`: landing anywhere else forfeits the
+            # splice (the whole point of the staging). Failover still
+            # works — a preferred replica that errors joins `tried`
+            # and the next attempt proceeds on plain affinity order
+            full_order = [prefer] + [r for r in full_order
+                                     if r != prefer]
         if hint is not None and not plan["hint_routable"]:
             # the session's warm replica is dead, draining, or stale:
             # the request proceeds COLD (never an error — the colder
@@ -1773,6 +1966,7 @@ class FleetRouter(object):
                     "speculate_k": v["speculate_k"],
                     "spec_acceptance_rate": v["spec_acceptance_rate"],
                     "kv_dtype": v["kv_dtype"],
+                    "tier": v["tier"],
                     # per-replica warmth at a glance: how many chains
                     # the replica's digest publishes, and whether the
                     # top-K bound cut any (PR 16)
@@ -1819,6 +2013,15 @@ class FleetRouter(object):
             for v in views:
                 lines.append('{}{{replica="{}"}} {}'.format(
                     family, v["replica_id"], tracing._fmt(key(v))))
+        # tier topology (PR 17): replica -> serving tier as an info-
+        # pattern gauge, so the prefill/decode split is legible from
+        # one scrape next to the per-tier load series
+        if views:
+            lines.append("# TYPE tfos_fleet_replica_tier gauge")
+            for v in views:
+                lines.append(
+                    'tfos_fleet_replica_tier{{replica="{}",tier="{}"}}'
+                    ' 1'.format(v["replica_id"], v["tier"]))
         # replica_id -> executor join (PR 13): which executor hosts
         # each replica, from the beat-carried host metadata — the
         # info-pattern gauge an operator joins autoscale decisions and
@@ -2153,7 +2356,26 @@ class ServingFleet(object):
                  engine_kw=None, host="127.0.0.1", beat_interval=0.25,
                  reservation_server=None, router_kw=None,
                  placement="driver", sc=None, executors=None,
-                 spawn_timeout=120.0):
+                 spawn_timeout=120.0, tiers=None):
+        #: tier topology (PR 17): ``{"prefill": n, "decode": m}``
+        #: (any subset of prefill/decode/mixed). When given it
+        #: OVERRIDES ``replicas`` — the fleet forms with exactly the
+        #: stated widths, each engine spawned with its tier, and the
+        #: router's two-stage dispatch turns on by virtue of the tiers
+        #: existing. None = a homogeneous "mixed" fleet (pre-PR-17
+        #: behavior exactly).
+        self.tiers = {str(t): int(n) for t, n in tiers.items()} \
+            if tiers else None
+        if self.tiers:
+            bad = [t for t in self.tiers
+                   if t not in ("prefill", "decode", "mixed")]
+            if bad:
+                raise ValueError(
+                    "unknown tier(s) {}: tiers maps 'prefill' / "
+                    "'decode' / 'mixed' to replica counts".format(bad))
+            if any(n < 0 for n in self.tiers.values()):
+                raise ValueError("tier widths must be >= 0")
+            replicas = sum(self.tiers.values())
         if int(replicas) < 1:
             raise ValueError("a fleet needs >= 1 replica")
         if placement not in ("driver", "executors"):
@@ -2191,6 +2413,10 @@ class ServingFleet(object):
         self._next_idx = 0
         self._np_params = None
         self._spawns = {}  # rid -> AsyncResult of its bootstrap task
+        # rid -> tier, recorded at spawn (PR 17): a REPLACEMENT must
+        # come back in its predecessor's tier, or a repaired
+        # prefill/decode split silently collapses to mixed
+        self._tier_by_rid = {}
         # guards the width bookkeeping (replicas / _next_idx /
         # _spawns) AND the executor-placement decision: the
         # autoscaler's control thread and operator threads drive
@@ -2235,7 +2461,19 @@ class ServingFleet(object):
                 return True
             return False
 
-    def _spawn_local_replica(self, rid):
+    def _formation_tiers(self):
+        """The tier of each formation replica in spawn order
+        (prefill first, so the feed side of the split is up before
+        decode traffic can stage against it); ``[None] * n`` for an
+        untiered fleet."""
+        if not self.tiers:
+            return [None] * self.n_replicas
+        plan = []
+        for tier in ("prefill", "decode", "mixed"):
+            plan.extend([tier] * self.tiers.get(tier, 0))
+        return plan
+
+    def _spawn_local_replica(self, rid, tier=None):
         from tensorflowonspark_tpu.serving import DecodeEngine, \
             ModelServer
 
@@ -2246,6 +2484,10 @@ class ServingFleet(object):
         # each dump EVERYONE's spans under their own label
         kw = dict(self.engine_kw)
         kw.setdefault("flight", tracing.FlightRecorder())
+        if tier is not None:
+            kw["tier"] = tier
+        with self._lock:
+            self._tier_by_rid[rid] = tier
         engine = DecodeEngine(self.model, self.params, replica_id=rid,
                               **kw)
         try:
@@ -2300,7 +2542,7 @@ class ServingFleet(object):
                 return eid
         return None
 
-    def _dispatch_spawn(self, rid, eid):
+    def _dispatch_spawn(self, rid, eid, tier=None):
         """Ship one serving bootstrap task pinned to executor ``eid``
         (exclusion of every other alive executor is how the engine's
         one-task-per-executor dispatch is pointed at exactly one) and
@@ -2312,10 +2554,15 @@ class ServingFleet(object):
             raise RuntimeError(
                 "executor {} is not alive/eligible (alive: {})".format(
                     eid, alive))
+        engine_kw = dict(self.engine_kw)
+        if tier is not None:
+            engine_kw["tier"] = tier
+        with self._lock:
+            self._tier_by_rid[rid] = tier
         spec = {"replica_id": rid, "name": self.name,
                 "reservation_addr": list(self._resv_addr),
                 "beat_interval": self.beat_interval,
-                "engine_kw": self.engine_kw,
+                "engine_kw": engine_kw,
                 "model": self.model, "params": self._host_params()}
         rdd = self.sc.parallelize([eid], 1)
         result = rdd.foreachPartitionAsync(
@@ -2366,9 +2613,11 @@ class ServingFleet(object):
                 self._resv_addr = self.reservation.start(host=self.host)
             else:
                 self._resv_addr = self.reservation.addr
+            plan = self._formation_tiers()
             if self.placement == "driver":
-                for _ in range(self.n_replicas):
-                    self._spawn_local_replica(self._new_rid())
+                for tier in plan:
+                    self._spawn_local_replica(self._new_rid(),
+                                              tier=tier)
             else:
                 eligible = self.alive_executors()
                 if len(eligible) < self.n_replicas:
@@ -2376,8 +2625,9 @@ class ServingFleet(object):
                         "fleet needs {} executors but only {} are "
                         "alive/eligible".format(self.n_replicas,
                                                 len(eligible)))
-                for eid in eligible[:self.n_replicas]:
-                    self._dispatch_spawn(self._new_rid(), eid)
+                for eid, tier in zip(eligible[:self.n_replicas], plan):
+                    self._dispatch_spawn(self._new_rid(), eid,
+                                         tier=tier)
             # formation barrier: every replica's lease must be live
             # before the router opens, or the first requests race the
             # first beats (spawn-task errors surface here too)
@@ -2405,7 +2655,7 @@ class ServingFleet(object):
     # -- elastic width (the autoscaler's verbs) ----------------------------
 
     def spawn_replica(self, replica_id=None, executor_id=None,
-                      timeout=None):
+                      timeout=None, tier=None):
         """Grow the fleet by one replica (or respawn ``replica_id`` —
         a REPLACEMENT under the same identity). Executor placement
         picks a free executor (:meth:`free_executor`; raises
@@ -2425,13 +2675,18 @@ class ServingFleet(object):
             and self._replica(replica_id) is not None
         rid = str(replica_id) if replica_id is not None \
             else self._new_rid()
+        if tier is None:
+            # a replacement (or tier-less respawn) inherits its
+            # identity's recorded tier — repairing a prefill replica
+            # as "mixed" would silently shrink the prefill tier
+            tier = self._tier_by_rid.get(rid)
         min_epoch = None
         if self.placement == "driver":
             if replacing:
                 raise NotImplementedError(
                     "driver-placement replicas are replaced by the "
                     "supervisor's RestartEngine, not by respawn")
-            replica = self._spawn_local_replica(rid)
+            replica = self._spawn_local_replica(rid, tier=tier)
         else:
             # the pick and the dispatch are ONE atomic placement
             # decision: free_executor() reads the hosting ledger, and
@@ -2466,7 +2721,7 @@ class ServingFleet(object):
                         # a blocked replacement must not fence an
                         # incarnation nothing will supersede.
                         min_epoch = self.reservation.mint_epoch(rid)
-                    replica = self._dispatch_spawn(rid, eid)
+                    replica = self._dispatch_spawn(rid, eid, tier=tier)
                 except BaseException:
                     # the dead identity must STAY TRACKED on any
                     # pre-dispatch failure, or the autoscaler forgets
@@ -2499,6 +2754,14 @@ class ServingFleet(object):
             # the force-clear) so the replacement is routable now, not
             # after the corpse's cooldown expires
             self.router.readmit(rid, owner=None)
+        if min_epoch is not None:
+            # the ship plane's half of the fence (PR 17): every live
+            # replica raises its floor against the DEAD incarnation's
+            # epoch, so a KV shipment it packed before dying — still
+            # in flight, or replayed by a partitioned-but-alive corpse
+            # — can never splice into a pool the replacement is
+            # already filling
+            self._broadcast_ship_fence(rid, min_epoch)
         logger.info("replica %s %s (%s)", rid,
                     "replaced" if replacing else "spawned",
                     "executor {}".format(replica.executor_id)
@@ -2550,15 +2813,48 @@ class ServingFleet(object):
         except Exception as e:  # noqa: BLE001 - teardown is best-effort
             logger.warning("retirement stop of replica %s failed: %s",
                            rid, e)
-        self.reservation.mint_epoch(rid)
+        fence_epoch = self.reservation.mint_epoch(rid)
         self._untrack(replica)
         self.reservation.drop_lease(rid)
         if self.router is not None:
             self.router.readmit(rid, owner="autoscale")
             self.router.health.forget(rid)
+        # a retired prefill replica's in-flight shipments die with it:
+        # fence its epoch fleet-wide so a zombie whose stop RPC never
+        # landed cannot splice stale blocks into live decode pools
+        self._broadcast_ship_fence(rid, fence_epoch)
         logger.info("replica %s retired (drain %s)", rid,
                     "clean" if clean else "UNCLEAN")
         return clean
+
+    def _broadcast_ship_fence(self, rid, min_epoch):
+        """Raise every live replica's KV-splice fence floor against
+        shipments ``rid`` minted at or below ``min_epoch`` (POST
+        /admin/ship_fence; the floor is monotonic and the RPC
+        idempotent, so re-broadcasts are harmless). Best-effort BY
+        DESIGN: a replica the broadcast misses still never serves
+        wrong bytes — the splice path's resident-chain dedupe and
+        block-table registration only ever ADD a prefix that decodes
+        bitwise-identically; the fence exists to stop a dead
+        incarnation's stale-cache shipments from wasting pool blocks
+        and warming wrong prefixes."""
+        body = json.dumps({"replica_id": str(rid),
+                           "min_epoch": int(min_epoch)}).encode()
+        for other, info in sorted(
+                self.reservation.serving_snapshot().items()):
+            if other == str(rid) or not info.get("addr"):
+                continue
+            try:
+                status, rbody, _ = _http_request(
+                    tuple(info["addr"]), "POST", "/admin/ship_fence",
+                    body=body, timeout=5.0)
+                if status != 200:
+                    logger.warning(
+                        "ship-fence broadcast to %s answered %s: %s",
+                        other, status, rbody[:200])
+            except (OSError, http.client.HTTPException) as e:
+                logger.warning("ship-fence broadcast to %s failed: %s",
+                               other, e)
 
     def autoscale(self, policy=None, **controller_kw):
         """Arm the SLO-driven autoscaler (autoscale.py): a driver-side
@@ -2632,6 +2928,7 @@ class ServingFleet(object):
         with self._lock:
             self.replicas = []
             self._spawns = {}
+            self._tier_by_rid = {}
             # a re-start() names from replica-0 again (fresh
             # formation; identity reuse is safe — Client.lease mints
             # the NEXT epoch even against a shared reservation

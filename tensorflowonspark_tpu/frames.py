@@ -189,6 +189,18 @@ def encode_multi(objs):
     return [_LEN.pack(len(hdr)), hdr] + payloads
 
 
+def frame_bytes(buffers):
+    """Total wire bytes of an :func:`encode`/:func:`encode_multi`
+    result — the PHYSICAL transfer cost (header + raw column payloads
+    as they sit in memory). This is the one place ship-byte accounting
+    reads (PR 17): int8 KV shipments are priced by their codes+scales
+    buffers, never by the logical dequantized size."""
+    total = 0
+    for b in buffers:
+        total += memoryview(b).nbytes
+    return total
+
+
 def decode(view):
     """One frame (memoryview/bytes) → object (or FrameList for multi).
 

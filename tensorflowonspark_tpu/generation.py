@@ -466,6 +466,82 @@ def paged_step_fns(model, temperature=0.0, top_k=None, top_p=None):
     return prefill, decode
 
 
+# -- KV block-row shipping primitives (PR 17) ---------------------------
+#
+# The device half of prefill/decode disaggregation: a prefill worker
+# exports the pool rows its blocks occupy (host-side gather — the bytes
+# that go on the wire are the POOL'S OWN storage, int8 codes + float32
+# scales on a quantized pool, so shipping needs no dequant round-trip
+# and splice parity is bitwise by construction), and a decode worker
+# scatters them into ITS pool at freshly allocated block ids. Leaves
+# are keyed by their full tree path, not discovery order, so a
+# structural mismatch (different layer count, missing scales) fails
+# loudly instead of splicing K into V.
+
+#: flax cache leaves that are per-BLOCK pool storage — the shippable
+#: content of a paged cache (everything else is per-slot host-owned
+#: state: cursors and block tables never ship)
+_POOL_LEAVES = ("cached_key", "cached_value", "key_scale", "value_scale")
+
+
+def _path_key(path):
+    """Stable string key of one cache-leaf path (e.g.
+    ``block_0/attn/cached_key``) — the wire name a shipped row set is
+    keyed under, identical across processes for one model config."""
+    return "/".join(
+        str(getattr(e, "key", None) or getattr(e, "name", None) or e)
+        for e in path)
+
+
+def gather_block_rows(cache, block_ids):
+    """Host-side gather of pool rows ``block_ids`` from every pool leaf.
+
+    Returns ``[(path_key, rows)]`` in tree order, ``rows`` a numpy array
+    of shape ``[len(block_ids), *leaf.shape[1:]]`` in the LEAF'S dtype —
+    int8 codes stay int8, scales stay float32. One device->host copy
+    per leaf; the caller (the engine's scheduler thread) must hold the
+    blocks referenced so the pool cannot recycle them mid-gather."""
+    import numpy as np
+
+    ids = np.asarray(list(block_ids), np.int32)
+    out = []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(cache):
+        if _leaf_name(path) in _POOL_LEAVES:
+            out.append((_path_key(path), np.asarray(leaf)[ids]))
+    return out
+
+
+def scatter_block_rows(cache, block_ids, rows):
+    """Inverse of :func:`gather_block_rows`: cache' with each shipped
+    row set written at ``block_ids`` into its path-matched pool leaf.
+
+    ``rows`` is ``{path_key: array}`` (or the gather's pair list).
+    Raises ValueError on a leaf the shipment lacks or a dtype mismatch
+    (an fp32 shipment cannot splice into an int8 pool — requantizing
+    here would break the bitwise-parity contract; ship pools must
+    match dtypes end to end)."""
+    rows = dict(rows)
+    ids = jnp.asarray(list(block_ids), jnp.int32)
+
+    def repl(path, leaf):
+        if _leaf_name(path) not in _POOL_LEAVES:
+            return leaf
+        key = _path_key(path)
+        if key not in rows:
+            raise ValueError(
+                "shipment lacks pool leaf {!r} (incompatible model "
+                "config between ship endpoints)".format(key))
+        arr = rows[key]
+        if str(arr.dtype) != str(leaf.dtype):
+            raise ValueError(
+                "shipped rows for {!r} are {} but the pool stores {} — "
+                "ship endpoints must share kv_dtype".format(
+                    key, arr.dtype, leaf.dtype))
+        return leaf.at[ids].set(jnp.asarray(arr))
+
+    return jax.tree_util.tree_map_with_path(repl, cache)
+
+
 # -- speculative decoding primitives (PR 15) ----------------------------
 #
 # Draft-model speculation over the SAME paged pool discipline: a

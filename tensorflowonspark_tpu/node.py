@@ -527,6 +527,22 @@ def serve_replica(spec):
         # chaos gate: kill_serving_executor_at_request refuses to fire
         # in any process that is not an executor-hosted serving node
         os.environ["TFOS_SERVING_EXECUTOR_ID"] = str(executor_id)
+        # reap KV-ship rings a SIGKILLed predecessor left in /dev/shm
+        # (PR 17): ship-ring names embed the creator pid exactly like
+        # the feed rings, so the stale sweep can prove owner death
+        # before this node's prefill side allocates fresh ones; scoped
+        # to the kvship family so a co-hosted training cluster's feed
+        # rings are never touched from the serving bootstrap
+        try:
+            from tensorflowonspark_tpu import shm
+            if shm.available():
+                swept = shm.sweep_stale(
+                    pattern="/dev/shm/tfos-kvship-*.*")
+                if swept:
+                    logger.warning("reaped %d stale kv-ship ring(s): "
+                                   "%s", len(swept), swept)
+        except Exception:  # noqa: BLE001 - bootstrap must not die on it
+            logger.exception("kv-ship ring sweep failed")
         old = _serving_state().pop(rid, None)
         if old is not None:
             logger.warning("executor %s already hosts replica %s; "

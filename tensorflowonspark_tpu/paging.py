@@ -325,6 +325,37 @@ class BlockPool(object):
                     if self._origin.get(bid) == "generated")
         return ids
 
+    def resident_chain(self, tokens, acquire=False):
+        """Longest resident chain of FULL blocks for ``tokens``,
+        UNCAPPED — the KV-export walk (PR 17 disaggregation). Where
+        :meth:`_walk_locked` stops at ``(len - 1) // block_size`` so
+        admission always leaves a tail token to prefill, a prefill
+        worker exporting a finished prompt wants every block admission
+        registered — ``len(tokens) // block_size`` of them — because
+        the DEEPEST block is exactly the one a decode-tier adopter
+        saves the most prefill on. Tallies no hits (an export probe is
+        not a cache lookup). With ``acquire`` the walk takes one
+        reference per returned block UNDER THE SAME LOCK — the export
+        path needs walk-then-pin to be atomic, or a concurrent
+        ``drop_cache`` / eviction could free a block between the two
+        (callers :meth:`release` when done). Read-only otherwise.
+        Returns ``[(block_id, origin), ...]`` in chain order."""
+        tokens = list(tokens)
+        out = []
+        with self._lock:
+            for j in range(len(tokens) // self.block_size):
+                key = self._chain_key(tokens, (j + 1) * self.block_size)
+                bid = self._by_key.get(key)
+                if bid is None:
+                    break
+                out.append((bid, self._origin.get(bid, "prompt")))
+            if acquire and out:
+                self._epoch += 1
+                for bid, _ in out:
+                    self._ref[bid] = self._ref.get(bid, 0) + 1
+                    self._lru.pop(bid, None)
+        return out
+
     def plan(self, tokens):
         """(shared_ids, new_blocks_needed, lru_resident) for admitting
         ``tokens`` — the admission gate's dry run (no refs taken, no

@@ -61,6 +61,8 @@ import time
 import numpy as np
 
 from tensorflowonspark_tpu import chaos
+from tensorflowonspark_tpu import frames
+from tensorflowonspark_tpu import kvship
 from tensorflowonspark_tpu import paging
 from tensorflowonspark_tpu import tracing
 
@@ -110,6 +112,19 @@ class EngineFailed(Retriable):
     """The decode scheduler died. Outstanding handles fail with this so
     clients retry (against this replica once the supervisor's
     RestartEngine policy rebuilds the engine, or against another)."""
+
+
+class SpliceRejected(RuntimeError):
+    """A shipped KV prefix was DELIBERATELY refused (PR 17): fenced
+    source epoch, mismatched pool geometry/dtype, pool pressure, or an
+    unpaged target. NOT retriable-as-is — the decode side answers 409
+    and the prefill side falls back to letting the decode replica
+    re-prefill cold. ``reason`` is the bounded label the
+    ``tfos_splice_failures_total{reason=...}`` counter carries."""
+
+    def __init__(self, reason, msg):
+        super(SpliceRejected, self).__init__(msg)
+        self.reason = str(reason)
 
 
 #: HTTP statuses a serving surface answers for TRANSIENT conditions —
@@ -590,7 +605,7 @@ class DecodeEngine(object):
                  max_queue=1024, metrics=None, flight=None,
                  replica_id=None, kv_block_size=None, kv_blocks=None,
                  prefix_cache=True, attn_impl=None, speculate_k=None,
-                 draft_layers=None, kv_dtype=None):
+                 draft_layers=None, kv_dtype=None, tier=None):
         import jax
 
         from tensorflowonspark_tpu import generation
@@ -614,8 +629,22 @@ class DecodeEngine(object):
             kv_block_size=kv_block_size, kv_blocks=kv_blocks,
             prefix_cache=prefix_cache, attn_impl=attn_impl,
             speculate_k=speculate_k, draft_layers=draft_layers,
-            kv_dtype=kv_dtype)
+            kv_dtype=kv_dtype, tier=tier)
         self._generation = generation
+        #: serving tier (PR 17 disaggregation): "prefill" engines take
+        #: prompt work and ship resident KV blocks out, "decode"
+        #: engines adopt shipped blocks and stream tokens, "mixed"
+        #: (the default) does both — exactly the pre-PR-17 engine.
+        #: Rides load_stats -> the BEAT lease -> router views ->
+        #: autoscaler views, so two-stage dispatch and tier-aware
+        #: sizing read it from the same one schema field.
+        if tier is None:
+            tier = "mixed"
+        if tier not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                "tier must be 'prefill', 'decode', or 'mixed', "
+                "got {!r}".format(tier))
+        self.tier = str(tier)
         total_len = int(total_len or model.max_len)
         if total_len > model.max_len:
             raise ValueError(
@@ -672,6 +701,17 @@ class DecodeEngine(object):
         # ring saturation is an exported signal, not a silent loss:
         # /metrics carries tfos_trace_spans_dropped_total
         tracing.expose_flight_drops(self.metrics, self.flight)
+        # KV-ship observability (PR 17): PHYSICAL bytes/blocks over the
+        # ship wire — codes + scales as stored, never the logical
+        # dequantized size — plus per-ship wall time and per-reason
+        # splice rejections. Writers are HTTP handler threads as well
+        # as the scheduler, so unlike self.counters these mutate only
+        # through the _cv-guarded note_ship()/note_splice_failure()
+        # helpers (Counters itself is single-writer by convention).
+        self.kv_counters = self.metrics.add_counters(
+            "tfos_kv", tracing.Counters())
+        self._hist_ship = self.metrics.histogram("tfos_kv_ship_ms")
+        self._splice_failures = {}  # reason -> count (guarded by _cv)
         self._temperature = float(temperature)
         norm_top_k = None if top_k is None else int(top_k)
         norm_top_p = None if top_p is None else float(top_p)
@@ -846,6 +886,12 @@ class DecodeEngine(object):
                 model, self._temperature, norm_top_k, norm_top_p)
         self._key = rng if rng is not None else jax.random.PRNGKey(0)
         self._queue = collections.deque()
+        # KV ship/splice jobs (PR 17): export and import must run on
+        # the scheduler thread (pool mutation + cache access are its
+        # monopoly), so client threads enqueue here under _cv and wait
+        # on a per-job event — the same single-writer discipline the
+        # request queue uses
+        self._kv_jobs = collections.deque()
         self._cv = threading.Condition()
         self._stopping = False
         self._draining = False
@@ -1219,6 +1265,20 @@ class DecodeEngine(object):
             self.counters.get("spec_accepted") / proposed, 4) \
             if proposed else 0.0
         stats["kv_dtype"] = self.kv_dtype
+        # disaggregation plane (PR 17): which tier this engine serves,
+        # plus shipped-KV accounting. Byte fields are PHYSICAL — the
+        # codes + scales actually transferred (frames.frame_bytes of
+        # the wire buffers), never the logical dequantized size, so an
+        # int8 pool's ships read ~3.2x smaller than a float pool's for
+        # the same chain — that ratio IS the feature, not a bug.
+        stats["tier"] = self.tier
+        with self._cv:
+            stats["kv_ship_bytes"] = self.kv_counters.get("ship_bytes")
+            stats["kv_ship_blocks"] = self.kv_counters.get("ship_blocks")
+            stats["kv_spliced_bytes"] = \
+                self.kv_counters.get("spliced_bytes")
+            stats["kv_spliced_blocks"] = \
+                self.kv_counters.get("spliced_blocks")
         if self._paged:
             ps = self._pool.stats()
             stats["kv_blocks_total"] = ps["total"]
@@ -1597,12 +1657,21 @@ class DecodeEngine(object):
             while True:
                 with self._cv:
                     while (not self._stopping and not self._queue
+                           and not self._kv_jobs
                            and not self._active_slots()):
                         self._cv.wait()
                     if self._stopping:
                         self._fail_outstanding(
                             RuntimeError("engine stopped"))
                         return
+                    # KV ship/splice jobs drain under the lock, run
+                    # outside it (export gathers device rows to host,
+                    # import scatters — both too slow for _cv). Taking
+                    # them on the scheduler thread is the whole safety
+                    # story: no admission or decode step interleaves
+                    # with pool surgery.
+                    kv_jobs = list(self._kv_jobs)
+                    self._kv_jobs.clear()
                     self._prune_queue_locked(time.monotonic())
                     admits = []
                     planned_blocks = 0
@@ -1663,6 +1732,8 @@ class DecodeEngine(object):
                         self._slot_req[s] = handle
                         admits.append((s, handle))
                     self.counters.gauge("queue_depth", len(self._queue))
+                for job in kv_jobs:
+                    self._run_kv_job(job)
                 # prefill OUTSIDE the lock: submit() must never block on
                 # device work
                 for s, handle in admits:
@@ -1755,6 +1826,13 @@ class DecodeEngine(object):
             self._release_slot(s)
         failed.extend(self._queue)
         self._queue.clear()
+        # pending KV ship/splice jobs are client threads parked on a
+        # per-job event — wake them with the same error so a ship RPC
+        # against a dying engine fails fast instead of timing out
+        for job in self._kv_jobs:
+            job["error"] = err
+            job["done"].set()
+        self._kv_jobs.clear()
         for handle in failed:
             handle._finish(err)
             self.flight.instant("failed", trace=handle.trace,
@@ -1976,6 +2054,213 @@ class DecodeEngine(object):
                 origin="prompt" if end <= n_prompt else "generated")
         self._slot_registered[slot] = full
         self._publish_kv_gauges()
+
+    # -- KV-block shipping (PR 17 disaggregation) ------------------------
+    #
+    # export_prefix / import_prefix are the engine half of prefill/
+    # decode disaggregation. Both execute ON the scheduler thread (via
+    # the _kv_jobs queue drained at the top of _loop): pool surgery and
+    # cache access stay single-writer, so an export never races an
+    # admission's acquire and an import's scatter never tears a decode
+    # step. Client threads (the server's /kv/splice and :prefill
+    # handlers) park on a per-job event.
+
+    def export_prefix(self, tokens, src_epoch=None, timeout=30.0):
+        """Pack ``tokens``'s resident full-block KV chain into wire
+        buffers — the prefill-tier half of a shipment. Returns
+        ``(buffers, meta)`` (:func:`kvship.pack` output plus the header
+        it embeds) or ``None`` when nothing is resident (unpaged
+        engine, or the prompt spans no full block). The buffers carry
+        the pool rows AS STORED — int8 codes + per-head scales on a
+        quantized pool, no dequant round-trip — so physical ship cost
+        is exactly ``frames.frame_bytes(buffers)``. ``src_epoch`` is
+        this replica's fencing epoch, stamped into the header so the
+        receiver can refuse shipments from a fenced-out incarnation."""
+        return self._kv_call({"kind": "export", "tokens": list(tokens),
+                              "src_epoch": src_epoch}, timeout)
+
+    def import_prefix(self, meta, rows, timeout=30.0):
+        """Adopt a shipment: splice its novel blocks into this engine's
+        pool by block-table pointer surgery — alloc, scatter the
+        shipped rows (bytes as stored, no requant), register the chain
+        — so a temp=0 decode over the spliced prefix is bitwise
+        identical to having prefilled locally. Idempotent: blocks
+        already resident (an earlier splice, or local traffic) are
+        skipped by resident-chain dedupe, which is what makes duplicate
+        deliveries (chaos ``dup`` verdicts, post-timeout re-ships)
+        safe. Raises :class:`SpliceRejected` (reason-tagged) on
+        geometry/dtype mismatch, malformed rows, or pool pressure.
+        Returns ``{'spliced_blocks', 'skipped_blocks', 'bytes'}`` —
+        ``bytes`` is the physical size of the NOVEL rows only."""
+        return self._kv_call({"kind": "import", "meta": meta,
+                              "rows": rows}, timeout)
+
+    def _kv_call(self, job, timeout):
+        """Enqueue a KV job for the scheduler thread and wait for its
+        verdict (safe from any thread)."""
+        job["done"] = threading.Event()
+        job["error"] = None
+        job["result"] = None
+        with self._cv:
+            if self._broken is not None:
+                raise EngineFailed(
+                    "engine failed: {}".format(self._broken))
+            if self._stopping:
+                raise EngineFailed("engine stopped")
+            self._kv_jobs.append(job)
+            self._cv.notify_all()
+        if not job["done"].wait(timeout):
+            raise TimeoutError(
+                "kv {} job not scheduled within {}s"
+                .format(job["kind"], timeout))
+        if job["error"] is not None:
+            raise job["error"]
+        return job["result"]
+
+    def _run_kv_job(self, job):
+        """Execute one drained KV job (scheduler thread, outside
+        ``_cv``). Job-scoped failures — SpliceRejected, malformed
+        shipments — fail ONLY the job's waiter; a non-Exception
+        (KeyboardInterrupt and kin) still propagates to the loop's
+        failure path after waking the waiter."""
+        try:
+            if job["kind"] == "export":
+                job["result"] = self._kv_export(job["tokens"],
+                                                job.get("src_epoch"))
+            else:
+                job["result"] = self._kv_import(job["meta"], job["rows"])
+        except BaseException as e:  # noqa: BLE001 - job-scoped verdict
+            job["error"] = e
+            if not isinstance(e, Exception):
+                job["done"].set()
+                raise
+        job["done"].set()
+
+    def _kv_export(self, tokens, src_epoch):
+        """Scheduler-thread half of :meth:`export_prefix`."""
+        if not self._paged:
+            return None
+        # walk-and-pin atomically: a concurrent drop_cache between the
+        # walk and a separate acquire could free a block mid-export
+        chain = self._pool.resident_chain(tokens, acquire=True)
+        if not chain:
+            return None
+        ids = [bid for bid, _ in chain]
+        t0 = time.monotonic()
+        try:
+            rows = self._generation.gather_block_rows(self._cache, ids)
+        finally:
+            self._pool.release(ids)
+        bs = self.kv_block_size
+        meta = {"tokens": list(tokens)[:len(ids) * bs],
+                "block_size": bs,
+                "kv_dtype": self.kv_dtype,
+                "origins": [origin for _, origin in chain],
+                "src_replica": self.replica_id,
+                "src_epoch": src_epoch}
+        buffers = kvship.pack(meta, rows)
+        t1 = time.monotonic()
+        self.flight.span("kv.pack", t0, t1, blocks=len(ids),
+                         bytes=frames.frame_bytes(buffers))
+        return buffers, meta
+
+    def _kv_import(self, meta, rows):
+        """Scheduler-thread half of :meth:`import_prefix`."""
+        if not self._paged:
+            raise SpliceRejected(
+                "unpaged", "target engine has no block pool")
+        bs = self.kv_block_size
+        if int(meta.get("block_size") or 0) != bs:
+            raise SpliceRejected(
+                "block_size",
+                "shipment block_size {!r} != pool block_size {}"
+                .format(meta.get("block_size"), bs))
+        if meta.get("kv_dtype") != self.kv_dtype:
+            raise SpliceRejected(
+                "kv_dtype",
+                "shipment kv_dtype {!r} != pool kv_dtype {!r} — ship "
+                "endpoints must share pool dtype (no requant on splice)"
+                .format(meta.get("kv_dtype"), self.kv_dtype))
+        tokens = list(meta.get("tokens") or ())
+        n = len(tokens) // bs
+        if n <= 0:
+            return {"spliced_blocks": 0, "skipped_blocks": 0, "bytes": 0}
+        rows = [(key, np.asarray(arr)) for key, arr in rows]
+        for key, arr in rows:
+            if arr.shape[:1] != (n,):
+                raise SpliceRejected(
+                    "malformed",
+                    "row {!r} carries {} block(s), token chain spans {}"
+                    .format(key, arr.shape[0] if arr.ndim else 0, n))
+        origins = list(meta.get("origins") or ())
+        origins += ["prompt"] * (n - len(origins))
+        t0 = time.monotonic()
+        # resident-chain dedupe = idempotence: whatever prefix of the
+        # shipped chain this pool already holds (an earlier delivery of
+        # this same shipment, or plain local traffic) is skipped, so a
+        # double splice is a no-op and never double-allocates
+        skip = len(self._pool.resident_chain(tokens))
+        if skip >= n:
+            return {"spliced_blocks": 0, "skipped_blocks": n, "bytes": 0}
+        try:
+            ids = self._pool.alloc(n - skip)
+        except paging.PoolExhausted as e:
+            raise SpliceRejected("pool_exhausted", str(e))
+        novel = [(key, arr[skip:n]) for key, arr in rows]
+        try:
+            self._cache = self._generation.scatter_block_rows(
+                self._cache, ids, novel)
+        except ValueError as e:
+            self._pool.release(ids)  # unregistered -> straight to free
+            raise SpliceRejected("malformed", str(e))
+        except Exception:
+            self._pool.release(ids)
+            raise
+        for j, bid in enumerate(ids):
+            # first-writer-wins: a chain link registered concurrently
+            # by local traffic keeps ITS block; ours stays private and
+            # the release below returns it to the free list — no leak
+            self._pool.register(tokens, (skip + j + 1) * bs, bid,
+                                origin=origins[skip + j])
+        # registered blocks park in the LRU (hittable, evictable) —
+        # exactly the state a locally-prefilled-and-released prefix
+        # would be in, which is why the follow-up :generate admission
+        # path needs no disaggregation awareness at all
+        self._pool.release(ids)
+        self._publish_kv_gauges()
+        t1 = time.monotonic()
+        n_bytes = sum(int(arr.nbytes) for _, arr in novel)
+        self.flight.span("kv.splice", t0, t1, blocks=len(ids),
+                         bytes=n_bytes)
+        with self._cv:
+            self.kv_counters.inc("spliced_blocks", len(ids))
+            self.kv_counters.inc("spliced_bytes", n_bytes)
+        return {"spliced_blocks": len(ids), "skipped_blocks": skip,
+                "bytes": n_bytes}
+
+    def note_ship(self, blocks, n_bytes, seconds):
+        """Record one SUCCESSFUL shipment leaving this replica:
+        physical wire bytes (codes + scales as transferred) and wall
+        time. Handler threads are multi-writer and ``Counters.inc`` is
+        read-modify-write, so mutation happens under ``_cv`` — same
+        rule for every kv_counters writer."""
+        with self._cv:
+            self.kv_counters.inc("ship_blocks", int(blocks))
+            self.kv_counters.inc("ship_bytes", int(n_bytes))
+        self._hist_ship.observe(seconds * 1000.0)
+
+    def note_splice_failure(self, reason):
+        """Count one refused/failed splice under its bounded reason
+        label (rendered as ``tfos_splice_failures_total{reason=...}``
+        by the server's metrics surface)."""
+        with self._cv:
+            self._splice_failures[reason] = \
+                self._splice_failures.get(reason, 0) + 1
+
+    def splice_failures(self):
+        """``{reason: count}`` snapshot for the metrics surface."""
+        with self._cv:
+            return dict(self._splice_failures)
 
     def _preempt(self, slot):
         """Free a slot's blocks under pool exhaustion and requeue its
@@ -2585,8 +2870,21 @@ class ModelServer(object):
         #: retirement need a transport, and the replica's own HTTP
         #: server is it). Empty by default: a server that registered
         #: nothing (driver-local fleets, plain model servers) answers
-        #: 404 for the whole /admin/ space.
+        #: 404 for the rest of the /admin/ space.
         self._admin = {}
+        #: splice fence floors (PR 17): src replica_id -> minimum
+        #: ACCEPTED epoch (exclusive). A shipment claiming an epoch at
+        #: or below the floor — or none — is refused 409 "fenced": the
+        #: supervisor raises the floor (broadcast /admin/ship_fence)
+        #: the moment it replaces/retires a prefill replica, so an
+        #: orphaned in-flight shipment from the dead incarnation can
+        #: never splice after its blocks' identity was reallocated.
+        self._ship_fence = {}
+        self._ship_fence_lock = threading.Lock()
+        # pre-registered (unlike the lifecycle RPCs above): every
+        # replica must accept fence broadcasts, including driver-local
+        # ones that never registered drain/respawn
+        self.register_admin("ship_fence", self._admin_ship_fence)
 
     # -- request handling ------------------------------------------------
 
@@ -2766,6 +3064,144 @@ class ModelServer(object):
                     "generation did not complete within {}s"
                     .format(timeout))
         return handle.result(0.1)
+
+    # -- KV shipping surface (PR 17 disaggregation) ------------------------
+
+    def prefill(self, payload, trace=None):
+        """POST :prefill — the prefill-tier entry point of two-stage
+        dispatch. ``{'prompt': [t, ...], 'session'?, 'src_epoch'?,
+        'ship'?: {'addr': 'host:port', 'replica_id'?, 'epoch'?}}``.
+
+        Runs the prompt through the NORMAL admission path as a 1-token
+        generation (so bucketing, admission control, chaos sites and
+        prefix registration all apply), then exports the now-resident
+        block chain and — when ``ship`` names a decode-tier peer —
+        delivers it to that peer's ``/kv/splice``. Ship failure is NOT
+        request failure: the response still answers 200 with
+        ``shipped: false`` and a reason, and the decode replica simply
+        re-prefills cold on the follow-up :generate — correctness
+        never rides the shipment. ``src_epoch`` (this replica's lease
+        epoch, stamped by the router) travels in the shipment header
+        so the receiver's fence floor can veto a superseded sender."""
+        engine = self.engine
+        if engine is None:
+            raise _BadRequest("no decode engine mounted on this server")
+        if self._fenced is not None:
+            raise Fenced("replica is fenced: " + self._fenced)
+        if not isinstance(payload, dict) or "prompt" not in payload:
+            raise _BadRequest("request needs a 'prompt' field")
+        prompt = payload["prompt"]
+        if not isinstance(prompt, list) or not prompt \
+                or isinstance(prompt[0], (list, tuple)):
+            raise _BadRequest(":prefill takes ONE flat token list")
+        session = payload.get("session")
+        if session is not None and not isinstance(session, str):
+            raise _BadRequest("session must be a string")
+        try:
+            vetted = engine.validate(prompt, 1)
+        except (ValueError, TypeError) as e:
+            raise _BadRequest(str(e))
+        handles = engine._submit_many([vetted], trace=trace,
+                                      session=session)
+        handles[0].result(600.0)
+        out = {"prefilled": True, "blocks": 0, "shipped": False}
+        export = engine.export_prefix(
+            prompt, src_epoch=payload.get("src_epoch"))
+        if export is None:
+            # nothing resident to ship (sub-block prompt or unpaged
+            # engine) — the prefill itself still happened
+            return out
+        buffers, meta = export
+        out["blocks"] = len(meta["origins"])
+        ship = payload.get("ship")
+        if not isinstance(ship, dict) or not ship.get("addr"):
+            return out
+        n_bytes = frames.frame_bytes(buffers)
+        t0 = time.monotonic()
+        try:
+            status, body, transport = kvship.ship(
+                ship["addr"], buffers, src=self.replica_id,
+                dst=ship.get("replica_id"))
+        except (kvship.ShipError, chaos.NetPartitioned) as e:
+            out["reason"] = str(e)
+            return out
+        t1 = time.monotonic()
+        if status != 200:
+            try:
+                out["reason"] = json.loads(body).get("error", "")
+            except (ValueError, AttributeError):
+                out["reason"] = "splice answered {}".format(status)
+            return out
+        # accounting only on a CONFIRMED splice: a dropped response
+        # (chaos) raised above, so shipped bytes are never claimed for
+        # a delivery this side cannot prove
+        engine.note_ship(out["blocks"], n_bytes, t1 - t0)
+        engine.flight.span("kv.ship", t0, t1, trace=trace or 0,
+                           blocks=out["blocks"], bytes=n_bytes,
+                           transport=transport)
+        out["shipped"] = True
+        out["bytes"] = n_bytes
+        out["transport"] = transport
+        try:
+            out["splice"] = json.loads(body)
+        except ValueError:
+            pass
+        return out
+
+    def splice_shipment(self, meta, rows):
+        """Fence-check one decoded shipment, then splice it into the
+        mounted engine (the body of ``POST /kv/splice``). All refusal
+        paths count into ``tfos_splice_failures_total{reason=...}``."""
+        engine = self.engine
+        if engine is None or not hasattr(engine, "import_prefix"):
+            raise SpliceRejected("engine", "no decode engine mounted")
+        src = meta.get("src_replica")
+        epoch = meta.get("src_epoch")
+        with self._ship_fence_lock:
+            floor = None if src is None \
+                else self._ship_fence.get(str(src))
+        if floor is not None and \
+                (epoch is None or int(epoch) <= int(floor)):
+            # the PR 12 epoch fence, applied to the SHIP plane: a
+            # shipment from a replaced/retired incarnation must never
+            # splice — its pool identity is gone and a replacement may
+            # be shipping the same chains under a newer epoch
+            engine.note_splice_failure("fenced")
+            raise SpliceRejected(
+                "fenced",
+                "shipment from {} at epoch {} is below fence floor {}"
+                .format(src, epoch, floor))
+        try:
+            return engine.import_prefix(meta, rows)
+        except SpliceRejected as e:
+            engine.note_splice_failure(e.reason)
+            raise
+        except (Retriable, TimeoutError):
+            engine.note_splice_failure("engine")
+            raise
+
+    def ship_fence(self, replica_id, min_epoch):
+        """Raise the splice fence floor for shipments claiming
+        ``replica_id`` (monotonic — a floor never lowers). Exposed as
+        ``POST /admin/ship_fence``; the fleet supervisor broadcasts it
+        to every live replica when it replaces or retires a prefill
+        replica, BEFORE the replacement spawns."""
+        rid = str(replica_id)
+        with self._ship_fence_lock:
+            cur = self._ship_fence.get(rid)
+            if cur is None or int(min_epoch) > cur:
+                self._ship_fence[rid] = int(min_epoch)
+            floor = self._ship_fence[rid]
+        logger.info("ship fence: shipments from %s now need epoch > %d",
+                    rid, floor)
+        return {"replica_id": rid, "min_epoch": floor}
+
+    def _admin_ship_fence(self, payload):
+        if not isinstance(payload, dict) or \
+                payload.get("replica_id") is None:
+            raise ValueError("ship_fence needs a replica_id")
+        return self.ship_fence(payload["replica_id"],
+                               payload.get("min_epoch", 0))
 
     def metadata(self):
         return {"model_spec": {"name": self.name,
@@ -2957,6 +3393,19 @@ class ModelServer(object):
             info += ('# TYPE tfos_serving_kv_dtype gauge\n'
                      'tfos_serving_kv_dtype{{dtype="{}"}} 1\n'
                      .format(kv_dtype))
+        # per-reason splice refusals (PR 17): label-valued counter
+        # rendered here because the engine's Counters carry no labels;
+        # sample name keeps the mandatory _total suffix the scrape
+        # contract (tests/test_observability.py) enforces
+        failures = getattr(engine, "splice_failures", None)
+        if callable(failures):
+            counts = failures()
+            if counts:
+                info += "# TYPE tfos_splice_failures counter\n"
+                for reason in sorted(counts):
+                    info += ('tfos_splice_failures_total'
+                             '{{reason="{}"}} {}\n'
+                             .format(reason, counts[reason]))
         if info:
             text = text.replace("# EOF\n", info + "# EOF\n")
         return text
@@ -3108,6 +3557,75 @@ class ModelServer(object):
                 except (OSError, ValueError):
                     return True
 
+            def _kv_splice(self):
+                """POST /kv/splice (PR 17): adopt one shipped KV
+                prefix. Body is the raw frames-coded shipment — or
+                empty with ``X-TFOS-KV-Via: shm``, in which case the
+                shipment sits in the named shm ring (the co-hosted
+                zero-copy path) and this request is just the notify.
+                Splicing happens while the source buffer is alive
+                (the rows are zero-copy views), then the ring slot
+                releases."""
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                except ValueError:
+                    n = 0
+                # always consume the body first: even refusal paths
+                # must leave the connection in a sane state
+                body = self.rfile.read(n) if n else b""
+                if server._fenced is not None:
+                    return self._send(
+                        410, {"error": "replica is fenced: "
+                              + server._fenced, "kind": "Fenced"})
+                if server._draining:
+                    return self._send(
+                        503, {"error": "server is draining",
+                              "kind": "Draining"},
+                        headers={"Retry-After": "5"})
+                try:
+                    if self.headers.get("X-TFOS-KV-Via") == "shm":
+                        ring, lock = kvship.consumer_ring(
+                            self.headers.get("X-TFOS-KV-Ring", ""))
+                        with lock:
+                            view, release = ring.read_view(timeout=5.0)
+                            try:
+                                meta, rows = kvship.unpack(view)
+                                result = server.splice_shipment(
+                                    meta, rows)
+                            finally:
+                                release()
+                    else:
+                        meta, rows = kvship.unpack(body)
+                        result = server.splice_shipment(meta, rows)
+                except SpliceRejected as e:
+                    # deliberate refusal: 409, reason-tagged — the
+                    # shipping side gives up (no retry loop can fix a
+                    # fence or a dtype mismatch) and lets the decode
+                    # replica re-prefill cold
+                    return self._send(
+                        409, {"error": str(e), "reason": e.reason,
+                              "kind": "SpliceRejected"})
+                except ValueError as e:
+                    # malformed frame / unknown wire version
+                    engine = server.engine
+                    if hasattr(engine, "note_splice_failure"):
+                        engine.note_splice_failure("malformed")
+                    return self._send(400, {"error": str(e)})
+                except OSError as e:
+                    # named ring unreachable (producer died / swept)
+                    engine = server.engine
+                    if hasattr(engine, "note_splice_failure"):
+                        engine.note_splice_failure("engine")
+                    return self._send(503, {"error": str(e)},
+                                      headers={"Retry-After": "1"})
+                except (Retriable, TimeoutError) as e:
+                    return self._send(503, {"error": str(e)},
+                                      headers={"Retry-After": "1"})
+                except Exception as e:  # noqa: BLE001 - surface 500
+                    logger.exception("/kv/splice failed")
+                    return self._send(500, {"error": str(e)})
+                return self._send(200, result)
+
             def do_GET(self):
                 if self.path == "/healthz":
                     return self._send(*server.healthz())
@@ -3170,12 +3688,20 @@ class ModelServer(object):
                 # carries the same id — the dedup window's join key
                 request_id = self.headers.get("X-TFOS-Request-Id") \
                     or None
+                if self.path == "/kv/splice":
+                    # raw octet-stream branch (PR 17): the body is a
+                    # frames-coded shipment (or an shm notify), never
+                    # JSON — it must branch before the JSON parse below
+                    return self._kv_splice()
                 routes = {"/v1/models/%s:predict" % server.name:
                           server.predict,
                           "/v1/models/%s:generate" % server.name:
                           lambda payload: server.generate(
                               payload, client_gone=self._client_gone,
-                              trace=trace, request_id=request_id)}
+                              trace=trace, request_id=request_id),
+                          "/v1/models/%s:prefill" % server.name:
+                          lambda payload: server.prefill(
+                              payload, trace=trace)}
                 handler = routes.get(self.path)
                 if handler is None:
                     return self._send(404,

@@ -97,7 +97,7 @@ def _load():
         return lib
 
 
-def sweep_stale(executor_id=None):
+def sweep_stale(executor_id=None, pattern=None):
     """Unlink rings whose creating process is dead; returns names removed.
 
     SIGKILL is the one exit the atexit/shutdown cleanups cannot cover
@@ -110,12 +110,19 @@ def sweep_stale(executor_id=None):
     cluster's live rings, whose pids are alive); unscoped from the
     engine driver's stop() on hosts it owns. pid-less legacy names are
     left alone — liveness is unknowable for them.
+
+    ``pattern`` (a ``/dev/shm`` glob) narrows the sweep to one ring
+    family instead of one executor slot — the serving bootstrap reaps
+    only KV-ship rings (``/dev/shm/tfos-kvship-*.*``, PR 17) this way,
+    leaving a co-hosted training cluster's feed rings alone even when
+    their liveness proof would pass.
     """
     import glob
     import re
 
-    pat = ("/dev/shm/tfos-*-{}.*".format(executor_id)
-           if executor_id is not None else "/dev/shm/tfos-*.*")
+    pat = pattern if pattern is not None else (
+        "/dev/shm/tfos-*-{}.*".format(executor_id)
+        if executor_id is not None else "/dev/shm/tfos-*.*")
     removed = []
     for path in glob.glob(pat):
         base = os.path.basename(path)
@@ -187,6 +194,31 @@ def default_capacity():
     # record would fail mid-feed, whereas 0 makes node.py fall back to
     # the queue transport cleanly.
     return want if want >= MIN_USEFUL_CAPACITY else 0
+
+
+#: capacity of a co-hosted KV-ship ring (PR 17 disaggregation):
+#: shipments are a few blocks of int8 codes + scales — megabytes, not
+#: the feed plane's 38MB image frames — so a small EXPLICIT capacity
+#: beats :func:`default_capacity`'s feed-sized floor. ``create()``
+#: honors explicit capacities below MIN_USEFUL_CAPACITY by design:
+#: that floor guards the feed transport's fallback decision only.
+KVSHIP_CAPACITY = 16 * 1024 * 1024
+
+
+def kvship_ring_name(src_replica, dst_replica):
+    """Canonical shm segment name of the src->dst KV-ship ring.
+
+    The PREFILL side creates it (ShmRing's producer-side convention),
+    and the name embeds the creator pid exactly like the feed rings
+    (``/tfos-...<name>.<pid>``) so :func:`sweep_stale` can reap rings a
+    SIGKILLed prefill worker left behind. Replica ids are sanitized to
+    the shm-name alphabet (no dots: the pid suffix must stay the only
+    ``.``-delimited field, or the sweep's liveness regex misparses)."""
+    def _safe(s):
+        return "".join(ch if ch.isalnum() or ch in "-_" else "-"
+                       for ch in str(s))
+    return "/tfos-kvship-{}-{}.{}".format(
+        _safe(src_replica), _safe(dst_replica), os.getpid())
 
 
 class ShmRing(object):

@@ -414,7 +414,7 @@ METRIC_FAMILIES = {
                           "attempts (pick + failover bookkeeping)"),
     "tfos_fleet_stage_seconds":
         ("counter", "stage", "router wall seconds per stage "
-                             "(pick / upstream)"),
+                             "(pick / upstream / prefill)"),
     "tfos_fleet_stage_samples":
         ("counter", "stage", "samples behind tfos_fleet_stage_seconds"),
     "tfos_fleet_replica_up":
@@ -443,6 +443,34 @@ METRIC_FAMILIES = {
     "tfos_fleet_affinity_entries":
         ("gauge", "", "live session -> replica entries in the "
                       "router's TTL'd affinity map"),
+    # -- prefill/decode disaggregation: two-stage dispatch (PR 17) --
+    "tfos_fleet_prefill_dispatches":
+        ("counter", "", "staged :prefill calls the two-stage "
+                        "dispatcher sent to the prefill tier"),
+    "tfos_fleet_prefill_ships":
+        ("counter", "", "staged prefills whose KV blocks were "
+                        "confirmed shipped to the chosen decode "
+                        "replica (the decode attempt then lands "
+                        "warm)"),
+    "tfos_fleet_prefill_skips":
+        ("counter", "", "stages skipped because the chosen decode "
+                        "replica already held the prompt's prefix "
+                        "(digest match — nothing to ship)"),
+    "tfos_fleet_prefill_misses":
+        ("counter", "", "staged prefills that completed WITHOUT a "
+                        "confirmed ship (splice refused, transport "
+                        "failed, or unshippable) — the decode side "
+                        "re-prefills cold"),
+    "tfos_fleet_prefill_errors":
+        ("counter", "", "prefill stages abandoned on a transport/"
+                        "routing error (partitioned or dead prefill "
+                        "tier; the request degrades to single-stage "
+                        "dispatch)"),
+    "tfos_fleet_replica_tier":
+        ("gauge", "replica,tier", "constant 1 joining each replica to "
+                                  "its serving tier (prefill / decode "
+                                  "/ mixed) — the disaggregation "
+                                  "topology at a glance"),
     # -- executor-hosted serving + SLO autoscaler (PR 13) --
     "tfos_serving_replica_host":
         ("gauge", "replica_id,executor", "constant 1 joining each "
@@ -546,6 +574,34 @@ METRIC_FAMILIES = {
         ("counter", "", "span events evicted from the FlightRecorder "
                         "ring (capacity overflow — raise capacity or "
                         "dump more often if this grows)"),
+    # -- KV shipping plane (PR 17 prefill/decode disaggregation) --
+    "tfos_kv_ship_bytes":
+        ("counter", "", "PHYSICAL bytes of KV shipments successfully "
+                        "delivered from this replica (codes + scales "
+                        "as transferred — an int8 pool ships ~3.2x "
+                        "fewer bytes than the dequantized size; never "
+                        "priced logically)"),
+    "tfos_kv_ship_blocks":
+        ("counter", "", "KV blocks successfully shipped from this "
+                        "replica to a decode-tier peer"),
+    "tfos_kv_spliced_bytes":
+        ("counter", "", "physical bytes of NOVEL shipped rows spliced "
+                        "into this replica's pool (dedupe-skipped "
+                        "blocks contribute nothing)"),
+    "tfos_kv_spliced_blocks":
+        ("counter", "", "shipped blocks adopted into this replica's "
+                        "pool by block-table splice"),
+    "tfos_kv_ship_ms":
+        ("histogram", "", "wall milliseconds per successful shipment "
+                          "(pack + transport + splice verdict, as the "
+                          "shipping side observes it)"),
+    "tfos_splice_failures":
+        ("counter", "reason", "shipments this replica refused or "
+                              "failed to splice, by bounded reason "
+                              "(fenced / block_size / kv_dtype / "
+                              "pool_exhausted / malformed / unpaged / "
+                              "engine) — 'fenced' growing means a "
+                              "retired incarnation is still shipping"),
 }
 
 

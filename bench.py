@@ -67,7 +67,11 @@ Prints ONE JSON line. Fields:
                          better than the load-only baseline published
                          beside it, and hot-session-skew p99 within
                          1.5x of pure load balancing (the load
-                         guard).
+                         guard). The ``qos`` subleg (PR 18) publishes
+                         the antagonist isolation factor (quiet-tenant
+                         p99 flooded / solo), HIGH-class preemption
+                         TTFT p50/p99 into a LOW-saturated engine, and
+                         the 3:1 weighted fair-share convergence time.
 - ``recovery``         — the supervision plane (PR 3): MTTR of an
                          injected mid-job trainer SIGKILL under
                          ``cluster.run(..., supervise=...)``, with the
@@ -1508,6 +1512,190 @@ def _disagg_leg(slots=4, n_prefill=1, n_decode=2, bombers=6,
     return out
 
 
+def _qos_leg(slots=4, block_size=16, kv_blocks=192, quiet_reqs=10,
+             antagonists=3, high_probes=8):
+    """serving_fleet.qos (PR 18): the three numbers the QoS plane is
+    for, measured on the live engine rather than asserted.
+
+    ``isolation`` — a quiet HIGH-class tenant's request p99 while an
+    antagonist floods the same engine at LOW class (the interactive
+    tier vs batch tier split docs/qos.md recommends), over its SOLO
+    p99 on the idle warmed engine (the chaos test pins the
+    bounded-factor contract; the bench publishes the measured
+    factor). Class preemption is what keeps this near 1: the plan
+    names a LOW victim the moment the HIGH request is blocked, so
+    the quiet tenant never waits out the antagonist's whole queue.
+
+    ``preemption`` — HIGH-class time-to-first-token while every slot
+    is held by LOW-class long sequences: the submit->first-token wall
+    IS the preemption latency (plan names a victim at the next step
+    boundary, the freed slot prefills the HIGH request). p50/p99 over
+    ``high_probes`` sequential probes.
+
+    ``fair_share`` — two flooding tenants at weights 3:1; convergence
+    time is the first moment the cumulative admitted ratio (read from
+    ``engine.qos_tallies()`` — the same tallies the /metrics scrape
+    renders as ``tfos_qos_admitted_total``) lands within 25% of the
+    configured ratio and the deficit scheduler keeps it there."""
+    import math
+    import threading
+
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu import metrics_report, serving
+
+    train, dec = _serving_model(False)
+    params = train.init(jax.random.PRNGKey(0),
+                        np.zeros((1, dec.max_len), np.int32))["params"]
+    engine_kw = {"slots": slots, "kv_block_size": block_size,
+                 "kv_blocks": kv_blocks}
+    rs = np.random.RandomState(23)
+
+    def pctl(walls, q):
+        if not walls:
+            return None
+        walls = sorted(walls)
+        return walls[min(len(walls) - 1,
+                         int(math.ceil(q * len(walls))) - 1)]
+
+    quiet_prompts = [[int(t) for t in rs.randint(1, dec.vocab, 8)]
+                     for _ in range(quiet_reqs)]
+
+    def quiet_pass(eng):
+        walls = []
+        for p in quiet_prompts:
+            t0 = time.monotonic()
+            eng.submit(p, 16, tenant="quiet",
+                       priority="high").result(600)
+            walls.append(time.monotonic() - t0)
+        return walls
+
+    # --- isolation: solo baseline, then the same pass under flood ---
+    with serving.DecodeEngine(dec, params, **engine_kw) as eng:
+        quiet_pass(eng)  # warm every program/bucket off the clock
+        solo = quiet_pass(eng)
+        stop = threading.Event()
+
+        def flood(i):
+            brs = np.random.RandomState(200 + i)
+            while not stop.is_set():
+                prompt = [int(t) for t in brs.randint(1, dec.vocab, 16)]
+                try:
+                    eng.submit(prompt, 32, tenant="antagonist",
+                               priority="low").result(600)
+                except serving.QueueFull:
+                    stop.wait(0.01)
+                except Exception:  # noqa: BLE001 - teardown race
+                    break
+
+        threads = [threading.Thread(target=flood, args=(i,), daemon=True)
+                   for i in range(antagonists)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # flood reaches steady state
+        # first flooded pass absorbs the one-time prefill-bucket
+        # compiles the flood regime introduces (preemption
+        # continuations are novel prompt lengths); steady state is
+        # the second pass — the chaos test drops warm-up the same way
+        quiet_pass(eng)
+        flooded = quiet_pass(eng)
+        stop.set()
+        for t in threads:
+            t.join(timeout=600)
+        qos_plan_ms = metrics_report.stage_ms(eng.timers).get("qos_plan")
+    isolation = {
+        "quiet_solo_p99_ms": round(pctl(solo, 0.99) * 1e3, 1),
+        "quiet_flooded_p99_ms": round(pctl(flooded, 0.99) * 1e3, 1),
+        "antagonists": antagonists,
+    }
+    isolation["factor"] = round(isolation["quiet_flooded_p99_ms"]
+                                / isolation["quiet_solo_p99_ms"], 2)
+
+    # --- preemption latency: HIGH TTFT into a LOW-saturated engine ---
+    ttfts = []
+    with serving.DecodeEngine(dec, params, **engine_kw) as eng:
+        eng.submit(quiet_prompts[0], 2, tenant="warm").result(600)
+        # 3x slots of LOW work so the queue refills every slot a LOW
+        # sequence (or a preemption victim) vacates — each probe meets
+        # a genuinely saturated engine, not the tail of a drained one
+        low = [eng.submit([int(t) for t in rs.randint(1, dec.vocab, 8)],
+                          128, tenant="bg", priority="low")
+               for _ in range(slots * 3)]
+        deadline = time.monotonic() + 30
+        while (eng.load_stats()["slot_occupancy"] < slots
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        # probe 0 is discarded: the first preemption's continuation
+        # re-prefill (prompt + emitted tokens, a novel length) pays a
+        # one-time bucket compile that is not preemption latency
+        for probe in range(high_probes + 1):
+            t0 = time.monotonic()
+            h = eng.submit([int(t) for t in rs.randint(1, dec.vocab, 8)],
+                           4, tenant="urgent", priority="high")
+            first = None
+            # no break: abandoning a stream cancels the request
+            for _tok in h.stream(600):
+                if first is None:
+                    first = time.monotonic() - t0
+            if probe > 0:
+                ttfts.append(first)
+            h.result(600)
+        preempted = eng.qos_tallies()["preemptions"]
+        for h in low:
+            h.result(600)
+    preemption = {
+        "ttft_p50_ms": round(pctl(ttfts, 0.5) * 1e3, 1),
+        "ttft_p99_ms": round(pctl(ttfts, 0.99) * 1e3, 1),
+        "probes": high_probes,
+        "victims": sum(preempted.values()),
+    }
+
+    # --- fair-share convergence at weights 3:1 ---
+    policy = {"weights": {"heavy": 3.0, "light": 1.0}}
+    with serving.DecodeEngine(dec, params, qos_policy=policy,
+                              **engine_kw) as eng:
+        eng.submit(quiet_prompts[0], 2, tenant="warmup").result(600)
+        handles = []
+        for _ in range(40):
+            for tenant in ("heavy", "light"):
+                handles.append(eng.submit(
+                    [int(t) for t in rs.randint(1, dec.vocab, 8)],
+                    4, tenant=tenant))
+        # the contested window is while BOTH tenants still have queued
+        # work — once either side fully admits, the other rightly gets
+        # every slot and the cumulative ratio of a finite workload
+        # drifts to 1.0, which says nothing about fairness
+        t0 = time.monotonic()
+        converged_s = None
+        heavy = light = 0
+        while heavy < 40 and light < 40:
+            adm = eng.qos_tallies()["admitted"]
+            heavy = sum(n for (t, _), n in adm.items() if t == "heavy")
+            light = sum(n for (t, _), n in adm.items() if t == "light")
+            if light >= 4 and abs(heavy / light - 3.0) <= 0.75:
+                if converged_s is None:
+                    converged_s = time.monotonic() - t0
+            else:
+                converged_s = None  # drifted back out: not converged
+            time.sleep(0.01)
+        for h in handles:
+            h.result(600)
+    fair_share = {
+        "weights": {"heavy": 3.0, "light": 1.0},
+        "admitted_at_window_end": {"heavy": heavy, "light": light},
+        "contested_ratio": round(heavy / max(light, 1), 2),
+        "convergence_s": (round(converged_s, 3)
+                          if converged_s is not None else None),
+    }
+    return {
+        "isolation": isolation,
+        "preemption": preemption,
+        "fair_share": fair_share,
+        "qos_plan_ms_mean": qos_plan_ms,
+    }
+
+
 def _serving_fleet_bench(on_tpu, replica_counts=(1, 2, 4)):
     """Aggregate serving throughput at 1 vs 2 vs 4 router-fronted
     replicas on the shared mixed-length workload. Returns the
@@ -1583,6 +1771,16 @@ def _serving_fleet_bench(on_tpu, replica_counts=(1, 2, 4)):
             print("serving_fleet.disagg failed: {}".format(e),
                   file=sys.stderr)
             block["disagg"] = {"error": str(e)}
+    # multi-tenant QoS leg (PR 18): antagonist isolation factor,
+    # HIGH-class preemption TTFT, fair-share convergence time.
+    # TFOS_BENCH_QOS=0 skips just this leg.
+    if os.environ.get("TFOS_BENCH_QOS", "1") == "1":
+        try:
+            block["qos"] = _qos_leg()
+        except Exception as e:  # noqa: BLE001 - report, not die
+            print("serving_fleet.qos failed: {}".format(e),
+                  file=sys.stderr)
+            block["qos"] = {"error": str(e)}
     return block
 
 

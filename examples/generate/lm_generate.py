@@ -53,6 +53,15 @@ def main(argv=None):
                          "executor-role serving bootstrap) instead of "
                          "the driver — the demo prints each replica's "
                          "executor + pid so the placement is visible")
+    ap.add_argument("--tenant", default=None,
+                    help="tenant id attached to every --serve/--fleet "
+                         "request (PR 18 QoS plane); omitted => the "
+                         "engine's default tenant, identical behaviour "
+                         "to older builds")
+    ap.add_argument("--priority", default=None,
+                    choices=["high", "normal", "low"],
+                    help="priority class for the --serve/--fleet "
+                         "requests (default: normal)")
     ap.add_argument("--out", default=None,
                     help="write {loss, prompt, generated} JSON here")
     args = ap.parse_args(argv)
@@ -126,7 +135,9 @@ def main(argv=None):
         with serving.DecodeEngine(dec, params, slots=4,
                                   total_len=max_len) as eng:
             t0 = time.monotonic()
-            handles = [eng.submit(p, mn) for p, mn in reqs]
+            handles = [eng.submit(p, mn, tenant=args.tenant,
+                                  priority=args.priority)
+                       for p, mn in reqs]
             outs = [h.result(600) for h in handles]
             wall = time.monotonic() - t0
             tokens = eng.counters.snapshot()["counts"]["tokens"]
@@ -219,8 +230,17 @@ def main(argv=None):
             # pins follow-up turns of a conversation to the replica
             # whose prefix cache is warm for it — same wire contract,
             # one optional field
-            outs = [post({"prompt": p, "max_new_tokens": mn,
-                          "session": "demo-{}".format(i)})["tokens"]
+            # tenant / priority (PR 18) ride the same body: the router
+            # and the replica both read them, absent fields mean the
+            # default tenant at normal priority
+            qos_fields = {}
+            if args.tenant is not None:
+                qos_fields["tenant"] = args.tenant
+            if args.priority is not None:
+                qos_fields["priority"] = args.priority
+            outs = [post(dict({"prompt": p, "max_new_tokens": mn,
+                               "session": "demo-{}".format(i)},
+                              **qos_fields))["tokens"]
                     for i, (p, mn) in enumerate(reqs)]
             wall = time.monotonic() - t0
             mismatches = 0

@@ -11,6 +11,11 @@ attribution the engine's own tracing hooks collect:
                       per-step host sync that reads the emitted tokens
 - ``host_schedule`` — pure scheduler bookkeeping between steps
                       (admission scans, EOS checks, stream delivery)
+- ``qos_plan``      — multi-tenant QoS (PR 18): the weighted-fair
+                      admission plan (bucket grouping + deficit
+                      selection + quota/preemption decisions) inside
+                      each scheduler pass — budget is <50µs/plan,
+                      pinned loosely in tests/test_qos.py
 - ``prefix_lookup`` — paged KV (PR 8): prefix-cache chain match at
                       admission (the TTFT attribution for warm hits)
 - ``block_alloc``   — paged KV: free-list allocation + LRU eviction at

@@ -143,6 +143,10 @@ def replica_view(rid, info):
         "alive": gauges.get("alive", False),
         "draining": bool(gauges.get("draining")),
         "queue_depth": int(gauges.get("queue_depth") or 0),
+        # per-priority queue split (PR 18): lets decide() tell a HIGH-
+        # class breach (buy hardware) from LOW-only backlog (declared
+        # soak load — tolerate). Empty on engines predating the gauge.
+        "queue_by_class": dict(gauges.get("queue_by_class") or {}),
         "slot_occupancy": int(gauges.get("slot_occupancy") or 0),
         "slots": slots,
         "queue_wait_ewma_s": float(gauges.get("queue_wait_ewma_s")
@@ -281,10 +285,19 @@ def _decide_pool(policy, views, state, now, tier=None):
     max_qwait = max(v["queue_wait_ewma_s"] for v in live)
     ttfts = [v["ttft_p99_s"] for v in live if v["ttft_p99_s"] is not None]
     completed = sum(v["completed"] for v in live)
+    by_class = {"high": 0, "normal": 0, "low": 0}
+    for v in live:
+        for cls, n in (v.get("queue_by_class") or {}).items():
+            if cls in by_class:
+                try:
+                    by_class[cls] += int(n)
+                except (TypeError, ValueError):
+                    continue
     evidence.update(occupancy=round(occupancy, 3), queue_depth=queue,
                     max_queue_wait_ewma_s=round(max_qwait, 4),
                     ttft_p99_s=round(max(ttfts), 4) if ttfts else None,
-                    completed=completed)
+                    completed=completed,
+                    queue_by_class=dict(by_class))
     # -- evidence-gated cold start: a fleet that has served nothing
     # and holds no work must not scale on the absence of evidence
     if completed == 0 and queue == 0 and occupancy == 0.0:
@@ -309,6 +322,20 @@ def _decide_pool(policy, views, state, now, tier=None):
                 occupancy, queue))
     if breach:
         reason = "; ".join(breach)
+        # per-priority breach view (PR 18): a backlog made ENTIRELY of
+        # LOW-class work is declared soak load — it opted into waiting
+        # (absorbing idle capacity is its whole job), so it tolerates
+        # the breach instead of buying hardware; any HIGH/normal work
+        # standing in the queue scales as before. Guarded on the class
+        # tally accounting for the WHOLE queue: replicas predating the
+        # gauge report nothing, and an unaccounted backlog must keep
+        # the legacy scale-up behavior.
+        if by_class["high"] + by_class["normal"] == 0 \
+                and by_class["low"] >= queue:
+            return ScaleDecision(
+                ScaleDecision.HOLD,
+                "LOW-class-only backlog tolerated: " + reason,
+                evidence=evidence, tier=tier)
         if len(live) >= policy.max_replicas:
             return ScaleDecision(
                 ScaleDecision.HOLD,

@@ -54,6 +54,7 @@ import collections
 import http.client
 import json
 import logging
+import math
 import os
 import socket
 import threading
@@ -62,6 +63,9 @@ import uuid
 
 from tensorflowonspark_tpu import chaos, paging, reservation, serving, \
     tracing
+from tensorflowonspark_tpu.qos import (
+    DEFAULT_PRIORITY, QosPolicy, QuotaExceeded, QuotaTable,
+    validate_priority, validate_tenant)
 
 logger = logging.getLogger(__name__)
 
@@ -764,6 +768,11 @@ class ServingNode(object):
         else:
             model, params = spec["model"], spec["params"]
         kw = dict(spec.get("engine_kw") or {})
+        # QoS policy (PR 18) may ride its own top-level spec key —
+        # operators keep the tenant policy (weights/quotas) separate
+        # from engine spawn knobs; an explicit engine_kw wins
+        if "qos" in spec:
+            kw.setdefault("qos_policy", spec["qos"])
         kw.setdefault("flight", tracing.FlightRecorder())
         engine = DecodeEngine(model, params,
                               replica_id=self.replica_id, **kw)
@@ -1101,7 +1110,7 @@ class FleetRouter(object):
                  affinity_capacity=2048,
                  load_guard=DEFAULT_LOAD_GUARD,
                  affinity_enabled=True, two_stage=True,
-                 prefill_timeout=120.0):
+                 prefill_timeout=120.0, qos=None):
         self.reservation = reservation_server
         self.name = name
         self.replicas = list(replicas or [])
@@ -1151,6 +1160,19 @@ class FleetRouter(object):
         #: the KV ship; generous because a missed stage only costs a
         #: cold decode-side prefill, never a failed request)
         self.prefill_timeout = float(prefill_timeout)
+        #: multi-tenant QoS at the router (PR 18): the same per-tenant
+        #: token-bucket quotas the engines enforce, checked BEFORE any
+        #: upstream attempt — an over-quota tenant is refused in one
+        #: hop instead of burning failover attempts fleet-wide. None =
+        #: no router-side quotas (engine-side enforcement still holds
+        #: for direct-API callers).
+        self.qos_policy = QosPolicy.from_spec(qos)
+        self._quota = QuotaTable(self.qos_policy)
+        #: (warm_rid, cold_rid) pre-warms currently in flight (PR 18
+        #: predictive placement; guarded by _obs_lock) — one shipment
+        #: per pair at a time, so a burst of guarded dispatches can't
+        #: stampede the saturated warm replica with prefill POSTs
+        self._prewarm_inflight = set()
         self.affinity = AffinityMap(capacity=affinity_capacity,
                                     ttl_s=affinity_ttl)
         #: reason -> count behind tfos_fleet_affinity_breaks{reason}
@@ -1245,6 +1267,13 @@ class FleetRouter(object):
                 # count the load guard's saturation check reads, and
                 # the truncation-honesty flag (zero schema —
                 # empty/0/False — on contiguous replicas)
+                # multi-tenant QoS (PR 18): per-tenant queued/active/
+                # token gauges plus the per-class queue split, beat-
+                # carried so dispatch can spread one tenant's burst
+                # across replicas and the autoscaler can tell a HIGH-
+                # class breach from LOW-only backlog
+                "queue_by_class": gauges.get("queue_by_class") or {},
+                "tenants": gauges.get("tenants") or {},
                 "slots": gauges.get("slots", 0),
                 "prefix_digest": gauges.get("prefix_digest") or [],
                 "prefix_digest_block_size": gauges.get(
@@ -1314,6 +1343,29 @@ class FleetRouter(object):
         # its own 400; the router must not pre-judge it
         session, prompt_tokens = self._affinity_inputs(raw_body) \
             if self.affinity_enabled or self.two_stage else (None, None)
+        # tenant identity (PR 18), parsed once like the affinity keys:
+        # a malformed tenant/priority routes under the DEFAULTS and
+        # the upstream answers the authoritative 400 — the router must
+        # not pre-judge a body it cannot parse
+        tenant, priority = self._qos_inputs(raw_body)
+        # router-side quota gate: the same post-paid buckets the
+        # engines enforce, checked BEFORE any upstream attempt so an
+        # over-quota tenant is refused in one hop. Charged below by
+        # the tokens the winning response actually delivered — one
+        # dispatch returns once no matter how many failover/hedge
+        # attempts ran, and the replicas' DedupWindow means those
+        # duplicates generated nothing extra, so the accounting stays
+        # exact with no double-charge.
+        try:
+            self._quota.admit(tenant)
+        except QuotaExceeded as e:
+            with self._obs_lock:
+                self.counters.inc("requests")
+                self.counters.inc("quota_rejections")
+            body = json.dumps(
+                {"error": str(e), "kind": "QuotaExceeded",
+                 "tenant": tenant}).encode()
+            return 429, body, max(1, int(math.ceil(e.retry_after)))
         # two-stage dispatch (PR 17): prefill placement + KV ship run
         # BEFORE the decode attempt, so by the time the :generate
         # lands, the decode replica's pool already holds the prompt's
@@ -1330,10 +1382,25 @@ class FleetRouter(object):
                         raw_body, tried, upstream_spent, client_gone,
                         trace, attempts_made, request_id,
                         session=session, prompt_tokens=prompt_tokens,
-                        prefer=prefer),
+                        prefer=prefer, tenant=tenant,
+                        priority=priority),
                     attempts=self.attempts, base_delay=self.base_delay,
                     max_delay=self.max_delay)
                 retry_after = None
+                if status == 200:
+                    # post-paid usage: drain this tenant's router-side
+                    # bucket by the tokens the response delivered
+                    self._quota.charge(
+                        tenant, self._delivered_tokens(body))
+                elif status == 429:
+                    # a replica's own quota refusal passes through
+                    # verbatim (see _attempt) — surface its honest
+                    # Retry-After instead of a bare 429
+                    try:
+                        retry_after = max(1, int(math.ceil(float(
+                            headers.get("Retry-After")))))
+                    except (TypeError, ValueError):
+                        retry_after = None
             except serving.Retriable as e:
                 status = 503
                 body = json.dumps(
@@ -1385,6 +1452,45 @@ class FleetRouter(object):
                              for t in first):
                 tokens = list(first)
         return session, tokens
+
+    @staticmethod
+    def _qos_inputs(raw_body):
+        """(tenant, priority) best-effort parsed from a ``:generate``
+        body — the router's quota/spread keys. Anything malformed maps
+        to the defaults here: the upstream answers the authoritative
+        400 (the router must not pre-judge a body it cannot parse),
+        and a client cannot dodge its quota by mangling the field —
+        the engine-side 400 rejects the request before any work."""
+        try:
+            parsed = json.loads(raw_body or b"{}")
+        except (ValueError, UnicodeDecodeError):
+            parsed = None
+        if not isinstance(parsed, dict):
+            parsed = {}
+        try:
+            tenant = validate_tenant(parsed.get("tenant"))
+        except (TypeError, ValueError):
+            tenant = validate_tenant(None)
+        try:
+            priority = validate_priority(parsed.get("priority"))
+        except (TypeError, ValueError):
+            priority = DEFAULT_PRIORITY
+        return tenant, priority
+
+    @staticmethod
+    def _delivered_tokens(body):
+        """Token count of a 200 ``:generate`` response body (flat or
+        nested), for post-paid quota charging; 0 for anything that
+        doesn't parse — never a dispatch failure."""
+        try:
+            tokens = json.loads(body).get("tokens")
+        except (ValueError, AttributeError):
+            return 0
+        if not isinstance(tokens, list):
+            return 0
+        if tokens and isinstance(tokens[0], list):
+            return sum(len(t) for t in tokens if isinstance(t, list))
+        return len(tokens)
 
     def _stage_prefill(self, prompt_tokens, session, trace):
         """Stage one of two-stage dispatch (PR 17): place the prompt
@@ -1533,6 +1639,99 @@ class FleetRouter(object):
         if self.affinity.evict(session):
             self._affinity_break("failover_cold")
 
+    def _spread_tenant(self, tenant, order, views):
+        """Burst spreading (PR 18): when the first-pick replica
+        already holds a strict majority of this tenant's fleet-wide
+        backlog (queued + active, read from the beat-carried tenant
+        gauges), demote it in favor of the candidate carrying the
+        LEAST of that tenant — one noisy tenant's burst spreads across
+        the fleet instead of stacking its own convoy on one replica.
+        The caller only invokes this when nothing warmer pinned the
+        leader (ship target / session hint / digest match), so
+        affinity always outranks spreading. Returns the (possibly
+        re-ordered) candidate list."""
+        by_rid = {str(v.get("replica_id")): (v.get("tenants") or {})
+                  for v in views}
+
+        def burden(rid):
+            t = by_rid.get(rid, {}).get(tenant) or {}
+            try:
+                return int(t.get("queued", 0)) + int(t.get("active", 0))
+            except (TypeError, ValueError):
+                return 0
+
+        total = sum(burden(r) for r in order)
+        lead = burden(order[0])
+        # "concentrating" = the leader holds a strict majority of a
+        # backlog worth spreading (>1: a single queued request is not
+        # a burst, and zero-schema replicas report nothing)
+        if total <= 1 or lead * 2 <= total:
+            return order
+        best = min(order[1:], key=burden)
+        if burden(best) >= lead:
+            return order
+        with self._obs_lock:
+            self.counters.inc("tenant_spreads")
+        return [best] + [r for r in order if r != best]
+
+    def _maybe_prewarm(self, warm_rids, cold_rid, prompt_tokens,
+                       session, trace, snapshot):
+        """Minimal digest-driven predictive placement (PR 18, the
+        follow-up PR 16 named): the request's warm replica sat past
+        the load guard, so THIS dispatch went cold to ``cold_rid`` —
+        have the saturated warm replica ship the prefix there via the
+        kv-ship plane (its ``:prefill`` surface: prefix-cache hit +
+        ship, PR 17) so the next turn of this hot prefix lands warm
+        instead of re-prefilling. Strictly best-effort on a daemon
+        thread — the current request never waits on it — and bounded
+        to one in-flight shipment per (warm, cold) pair."""
+        warm_rid = next(iter(warm_rids), None)
+        if warm_rid is None or warm_rid == cold_rid:
+            return
+        w_info = snapshot.get(warm_rid) or {}
+        c_info = snapshot.get(cold_rid) or {}
+        w_addr, c_addr = w_info.get("addr"), c_info.get("addr")
+        if not w_addr or not c_addr:
+            return
+        key = (warm_rid, cold_rid)
+        with self._obs_lock:
+            if key in self._prewarm_inflight:
+                return
+            self._prewarm_inflight.add(key)
+            self.counters.inc("prefix_prewarms")
+        self.flight.instant("prefix_prewarm", trace=trace,
+                            warm=warm_rid, cold=cold_rid)
+        body = json.dumps({
+            "prompt": list(prompt_tokens),
+            "session": session,
+            "src_epoch": w_info.get("epoch"),
+            "ship": {"addr": "{}:{}".format(c_addr[0], c_addr[1]),
+                     "replica_id": cold_rid,
+                     "epoch": c_info.get("epoch")},
+        }).encode()
+
+        def _run():
+            try:
+                _http_request(
+                    tuple(w_addr), "POST",
+                    "/v1/models/{}:prefill".format(self.name),
+                    body=body, timeout=self.prefill_timeout,
+                    connect_timeout=self.connect_timeout,
+                    extra_headers={"X-TFOS-Trace": str(trace)},
+                    net_src="router", net_dst=warm_rid)
+            except (OSError, ValueError, TimeoutError,
+                    http.client.HTTPException) as e:
+                # a failed pre-warm costs nothing: the next dispatch
+                # just prefills cold, exactly as it would have anyway
+                logger.debug("prefix pre-warm skipped: %s", e)
+            finally:
+                with self._obs_lock:
+                    self._prewarm_inflight.discard(key)
+
+        # tfos: unjoined(best-effort background shipment, never awaited by a dispatch; completion observable via tfos_fleet_prefix_prewarms)
+        threading.Thread(target=_run, daemon=True,
+                         name="tfos-fleet-prewarm").start()
+
     def _hedge_delay(self):
         """Seconds to wait before hedging, derived from the router's
         own upstream-latency histogram at ``hedge_quantile`` (floored
@@ -1551,7 +1750,8 @@ class FleetRouter(object):
 
     def _attempt_hedged(self, raw_body, tried, upstream_spent,
                         client_gone, trace, attempts_made, request_id,
-                        session=None, prompt_tokens=None, prefer=None):
+                        session=None, prompt_tokens=None, prefer=None,
+                        tenant=None, priority=None):
         """One retry_call step, possibly racing TWO upstream attempts:
         the primary starts immediately; if it is still running after
         :meth:`_hedge_delay`, a hedge attempt goes to a DIFFERENT
@@ -1570,7 +1770,8 @@ class FleetRouter(object):
                                  client_gone, trace, attempts_made,
                                  request_id, session=session,
                                  prompt_tokens=prompt_tokens,
-                                 prefer=prefer)
+                                 prefer=prefer, tenant=tenant,
+                                 priority=priority)
         cv = threading.Condition()
         outcomes = []  # (label, "ok"|"err", payload) in arrival order
         lose = threading.Event()
@@ -1599,7 +1800,8 @@ class FleetRouter(object):
                                     session=session,
                                     prompt_tokens=prompt_tokens,
                                     picked=picked, label=label,
-                                    prefer=prefer)
+                                    prefer=prefer, tenant=tenant,
+                                    priority=priority)
                 with cv:
                     outcomes.append((label, "ok", out))
                     cv.notify_all()
@@ -1675,7 +1877,7 @@ class FleetRouter(object):
                  client_gone=None, trace=0, attempts_made=None,
                  request_id=None, lose=None, hedge=False,
                  session=None, prompt_tokens=None, picked=None,
-                 label=None, prefer=None):
+                 label=None, prefer=None, tenant=None, priority=None):
         """One dispatch attempt: pick the best untried replica —
         prefix/session-aware via :func:`affinity_plan` (PR 16), so the
         session's remembered replica or the deepest digest match wins
@@ -1730,6 +1932,13 @@ class FleetRouter(object):
             # and the next attempt proceeds on plain affinity order
             full_order = [prefer] + [r for r in full_order
                                      if r != prefer]
+        elif tenant is not None and len(full_order) > 1 \
+                and full_order[0] != hint \
+                and not matches.get(full_order[0]):
+            # burst spreading (PR 18): only when nothing pinned the
+            # leader — a ship target, session hint, or digest match
+            # (warmth) always outranks spreading
+            full_order = self._spread_tenant(tenant, full_order, views)
         if hint is not None and not plan["hint_routable"]:
             # the session's warm replica is dead, draining, or stale:
             # the request proceeds COLD (never an error — the colder
@@ -1780,6 +1989,15 @@ class FleetRouter(object):
             # request to a colder, less-loaded replica — affinity
             # yielded to load, by design
             self._affinity_break("load_guard")
+            # digest-driven predictive placement (PR 18, the PR 16
+            # follow-up): this request's hot prefix saturated its warm
+            # replica, so THIS dispatch serves cold — but the warm
+            # replica can ship the prefix to the cold pick via the
+            # kv-ship plane so the NEXT one lands warm
+            if prompt_tokens:
+                self._maybe_prewarm(
+                    [g for g in plan["guarded"] if g not in tried],
+                    rid, prompt_tokens, session, trace, snapshot)
         addr = (snapshot.get(rid) or {}).get("addr")
         if not addr:
             raise ReplicaUnavailable(
@@ -1795,6 +2013,14 @@ class FleetRouter(object):
                 attempts_made[0] += 1
             attempt_no = attempts_made[0] if attempts_made else 1
         extra = {"X-TFOS-Trace": str(trace)}
+        if tenant is not None:
+            # tenant identity survives failover: every retry and hedge
+            # of one client request carries the same headers, so
+            # replica-side logs/traces and any tier-crossing hop see
+            # one consistent identity (the BODY fields stay the
+            # engine's authoritative source)
+            extra["X-TFOS-Tenant"] = str(tenant)
+            extra["X-TFOS-Priority"] = str(priority or DEFAULT_PRIORITY)
         if request_id is not None:
             # idempotency key + attempt ordinal: every retry and hedge
             # of one client request shares the id, so the replica's
@@ -1856,6 +2082,17 @@ class FleetRouter(object):
             raise ReplicaUnavailable(
                 "replica {} is fenced (stale lease epoch)".format(rid),
                 retry_after=0.0 if more else 0.5)
+        if status == 429 \
+                and self._retriable_kind(status, body) == "QuotaExceeded":
+            # per-tenant quota refusal (PR 18) is POLICY, not load: the
+            # quota follows the TENANT across every replica, so failing
+            # over would just re-ask the same question elsewhere (and a
+            # fleet of N replicas would multiply the tenant's effective
+            # quota by N). Pass the replica's verdict through verbatim,
+            # honest Retry-After included; the replica behaved
+            # correctly, so it stays healthy.
+            self.health.note_success(rid)
+            return status, body, headers
         if status in serving.RETRIABLE_HTTP_STATUS:
             kind = self._retriable_kind(status, body)
             if kind == "EngineFailed":
@@ -1890,16 +2127,19 @@ class FleetRouter(object):
 
     @staticmethod
     def _retriable_kind(status, body):
-        if status == 429:
-            return "QueueFull"
         try:
             parsed = json.loads(body)
             kind = parsed.get("kind") \
                 or ("Draining" if parsed.get("status") == "draining"
                     else None)
-            return kind or "Retriable"
         except (ValueError, AttributeError):
-            return "Retriable"
+            kind = None
+        if status == 429:
+            # 429 bodies carry a kind since PR 18 (QuotaExceeded must
+            # be told apart from backpressure); a bare 429 predates it
+            # and can only be the engine's QueueFull
+            return kind or "QueueFull"
+        return kind or "Retriable"
 
     # -- half-open probing -------------------------------------------------
 

@@ -499,7 +499,11 @@ def serve_replica(spec):
     applied to the serving plane. The closure builds the replica
     IN the executor process — ``fleet.ServingNode``: DecodeEngine
     (spawn config rides ``spec["engine_kw"]`` — slots, paging,
-    ``attn_impl``), ModelServer on an ephemeral port with the remote
+    ``attn_impl``; the multi-tenant QoS policy — tenant weights,
+    priority classes, token quotas — rides ``spec["qos"]``, applied as
+    the engine's ``qos_policy`` so every executor-hosted replica
+    enforces the same tenant contract the router does, PR 18),
+    ModelServer on an ephemeral port with the remote
     lifecycle RPCs mounted, and the BEAT agent registering the
     replica's real HTTP address with the driver's reservation server —
     then RETURNS, leaving the node serving on daemon threads (the

@@ -64,7 +64,9 @@ from tensorflowonspark_tpu import chaos
 from tensorflowonspark_tpu import frames
 from tensorflowonspark_tpu import kvship
 from tensorflowonspark_tpu import paging
+from tensorflowonspark_tpu import qos
 from tensorflowonspark_tpu import tracing
+from tensorflowonspark_tpu.qos import QuotaExceeded  # noqa: F401 - HTTP taxonomy re-export
 
 logger = logging.getLogger(__name__)
 
@@ -239,13 +241,20 @@ class GenerationHandle(object):
     """
 
     def __init__(self, prompt, max_new_tokens, deadline=None,
-                 trace=None, session=None):
+                 trace=None, session=None, tenant=None, priority=None):
         # constructed by DecodeEngine AFTER validate() normalized both
         self.prompt = list(prompt)
         self.max_new_tokens = max_new_tokens
         self.deadline = deadline  # absolute monotonic, or None
         self.submitted = time.monotonic()
         self.completed = None
+        #: multi-tenant QoS identity (PR 18): validated upstream by
+        #: qos.validate_tenant/validate_priority — the fair scheduler
+        #: keys its deficit counters on tenant, strict class ordering
+        #: and preemption on priority
+        self.tenant = tenant if tenant is not None else qos.DEFAULT_TENANT
+        self.priority = priority if priority is not None \
+            else qos.DEFAULT_PRIORITY
         #: optional conversation identity (PR 16): an opaque client
         #: string riding the :generate payload end to end. The engine
         #: never interprets it — it exists so the fleet router's
@@ -605,7 +614,8 @@ class DecodeEngine(object):
                  max_queue=1024, metrics=None, flight=None,
                  replica_id=None, kv_block_size=None, kv_blocks=None,
                  prefix_cache=True, attn_impl=None, speculate_k=None,
-                 draft_layers=None, kv_dtype=None, tier=None):
+                 draft_layers=None, kv_dtype=None, tier=None,
+                 qos_policy=None):
         import jax
 
         from tensorflowonspark_tpu import generation
@@ -629,7 +639,7 @@ class DecodeEngine(object):
             kv_block_size=kv_block_size, kv_blocks=kv_blocks,
             prefix_cache=prefix_cache, attn_impl=attn_impl,
             speculate_k=speculate_k, draft_layers=draft_layers,
-            kv_dtype=kv_dtype, tier=tier)
+            kv_dtype=kv_dtype, tier=tier, qos_policy=qos_policy)
         self._generation = generation
         #: serving tier (PR 17 disaggregation): "prefill" engines take
         #: prompt work and ship resident KV blocks out, "decode"
@@ -712,6 +722,36 @@ class DecodeEngine(object):
             "tfos_kv", tracing.Counters())
         self._hist_ship = self.metrics.histogram("tfos_kv_ship_ms")
         self._splice_failures = {}  # reason -> count (guarded by _cv)
+        # -- multi-tenant QoS plane (PR 18) ----------------------------
+        #: operator QoS config: per-tenant fair-share weights and
+        #: token-rate quotas (qos.QosPolicy / kwargs dict / None)
+        self.qos_policy = qos.QosPolicy.from_spec(qos_policy)
+        # deficit-counter weighted-fair admission with strict priority
+        # classes — replaces the FIFO head scan. Scheduler-thread
+        # private: select/charge run only inside the admission scan.
+        self._qos_sched = qos.FairScheduler(self.qos_policy)
+        # per-tenant token buckets, post-paid: the scheduler thread
+        # charges ACTUAL deliveries (exact usage; dedup replays deliver
+        # nothing, so retries never double-charge), HTTP handler
+        # threads check admission — QuotaTable has its own lock for
+        # that two-population split.
+        self._quota = qos.QuotaTable(self.qos_policy)
+        # tenant-labeled tallies behind the tfos_qos_* families
+        # (ModelServer.metrics_text renders them). All four mutate
+        # under _cv: admitted/preemptions/tokens are scheduler-thread
+        # writes inside _cv'd sections, quota rejections land from
+        # HTTP handler threads via note_quota_rejection().
+        self._qos_admitted = {}          # (tenant, class) -> requests
+        self._qos_preemptions = {}       # (tenant, class) -> evictions
+        self._qos_tokens = {}            # tenant -> generated tokens
+        self._qos_quota_rejections = {}  # tenant -> refusals
+        # queue-wait distribution per priority class — the isolation
+        # number the antagonist bench pins (a flooded LOW class must
+        # not move the HIGH class's wait)
+        self._hist_qwait_class = {
+            name: self.metrics.histogram(
+                "tfos_qos_queue_wait_{}_seconds".format(name))
+            for name in qos.PRIORITIES}
         self._temperature = float(temperature)
         norm_top_k = None if top_k is None else int(top_k)
         norm_top_p = None if top_p is None else float(top_p)
@@ -1001,7 +1041,7 @@ class DecodeEngine(object):
         return prompt, max_new
 
     def submit(self, prompt, max_new_tokens, deadline_s=None,
-               session=None):
+               session=None, tenant=None, priority=None):
         """Queue one request; returns its :class:`GenerationHandle`.
 
         Validation happens HERE, on the caller's thread, so a malformed
@@ -1017,10 +1057,17 @@ class DecodeEngine(object):
         ``session``: opaque conversation id threaded onto the handle
         (the fleet router's affinity key); the engine itself does not
         interpret it.
+
+        ``tenant`` / ``priority`` (PR 18): QoS identity. Omitted =
+        the ``default`` tenant at ``normal`` class — every pre-QoS
+        caller is unchanged. Malformed values raise ``ValueError``
+        (HTTP 400); a tenant whose token bucket is in debt raises
+        :class:`qos.QuotaExceeded` (HTTP 429 + Retry-After).
         """
         return self._submit_many([self.validate(prompt, max_new_tokens)],
                                  deadline_s=deadline_s,
-                                 session=session)[0]
+                                 session=session, tenant=tenant,
+                                 priority=priority)[0]
 
     def estimate_admission(self, max_new_tokens, prompt=None):
         """{'queue_wait_s', 'service_s'} — what admitting a request of
@@ -1093,7 +1140,7 @@ class DecodeEngine(object):
                 "service_s": prefill + max_new * step}
 
     def _submit_many(self, vetted, deadline_s=None, trace=None,
-                     session=None):
+                     session=None, tenant=None, priority=None):
         """Atomically queue a whole vetted body: either every request is
         admitted or none is (QueueFull / Shed / stopped / draining /
         broken raise before any handle exists), so a mid-batch refusal
@@ -1103,12 +1150,28 @@ class DecodeEngine(object):
         checks — a dead engine must refuse degenerate requests as
         loudly as real ones. ``trace``: adopt an externally minted
         trace id (the router's ``X-TFOS-Trace``) for every handle of
-        the body — one propagated id, one Perfetto row."""
+        the body — one propagated id, one Perfetto row. ``tenant`` /
+        ``priority``: validated QoS identity for the whole body (one
+        client, one class); a quota-indebted tenant is refused BEFORE
+        any handle exists, same atomicity as QueueFull."""
         if deadline_s is not None:
             deadline_s = float(deadline_s)
             if not deadline_s > 0:
                 raise ValueError(
                     "deadline_s must be > 0, got {}".format(deadline_s))
+        tenant = qos.validate_tenant(tenant)
+        priority = qos.validate_priority(priority)
+        # quota gate (PR 18): post-paid token buckets — usage is
+        # charged by the scheduler at ACTUAL delivery, so this check
+        # never charges (a dedup-keyed retry that replays a stored
+        # completion costs nothing). Checked outside _cv: QuotaTable
+        # has its own lock, and a refused tenant must not serialize
+        # against the scheduler.
+        try:
+            self._quota.admit(tenant)
+        except qos.QuotaExceeded:
+            self.note_quota_rejection(tenant, requests=len(vetted))
+            raise
         with self._cv:
             # chaos site (PR 13): kill_serving_executor_at_request
             # fires on the K-th submitted request — whole-executor
@@ -1183,12 +1246,15 @@ class DecodeEngine(object):
                 handle = GenerationHandle(prompt, max_new,
                                           deadline=deadline,
                                           trace=trace,
-                                          session=session)
+                                          session=session,
+                                          tenant=tenant,
+                                          priority=priority)
                 self.flight.instant("admit", trace=handle.trace,
                                     prompt_len=len(prompt),
                                     max_new=max_new,
                                     deadline_s=deadline_s,
-                                    session=handle.session or "")
+                                    session=handle.session or "",
+                                    tenant=tenant, priority=priority)
                 if max_new == 0:
                     handle._finish()
                     self._trace_finish(handle, "finish",
@@ -1235,6 +1301,26 @@ class DecodeEngine(object):
             queue_depth = len(self._queue)
             occupancy = len(self._active_slots())
             qwait = self._qwait_ewma
+            # QoS view (PR 18): queue split by priority class (the
+            # autoscaler's per-priority breach view) and per-tenant
+            # backlog/usage (the router's burst-spreading signal).
+            # Always present — a tenant-less engine publishes the zero
+            # schema (all-zero classes, empty tenants), never absent
+            # keys, matching every other load_stats field.
+            queue_by_class = dict.fromkeys(qos.PRIORITIES, 0)
+            tenant_queued = {}
+            for h in self._queue:
+                queue_by_class[h.priority] = \
+                    queue_by_class.get(h.priority, 0) + 1
+                tenant_queued[h.tenant] = \
+                    tenant_queued.get(h.tenant, 0) + 1
+            tenant_active = {}
+            for s in self._active_slots():
+                handle = self._slot_req[s]
+                if handle is not None:
+                    tenant_active[handle.tenant] = \
+                        tenant_active.get(handle.tenant, 0) + 1
+            qos_tokens = dict(self._qos_tokens)
         health = self.healthy()
         stats = {"replica_id": self.replica_id,
                  "queue_depth": queue_depth,
@@ -1243,7 +1329,13 @@ class DecodeEngine(object):
                  "queue_wait_ewma_s": round(qwait, 6)
                  if qwait is not None else 0.0,
                  "alive": health["alive"],
-                 "draining": health["draining"]}
+                 "draining": health["draining"],
+                 "queue_by_class": queue_by_class,
+                 "tenants": {t: {"queued": tenant_queued.get(t, 0),
+                                 "active": tenant_active.get(t, 0),
+                                 "tokens": qos_tokens.get(t, 0)}
+                             for t in set(tenant_queued)
+                             | set(tenant_active) | set(qos_tokens)}}
         # block-pool view (PR 8) + kernel config (PR 11): rides the
         # fleet BEAT payload and /healthz so routers and operators see
         # memory headroom and which attention formulation serves each
@@ -1649,6 +1741,122 @@ class DecodeEngine(object):
                 self._slot_req[s] = None
                 self._release_slot(s)
 
+    def _plan_admission_locked(self):
+        """Weighted-fair admission plan (PR 18); caller holds ``_cv``
+        at a decode-step boundary. Returns ``(admits, victims)``.
+
+        Replaces the FIFO head scan: queue entries group into
+        per-(tenant, class) FIFO buckets and ``qos.FairScheduler``
+        picks each admission — strict priority classes first, largest
+        deficit within the strongest class — so a starved tenant
+        provably catches up while the single ``_queue`` deque stays
+        the source of truth for drain/evict/estimate. One tenant at
+        one class degenerates to exactly the old FIFO scan (one
+        bucket, heads in queue order), so every existing caller sees
+        unchanged behavior.
+
+        Block-aware admission (PR 8) is unchanged in substance: the
+        selected head only enters a slot when its prefill blocks are
+        obtainable NOW, verdict and capacity from ONE
+        ``plan_admission`` snapshot (PR 14), and there is no bypass
+        past a block-starved winner — completions free blocks and the
+        scan reruns every step. The blocked-head memo generalizes to
+        the blocked WINNER: selection is deterministic under unchanged
+        deficits (nothing was charged after the blocked pick), so an
+        unchanged pool epoch means the old verdict stands. Fairness is
+        priced in the resource that actually gates entry: KV blocks on
+        a paged engine (min 1 so a fully-shared prefix still pays for
+        its slot), slots otherwise.
+
+        ``victims`` are slot ids to preempt AFTER ``_cv`` is released
+        (``_preempt`` re-acquires it to requeue): when a strictly
+        stronger class is still waiting — for a slot or for blocks —
+        the weakest-class youngest active slot is evicted, at most one
+        per scan (the scan reruns every step, so catch-up is quick and
+        churn stays bounded). The continuation re-prefills seamlessly
+        via the PR 8 preemption machinery, bitwise at temperature=0.
+        """
+        admits = []
+        if not self._queue:
+            return admits, []
+        free = [s for s in range(self.slots)
+                if self._slot_req[s] is None]
+        planned_blocks = 0
+        block_starved = False
+        # per-(tenant, class) FIFO buckets; deque order is preserved
+        # inside each bucket so one tenant's own requests never reorder
+        buckets = collections.OrderedDict()
+        for h in self._queue:
+            buckets.setdefault((h.tenant, h.priority), []).append(h)
+        backlogged = {t for t, _ in buckets}
+        while free and buckets:
+            keys = list(buckets)
+            winner = keys[self._qos_sched.select(keys)]
+            head = buckets[winner][0]
+            cost = 1.0
+            if self._paged:
+                # blocked-winner memo: while the winner waits for
+                # blocks, re-walking its prefix chain every decode
+                # step is O(prompt) wasted on the scheduler thread.
+                # Keyed on the pool's MUTATION EPOCH — every event
+                # that could change the verdict bumps it, and with an
+                # unchanged epoch this scan's planned_blocks is
+                # provably 0, so the old verdict stands.
+                if self._head_block_memo == \
+                        (head, self._pool.epoch()):
+                    block_starved = True
+                    break
+                toks = head.prompt + head._tokens
+                shared, need, lru_shared, allocatable, \
+                    epoch = self._pool.plan_admission(toks)
+                if need + lru_shared + planned_blocks \
+                        > allocatable:
+                    self._head_block_memo = (head, epoch)
+                    block_starved = True
+                    break
+                self._head_block_memo = None
+                planned_blocks += need + lru_shared
+                cost = float(max(1, need + lru_shared))
+            s = free.pop(0)
+            # occupy the slot AT pop time: every popped handle must be
+            # findable by the failure paths (_fail_outstanding) even
+            # if an EARLIER admit's prefill dies before this one runs.
+            # deque.remove matches by identity (no __eq__ on handles).
+            self._queue.remove(head)
+            buckets[winner].pop(0)
+            if not buckets[winner]:
+                del buckets[winner]
+            self._slot_req[s] = head
+            admits.append((s, head))
+            self._qos_sched.charge(winner[0], cost,
+                                   backlogged=backlogged)
+            self._qos_admitted[winner] = \
+                self._qos_admitted.get(winner, 0) + 1
+        victims = []
+        # class preemption rides PR 8's paged preemption machinery
+        # (continuation re-prefill of prompt + emitted tokens); a
+        # contiguous engine has no seamless re-entry, so it never
+        # preempts — strict class ordering still holds at admission
+        if buckets and self._paged and (block_starved or not free):
+            # a head is still waiting; if its class is strictly
+            # stronger than some in-flight sequence, that sequence
+            # yields — weakest class first, youngest within the class
+            # (so the oldest of the strongest class always progresses:
+            # no preemption livelock)
+            waiting = min(qos.priority_rank(p) for _, p in buckets)
+            admitted = {s for s, _ in admits}
+            cands = [
+                s for s in self._active_slots()
+                if s not in admitted
+                and qos.priority_rank(self._slot_req[s].priority)
+                > waiting]
+            if cands:
+                victims.append(max(
+                    cands, key=lambda v: (
+                        qos.priority_rank(self._slot_req[v].priority),
+                        self._slot_seq[v])))
+        return admits, victims
+
     def _loop(self):
         import jax.numpy as jnp
 
@@ -1673,65 +1881,22 @@ class DecodeEngine(object):
                     kv_jobs = list(self._kv_jobs)
                     self._kv_jobs.clear()
                     self._prune_queue_locked(time.monotonic())
-                    admits = []
-                    planned_blocks = 0
-                    for s in range(self.slots):
-                        if self._slot_req[s] is not None \
-                                or not self._queue:
-                            continue
-                        if self._paged:
-                            # block-aware admission: the FIFO head only
-                            # enters a slot when its prefill blocks are
-                            # obtainable NOW. Shared prefix blocks need
-                            # no allocation; only the LRU-RESIDENT ones
-                            # cost capacity (acquire removes them from
-                            # the allocatable set), while sharing a
-                            # LIVE block is free — so concurrent
-                            # same-prefix requests admit together
-                            # instead of serializing on a pool-sized
-                            # prefix. No head-of-line bypass:
-                            # completions free blocks and the scan
-                            # reruns every step.
-                            head = self._queue[0]
-                            # blocked-head memo: while the head waits
-                            # for blocks, re-walking its prefix chain
-                            # every decode step is O(prompt) wasted on
-                            # the scheduler thread. The memo keys on
-                            # the pool's MUTATION EPOCH — every event
-                            # that could change the verdict (release,
-                            # alloc, acquire, prefix registration)
-                            # bumps it, and with an unchanged epoch
-                            # this scan's planned_blocks is provably 0
-                            # (planned admissions alloc — and bump —
-                            # right after the scan), so the old
-                            # verdict stands.
-                            if self._head_block_memo == \
-                                    (head, self._pool.epoch()):
-                                break
-                            toks = head.prompt + head._tokens
-                            # verdict and capacity from ONE pool
-                            # snapshot; the memo stores the epoch OF
-                            # that snapshot, so a mutation landing
-                            # mid-scan (drop_cache from an operator
-                            # thread) invalidates it next step instead
-                            # of pinning a torn verdict
-                            shared, need, lru_shared, allocatable, \
-                                epoch = self._pool.plan_admission(toks)
-                            if need + lru_shared + planned_blocks \
-                                    > allocatable:
-                                self._head_block_memo = (head, epoch)
-                                break
-                            self._head_block_memo = None
-                            planned_blocks += need + lru_shared
-                        handle = self._queue.popleft()
-                        # occupy the slot AT pop time: every popped
-                        # handle must be findable by the failure
-                        # paths (_fail_outstanding) even if an
-                        # EARLIER admit's prefill dies before this
-                        # one runs
-                        self._slot_req[s] = handle
-                        admits.append((s, handle))
+                    # QoS admission (PR 18): weighted-fair pick order
+                    # replaces the FIFO head scan; the stage timer
+                    # proves the scheduler stays off the hot path
+                    # (<50us/plan, pinned by scripts/profile_serving)
+                    with self.timers.timed("qos_plan"):
+                        admits, victims = self._plan_admission_locked()
                     self.counters.gauge("queue_depth", len(self._queue))
+                # class preemption OUTSIDE the lock (_preempt
+                # re-acquires _cv to requeue its victim — _cv is
+                # non-reentrant): the slot and blocks it frees admit
+                # the waiting stronger-class head on the very next
+                # scan — one decode step of latency, the same boundary
+                # every other scheduling decision lands on
+                for s in victims:
+                    if self._slot_req[s] is not None:
+                        self._preempt(s)
                 for job in kv_jobs:
                     self._run_kv_job(job)
                 # prefill OUTSIDE the lock: submit() must never block on
@@ -2262,6 +2427,25 @@ class DecodeEngine(object):
         with self._cv:
             return dict(self._splice_failures)
 
+    def note_quota_rejection(self, tenant, requests=1):
+        """Count quota refusals (429 QuotaExceeded). Handler threads
+        are multi-writer, so the tally mutates under ``_cv`` — same
+        rule as every other cross-thread counter here."""
+        with self._cv:
+            self._qos_quota_rejections[tenant] = \
+                self._qos_quota_rejections.get(tenant, 0) + int(requests)
+
+    def qos_tallies(self):
+        """One consistent snapshot of the QoS counters for the metrics
+        surface: ``{'admitted': {(tenant, class): n}, 'preemptions':
+        {(tenant, class): n}, 'quota_rejections': {tenant: n},
+        'tokens': {tenant: n}}``."""
+        with self._cv:
+            return {"admitted": dict(self._qos_admitted),
+                    "preemptions": dict(self._qos_preemptions),
+                    "quota_rejections": dict(self._qos_quota_rejections),
+                    "tokens": dict(self._qos_tokens)}
+
     def _preempt(self, slot):
         """Free a slot's blocks under pool exhaustion and requeue its
         request at the queue FRONT: it re-admits as soon as blocks
@@ -2274,6 +2458,9 @@ class DecodeEngine(object):
         self._release_slot(slot)
         with self._cv:
             self._queue.appendleft(handle)
+            key = (handle.tenant, handle.priority)
+            self._qos_preemptions[key] = \
+                self._qos_preemptions.get(key, 0) + 1
             self.counters.gauge("queue_depth", len(self._queue))
         self.counters.inc("preemptions")
         self.flight.instant("preempt", trace=handle.trace,
@@ -2293,10 +2480,12 @@ class DecodeEngine(object):
         growth covers ``min(k, tokens the request can still emit)`` —
         writes past that clamp are rejected-proposal garbage that may
         land in scratch (table entry 0) because no cursor will ever
-        make them visible. Under exhaustion the YOUNGEST admission is
-        preempted (LIFO victims), so the oldest request always
+        make them visible. Under exhaustion the WEAKEST-class YOUNGEST
+        admission is preempted (class-aware LIFO victims, PR 18 — with
+        one priority class this is exactly the old youngest-first
+        rule), so the oldest request of the strongest class always
         progresses: no preemption livelock, and ``validate``'s
-        worst-case-fits-the-pool bound guarantees the oldest alone
+        worst-case-fits-the-pool bound guarantees that request alone
         can always satisfy its own lookahead."""
         bs = self.kv_block_size
         look = self._spec_k or 1
@@ -2328,8 +2517,12 @@ class DecodeEngine(object):
                     with self.timers.timed("block_alloc"):
                         new_id = self._pool.alloc(1)[0]
                 except paging.PoolExhausted:
-                    victim = max(self._active_slots(),
-                                 key=lambda v: self._slot_seq[v])
+                    victim = max(
+                        self._active_slots(),
+                        key=lambda v: (
+                            qos.priority_rank(
+                                self._slot_req[v].priority),
+                            self._slot_seq[v]))
                     # preempting s itself clears its slot_req and
                     # ends the while
                     self._preempt(victim)
@@ -2395,6 +2588,10 @@ class DecodeEngine(object):
             # queue-wait metrics describe FIRST admissions only; a
             # preemption re-entry is a continuation, not a queue wait
             self._hist_qwait.observe(t0 - handle.submitted)
+            self._hist_qwait_class.get(
+                handle.priority,
+                self._hist_qwait_class[qos.DEFAULT_PRIORITY]).observe(
+                    t0 - handle.submitted)
             self._qwait_ewma = self._ewma(self._qwait_ewma,
                                           t0 - handle.submitted)
             self.flight.span("queue", handle.submitted, t0,
@@ -2465,6 +2662,10 @@ class DecodeEngine(object):
         # of stranding its client on a timeout)
         t0 = time.monotonic()
         self._hist_qwait.observe(t0 - handle.submitted)
+        self._hist_qwait_class.get(
+            handle.priority,
+            self._hist_qwait_class[qos.DEFAULT_PRIORITY]).observe(
+                t0 - handle.submitted)
         self.flight.span("queue", handle.submitted, t0,
                          trace=handle.trace, slot=slot)
         with self.timers.timed("prefill"):
@@ -2500,6 +2701,15 @@ class DecodeEngine(object):
             self._hist_token.observe(now - handle._last_emit_at)
         handle._last_emit_at = now
         self._last[slot] = token
+        # QoS usage accounting (PR 18), post-paid at ACTUAL delivery:
+        # the quota bucket drains by tokens the engine really emitted,
+        # so a dedup-replayed retry (which delivers nothing new) can
+        # never double-charge. _qos_tokens rides load_stats() to the
+        # fleet, hence mutates under _cv; QuotaTable has its own lock.
+        self._quota.charge(handle.tenant, 1)
+        with self._cv:
+            self._qos_tokens[handle.tenant] = \
+                self._qos_tokens.get(handle.tenant, 0) + 1
         done = (self.eos_token is not None and token == self.eos_token) \
             or len(handle._tokens) >= handle.max_new_tokens
         if done:
@@ -2513,6 +2723,18 @@ class DecodeEngine(object):
             self._release_slot(slot)
             self.counters.inc("requests_completed")
             self._trace_finish(handle, "finish")
+            # fair-share hygiene: a tenant that went fully idle drops
+            # its deficit counter, keeping the table bounded by LIVE
+            # tenants (an idle tenant earns no credit anyway — shares
+            # only accrue to backlogged tenants)
+            with self._cv:
+                live = any(h.tenant == handle.tenant
+                           for h in self._queue) \
+                    or any(self._slot_req[s] is not None
+                           and self._slot_req[s].tenant == handle.tenant
+                           for s in range(self.slots))
+                if not live:
+                    self._qos_sched.forget(handle.tenant)
         elif chaos.on_token(len(handle._tokens)):
             # chaos disconnect_client_at_token: the client vanished
             # mid-stream; eviction happens at the next step boundary,
@@ -3019,6 +3241,15 @@ class ModelServer(object):
         session = payload.get("session")
         if session is not None and not isinstance(session, str):
             raise _BadRequest("session must be a string")
+        # tenant identity (PR 18): absent fields keep the default
+        # tenant/class, so every existing caller is unchanged; a
+        # MALFORMED value is the client's error (400), never silently
+        # coerced into someone else's accounting bucket
+        try:
+            tenant = qos.validate_tenant(payload.get("tenant"))
+            priority = qos.validate_priority(payload.get("priority"))
+        except (TypeError, ValueError) as e:
+            raise _BadRequest(str(e))
         try:
             # vet the WHOLE body before submitting any of it: a 400 must
             # not leave earlier prompts of the same body decoding for a
@@ -3030,7 +3261,8 @@ class ModelServer(object):
         # Shed as 503) with nothing queued, instead of part of the body
         # decoding for a client that got an error
         handles = engine._submit_many(vetted, deadline_s=deadline_s,
-                                      trace=trace, session=session)
+                                      trace=trace, session=session,
+                                      tenant=tenant, priority=priority)
         try:
             tokens = [self._await_handle(h, handles, client_gone)
                       for h in handles]
@@ -3406,6 +3638,40 @@ class ModelServer(object):
                     info += ('tfos_splice_failures_total'
                              '{{reason="{}"}} {}\n'
                              .format(reason, counts[reason]))
+        # tenant-labeled QoS counters (PR 18): same hand-rendered
+        # label pattern — the engine's Counters carry no labels, and
+        # tenant names are client-bounded by qos._TENANT_RE (64 chars
+        # of [A-Za-z0-9._-]), so label values need no escaping
+        tallies = getattr(engine, "qos_tallies", None)
+        if callable(tallies):
+            t = tallies()
+            if t["admitted"]:
+                info += "# TYPE tfos_qos_admitted counter\n"
+                for tenant, cls in sorted(t["admitted"]):
+                    info += ('tfos_qos_admitted_total'
+                             '{{tenant="{}",class="{}"}} {}\n'
+                             .format(tenant, cls,
+                                     t["admitted"][(tenant, cls)]))
+            if t["preemptions"]:
+                info += "# TYPE tfos_qos_preemptions counter\n"
+                for tenant, cls in sorted(t["preemptions"]):
+                    info += ('tfos_qos_preemptions_total'
+                             '{{tenant="{}",class="{}"}} {}\n'
+                             .format(tenant, cls,
+                                     t["preemptions"][(tenant, cls)]))
+            if t["quota_rejections"]:
+                info += "# TYPE tfos_qos_quota_rejections counter\n"
+                for tenant in sorted(t["quota_rejections"]):
+                    info += ('tfos_qos_quota_rejections_total'
+                             '{{tenant="{}"}} {}\n'
+                             .format(tenant,
+                                     t["quota_rejections"][tenant]))
+            if t["tokens"]:
+                info += "# TYPE tfos_qos_tokens counter\n"
+                for tenant in sorted(t["tokens"]):
+                    info += ('tfos_qos_tokens_total'
+                             '{{tenant="{}"}} {}\n'
+                             .format(tenant, t["tokens"][tenant]))
         if info:
             text = text.replace("# EOF\n", info + "# EOF\n")
         return text
@@ -3737,6 +4003,18 @@ class ModelServer(object):
                 except QueueFull as e:
                     # backpressure, not failure: retry later
                     return self._send(429, {"error": str(e)})
+                except QuotaExceeded as e:
+                    # per-tenant rate quota (PR 18): 429 like QueueFull
+                    # but NOT a failover signal — the quota follows the
+                    # tenant, not the replica, so the router passes it
+                    # through verbatim. Retry-After is the bucket's
+                    # honest refill time.
+                    return self._send(
+                        429, {"error": str(e),
+                              "kind": "QuotaExceeded",
+                              "tenant": e.tenant},
+                        headers={"Retry-After":
+                                 str(int(math.ceil(e.retry_after)))})
                 except DeadlineExceeded as e:
                     # admitted but evicted past its deadline — the
                     # gateway-timeout shape, not a server fault

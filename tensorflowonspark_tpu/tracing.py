@@ -354,11 +354,11 @@ METRIC_FAMILIES = {
         ("gauge", "", "slots holding an in-flight sequence"),
     "tfos_serving_stage_seconds":
         ("counter", "stage", "scheduler wall seconds per stage "
-                             "(prefill / decode_step / host_schedule; "
-                             "speculative engines add spec_round / "
-                             "draft_prefill plus the draft and verify "
-                             "probes, int8 engines the dequant "
-                             "probe)"),
+                             "(qos_plan / prefill / decode_step / "
+                             "host_schedule; speculative engines add "
+                             "spec_round / draft_prefill plus the "
+                             "draft and verify probes, int8 engines "
+                             "the dequant probe)"),
     "tfos_serving_stage_samples":
         ("counter", "stage", "samples behind tfos_serving_stage_seconds"),
     "tfos_serving_replica_info":
@@ -375,6 +375,38 @@ METRIC_FAMILIES = {
         ("counter", "", "duplicate deliveries that JOINED a still-"
                         "executing original instead of racing a second "
                         "generation"),
+    # -- multi-tenant QoS plane (PR 18) --
+    "tfos_qos_admitted":
+        ("counter", "tenant,class", "admissions the weighted-fair "
+                                    "scheduler granted, by tenant and "
+                                    "priority class"),
+    "tfos_qos_preemptions":
+        ("counter", "tenant,class", "in-flight sequences preempted, by "
+                                    "the tenant/class that was evicted "
+                                    "(pool exhaustion or a stronger "
+                                    "class waiting; subset context for "
+                                    "tfos_serving_preemptions)"),
+    "tfos_qos_quota_rejections":
+        ("counter", "tenant", "admissions refused 429 QuotaExceeded "
+                              "because the tenant's token bucket was "
+                              "in debt"),
+    "tfos_qos_tokens":
+        ("counter", "tenant", "tokens actually delivered per tenant "
+                              "(the post-paid usage that drains its "
+                              "quota bucket)"),
+    "tfos_qos_queue_wait_high_seconds":
+        ("histogram", "", "submit -> prefill start for HIGH-class "
+                          "admissions (per-class split of "
+                          "tfos_serving_queue_wait_seconds — the "
+                          "isolation number the antagonist bench "
+                          "pins)"),
+    "tfos_qos_queue_wait_normal_seconds":
+        ("histogram", "", "submit -> prefill start for normal-class "
+                          "admissions"),
+    "tfos_qos_queue_wait_low_seconds":
+        ("histogram", "", "submit -> prefill start for LOW-class "
+                          "admissions (grows under pressure by "
+                          "design: LOW absorbs the backlog)"),
     # -- fleet plane (FleetRouter registry; router /metrics) --
     "tfos_fleet_requests":
         ("counter", "", "requests the router answered (any status)"),
@@ -471,6 +503,24 @@ METRIC_FAMILIES = {
                                   "its serving tier (prefill / decode "
                                   "/ mixed) — the disaggregation "
                                   "topology at a glance"),
+    # -- multi-tenant QoS at the router (PR 18) --
+    "tfos_fleet_quota_rejections":
+        ("counter", "", "dispatches the ROUTER refused 429 "
+                        "QuotaExceeded from its own quota table "
+                        "before any upstream attempt (engine-side "
+                        "refusals count in tfos_qos_quota_rejections "
+                        "on the replica)"),
+    "tfos_fleet_tenant_spreads":
+        ("counter", "", "dispatches re-ordered away from a replica "
+                        "already concentrating the requesting "
+                        "tenant's backlog (burst spreading; affinity "
+                        "preferences still win)"),
+    "tfos_fleet_prefix_prewarms":
+        ("counter", "", "predictive placements triggered: a tenant's "
+                        "hot prefix saturated its warm replica past "
+                        "the load guard, so the router staged the "
+                        "prefix onto the chosen cold replica via the "
+                        "kv-ship plane (PR 16's digest follow-up)"),
     # -- executor-hosted serving + SLO autoscaler (PR 13) --
     "tfos_serving_replica_host":
         ("gauge", "replica_id,executor", "constant 1 joining each "

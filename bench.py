@@ -1802,6 +1802,14 @@ def _fault_plane_bench(on_tpu, flap_cycles=3, hedge_requests=24,
     with hedging OFF vs ON (quantile-derived hedge delay, first
     response wins). Clients here read whole short responses, so
     request wall clock IS their time-to-first-token.
+
+    ``control_mttr`` — the control-plane survivability leg (PR 19):
+    under live session traffic, crash the reservation server and
+    restart it from its journal (detect / reconnect /
+    snapshot-rebuild breakdown), then crash the router and let a warm
+    standby take over. Verdicts: zero client-visible errors across
+    both deaths, and the affinity warm-hit rate before vs after the
+    takeover (the promoted router starts COLD by design).
     """
     import jax
     import numpy as np
@@ -1812,11 +1820,13 @@ def _fault_plane_bench(on_tpu, flap_cycles=3, hedge_requests=24,
     params = train.init(jax.random.PRNGKey(0),
                         np.zeros((1, dec.max_len), np.int32))["params"]
 
-    def post(url, prompt, max_new):
+    def post(url, prompt, max_new, session=None):
         import json as json_mod
         import urllib.request
-        body = json_mod.dumps({"prompt": prompt,
-                               "max_new_tokens": max_new}).encode()
+        payload = {"prompt": prompt, "max_new_tokens": max_new}
+        if session is not None:
+            payload["session"] = session
+        body = json_mod.dumps(payload).encode()
         req = urllib.request.Request(
             url, data=body, headers={"Content-Type": "application/json"})
         t0 = time.monotonic()
@@ -1904,6 +1914,138 @@ def _fault_plane_bench(on_tpu, flap_cycles=3, hedge_requests=24,
             baseline["p99_ms"] / hedged["p99_ms"], 2)
         if hedged["p99_ms"] else None,
     }
+
+    # -- leg 3: control-plane MTTR (PR 19) --
+    # Kill the CONTROL plane twice under live session traffic — the
+    # reservation server (journal-seeded restart: detect / reconnect /
+    # snapshot-rebuild breakdown) and then the router (warm-standby
+    # takeover) — and report the repair timeline plus the two verdicts
+    # that make the timeline honest: client-visible errors (target 0;
+    # the data plane never stopped) and the affinity warm-hit rate
+    # before vs after the takeover rebuild (the promoted router starts
+    # COLD by design and re-learns pins from live traffic).
+    import tempfile as tempfile_mod
+    import threading as threading_mod
+
+    from tensorflowonspark_tpu import chaos as chaos_mod
+
+    journal = os.path.join(
+        tempfile_mod.mkdtemp(prefix="tfos-bench-control"),
+        "control.journal")
+    with fleet.ServingFleet(dec, params, replicas=2,
+                            engine_kw={"slots": 4}, beat_interval=0.1,
+                            journal=journal) as f:
+        def spost(session, prompt, max_new=4):
+            # f.url() re-reads f.router: follows the takeover
+            return post(f.url("/v1/models/model:generate"),
+                        prompt, max_new, session=session)
+
+        spost("warm", [1, 2, 3], 2)  # compiles outside the verdict
+
+        def hit_rate(rounds=8):
+            base = f.router.counters.snapshot()["counts"]
+            for i in range(rounds):
+                spost("sess-%d" % (i % 4), [1 + i % 5, 2, 3])
+            counts = f.router.counters.snapshot()["counts"]
+            req = counts.get("requests", 0) - base.get("requests", 0)
+            hits = counts.get("affinity_hits", 0) \
+                - base.get("affinity_hits", 0)
+            return hits / req if req else 0.0
+
+        hit_rate()  # learn the session pins
+        warm_hit_rate = hit_rate()
+
+        errors = [0]
+        stop = threading_mod.Event()
+
+        def client_loop():
+            # a router DEATH severs in-flight TCP connections — no
+            # server-side retry can hide that, so the realistic client
+            # (and the one the e2e pins) retries against the promoted
+            # router. An error here = a request that failed even after
+            # bounded retries: actual lost work, not a dropped socket.
+            i = 0
+            while not stop.is_set():
+                for _ in range(8):
+                    try:
+                        spost("sess-%d" % (i % 4), [1 + i % 5, 2, 3])
+                        break
+                    except Exception:  # noqa: BLE001 - retried
+                        time.sleep(0.25)
+                else:
+                    errors[0] += 1
+                i += 1
+                time.sleep(0.05)
+
+        client = threading_mod.Thread(
+            target=client_loop, daemon=True,
+            name="tfos-bench-control-client")
+        client.start()
+        time.sleep(0.3)
+
+        # reservation-server death -> journal-seeded restart
+        t_crash = time.monotonic()
+        f.reservation.crash()
+        chaos_mod.poll_until(
+            lambda: all(r._backoff for r in f.replicas), timeout=30)
+        detect_s = time.monotonic() - t_crash  # beat loops noticed
+        f.restart_reservation()
+        t_restart = time.monotonic()
+        chaos_mod.poll_until(
+            lambda: all(r.beat_reconnects >= 1 for r in f.replicas),
+            timeout=30)
+        reconnect_s = time.monotonic() - t_restart
+        chaos_mod.poll_until(
+            lambda: len(f.reservation.serving_snapshot()) == 2
+            and not f.reservation.recovering(), timeout=30)
+        rebuild_s = time.monotonic() - t_restart
+        reservation_mttr_s = time.monotonic() - t_crash
+
+        # router death -> warm-standby takeover
+        sb = fleet.RouterStandby(f, probe_interval=0.1, confirm=3)
+        sb.start()
+        time.sleep(0.5)  # standby shadows at least one quota snapshot
+        t_kill = time.monotonic()
+        f.router.crash()
+        took_over = sb.took_over.wait(timeout=30)
+        takeover_s = time.monotonic() - t_kill
+        serve_s = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                spost("probe", [1, 2, 3], 2)
+                serve_s = time.monotonic() - t_kill
+                break
+            except Exception:  # noqa: BLE001 - until deadline
+                time.sleep(0.05)
+        cold_hit_rate = hit_rate()      # promoted router starts cold
+        rebuilt_hit_rate = hit_rate()   # pins re-learned from traffic
+        sb.stop()
+
+        stop.set()
+        client.join(timeout=30)
+        block["control_mttr"] = {
+            "reservation": {
+                "detect_ms": round(detect_s * 1e3, 1),
+                "reconnect_ms": round(reconnect_s * 1e3, 1),
+                "snapshot_rebuild_ms": round(rebuild_s * 1e3, 1),
+                "mttr_ms": round(reservation_mttr_s * 1e3, 1),
+            },
+            "router_takeover": {
+                "took_over": bool(took_over),
+                "takeover_ms": round(takeover_s * 1e3, 1),
+                "first_served_ms": round(serve_s * 1e3, 1)
+                if serve_s is not None else None,
+                "control_epoch": f.control_epoch,
+            },
+            "client_errors": errors[0],
+            "affinity_hit_rate": {
+                "warm_before": round(warm_hit_rate, 3),
+                "cold_after_takeover": round(cold_hit_rate, 3),
+                "rebuilt": round(rebuilt_hit_rate, 3),
+            },
+            "zero_loss": errors[0] == 0 and bool(took_over),
+        }
     return block
 
 

@@ -475,6 +475,22 @@ class AutoscaleController(object):
                 now if now is not None else time.monotonic())
 
     def _poll_locked(self, now):
+        recovering = getattr(self.fleet.reservation, "recovering",
+                             None)  # stub reservations lack it
+        if recovering is not None and recovering():
+            # control-plane recovery grace (PR 19): a restarted
+            # reservation server's snapshot is floors-without-leases
+            # until the incumbents re-announce — every view reads
+            # age None, the REPLACE signature. Scaling on that would
+            # spawn replacements (fresh epochs!) for replicas that
+            # are alive and about to re-register; hold until the
+            # grace window clears.
+            self.counters.inc("decisions")
+            decision = ScaleDecision(
+                ScaleDecision.HOLD, "reservation server recovering "
+                "(journal floors seeded, awaiting re-announce)")
+            self._record(decision, 0, len(self.fleet.replicas))
+            return decision
         views = self.views()
         decision = decide(self.policy, views, self._state, now)
         self.counters.inc("decisions")

@@ -163,7 +163,9 @@ POINTS = ("kill_trainer_at_step", "kill_trainer_at_batch",
           "stall_ring_slot", "drop_heartbeats_for", "corrupt_checkpoint",
           "kill_scheduler_at_step", "stall_decode_for",
           "disconnect_client_at_token", "drop_executor_then_return_after",
-          "kill_serving_executor_at_request"
+          "kill_serving_executor_at_request",
+          "kill_reservation_server", "kill_router_at_request",
+          "restart_reservation_after"
           ) + NET_POINTS
 
 
@@ -581,6 +583,44 @@ def on_token(tokens_emitted):
     return True
 
 
+def on_reservation_beat(beats_seen):
+    """Reservation-server BEAT site (reservation.Server._handle),
+    called with the cumulative BEAT messages this server has handled.
+    ``kill_reservation_server=N`` returns True once the N-th beat
+    lands; the server then CRASHES in place (``Server.crash()`` — the
+    in-process SIGKILL emulation: lease-table state already written,
+    the reply never sent), which is the control-plane mirror of
+    ``kill_serving_executor_at_request``. Single-shot: the in-process
+    ``fired`` latch survives the server's restart (same process), so
+    the restarted server is never re-killed at the same beat count."""
+    inj = armed("kill_reservation_server")
+    if inj is None or beats_seen < inj.value:
+        return False
+    inj.mark_fired()
+    logger.error("CHAOS kill_reservation_server: crashing the "
+                 "reservation server at BEAT %d >= %g",
+                 beats_seen, inj.value)
+    return True
+
+
+def on_router_request(requests_seen, ident=None):
+    """Fleet-router dispatch site (fleet.FleetRouter.dispatch), called
+    with the cumulative dispatches this router has seen.
+    ``kill_router_at_request=K`` returns True once the K-th dispatch
+    arrives; the router then CRASHES (listener closed mid-traffic, no
+    drain) — leader death at a deterministic point in the request
+    stream, the signature the warm-standby takeover e2e recovers
+    from. ``ident`` is the router's model name: ``only=<name>`` kills
+    ONE router when a leader and standby share the process."""
+    inj = armed("kill_router_at_request", ident)
+    if inj is None or requests_seen < inj.value:
+        return False
+    inj.mark_fired()
+    logger.error("CHAOS kill_router_at_request: crashing router %s at "
+                 "dispatch %d >= %g", ident, requests_seen, inj.value)
+    return True
+
+
 def on_heartbeat():
     """Heartbeat-publish sites; True = suppress this publish.
 
@@ -768,6 +808,47 @@ def schedule_executor_return(sc, executor_id, fuse, delay=None,
             logger.warning("chaos.schedule_executor_return failed: %s", e)
 
     t = threading.Thread(target=_returner, name="chaos-returner",
+                         daemon=True)
+    t.start()
+    return t
+
+
+def schedule_reservation_restart(fleet, delay=None, deadline=60):
+    """Driver-side half of ``kill_reservation_server``: wait for the
+    fleet's reservation server to die (its ``done`` latch — the crash
+    site sets it), sleep ``delay`` seconds of headless time, then
+    restart it via ``fleet.restart_reservation()`` — deterministic
+    "the driver comes back" for the control-plane recovery suite.
+    ``delay`` defaults to the armed ``restart_reservation_after``
+    injection's value (0 when none is armed). Returns the started
+    thread; a kill that never fires means no restart, and the
+    caller's positive assertions (zero failures, floors restored)
+    fail loudly instead of flaking."""
+    if delay is None:
+        inj = _current().get("restart_reservation_after")
+        delay = float(inj.value) if inj is not None else 0.0
+
+    def _restarter():
+        if not poll_until(lambda: fleet.reservation.done.is_set(),
+                          timeout=deadline, interval=0.02):
+            logger.warning("chaos.schedule_reservation_restart: the "
+                           "reservation server never died; not "
+                           "restarting")
+            return
+        if delay > 0:
+            time.sleep(delay)
+        inj = _current().get("restart_reservation_after")
+        if inj is not None:
+            inj.mark_fired()
+        try:
+            logger.warning("CHAOS restarting the reservation server "
+                           "(%.2fs of headless time)", delay)
+            fleet.restart_reservation()
+        except Exception as e:  # noqa: BLE001 - harness must not raise
+            logger.warning("chaos.schedule_reservation_restart "
+                           "failed: %s", e)
+
+    t = threading.Thread(target=_restarter, name="chaos-resv-restarter",
                          daemon=True)
     t.start()
     return t

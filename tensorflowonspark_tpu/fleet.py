@@ -56,6 +56,7 @@ import json
 import logging
 import math
 import os
+import random
 import socket
 import threading
 import time
@@ -511,10 +512,29 @@ class Replica(object):
     remote = False
 
     def __init__(self, server, reservation_addr, beat_interval=0.25,
-                 host_meta=None):
+                 host_meta=None, connect_timeout=2.0,
+                 reconnect_backoff=0.25, reconnect_backoff_cap=4.0):
         self.server = server
         self.reservation_addr = tuple(reservation_addr)
         self.beat_interval = float(beat_interval)
+        #: bound on ONE reconnect attempt to the reservation server —
+        #: deliberately short (seconds, not the OS connect timeout):
+        #: the beat thread holds the replica lock across the attempt,
+        #: and stop()/re_register() wait on that lock
+        self.connect_timeout = float(connect_timeout)
+        #: reconnect backoff schedule after a connection-level beat
+        #: failure: starts at ``reconnect_backoff``, doubles per
+        #: consecutive failure, capped (pre-jitter) at
+        #: ``reconnect_backoff_cap`` — the replica keeps SERVING the
+        #: whole time; only its lease announcements are delayed
+        self.reconnect_backoff = float(reconnect_backoff)
+        self.reconnect_backoff_cap = float(reconnect_backoff_cap)
+        #: reconnects survived so far (mirrors the engine's
+        #: ``beat_reconnects`` counter -> tfos_serving_beat_
+        #: reconnects_total; kept here too so engineless replicas and
+        #: tests can observe it directly)
+        self.beat_reconnects = 0
+        self._backoff = 0.0  # current delay; 0 = healthy cadence
         self.replica_id = server.replica_id
         if self.replica_id is None:
             raise ValueError(
@@ -587,19 +607,50 @@ class Replica(object):
         while not self._stop.is_set():
             if not self._beat_once():
                 return  # fenced: beating stops until re_register()
-            self._stop.wait(self.beat_interval)
+            backoff = self._backoff
+            if backoff:
+                # reservation server unreachable: jittered backoff so
+                # a fleet whose server died together doesn't hammer
+                # the restarted one in lockstep (thundering herd)
+                delay = backoff * (0.5 + random.random())
+            else:
+                delay = self.beat_interval
+            self._stop.wait(delay)
 
     def _beat_once(self):
         """One beat iteration, atomic under the replica lock (state
         reads, the exchange, and any fence latch are one unit — a
         re_register serializes entirely before or entirely after it).
         Returns False when the loop must exit (this identity was
-        fenced)."""
+        fenced).
+
+        Connection-level failures (reservation server dead, network
+        partition) are NEVER fatal to the loop: the replica keeps
+        serving headless, and the next iteration reconnects after a
+        bounded jittered backoff. The epoch belongs to the IDENTITY's
+        incarnation, not the TCP connection, so a reconnect beats the
+        SAME epoch — a restarted journal-seeded reservation server
+        adopts it (replicas are the source of truth), and only a
+        genuinely superseded epoch earns FENCED."""
         with self._lock:
             try:
                 if self._client is None:
                     self._client = reservation.Client(
-                        self.reservation_addr)
+                        self.reservation_addr,
+                        connect_timeout=self.connect_timeout)
+                    if self._backoff:
+                        # a previous iteration failed, so this connect
+                        # is a RECONNECT the operator should see
+                        self.beat_reconnects += 1
+                        engine = self.server.engine
+                        counters = getattr(engine, "counters", None)
+                        if counters is not None:
+                            counters.inc("beat_reconnects")
+                        logger.info(
+                            "replica %s beat reconnected to "
+                            "reservation server (reconnect #%d, "
+                            "epoch %s kept)", self.replica_id,
+                            self.beat_reconnects, self.epoch)
                 if self.epoch is None:
                     # acquire the fencing epoch before the first beat
                     # (and after any reconnect that lost it); the
@@ -608,6 +659,7 @@ class Replica(object):
                     self.epoch = self._client.lease(self.replica_id)
                 self._client.beat(self.replica_id, self._payload(),
                                   epoch=self.epoch)
+                self._backoff = 0.0
             except reservation.Fenced as e:
                 # NON-retriable by design: someone else holds a newer
                 # epoch for this identity. Serving on would be the
@@ -623,8 +675,14 @@ class Replica(object):
                         self.epoch, e.epoch))
                 return False
             except Exception as e:  # noqa: BLE001 - beats must survive
-                logger.warning("replica %s beat failed: %s",
-                               self.replica_id, e)
+                self._backoff = min(
+                    self.reconnect_backoff_cap,
+                    self._backoff * 2 if self._backoff
+                    else self.reconnect_backoff)
+                logger.warning(
+                    "replica %s beat failed (%s); retrying in ~%.2fs "
+                    "— replica keeps serving", self.replica_id, e,
+                    self._backoff)
                 if self._client is not None:
                     try:
                         self._client.close()
@@ -703,6 +761,19 @@ class Replica(object):
     def stop(self):
         self._stop.set()
         thread = self._thread
+        if thread is not None and thread.is_alive():
+            # a beat mid-exchange against a DEAD reservation server
+            # would otherwise hold the lock until its socket timeout;
+            # abort() closes the client's socket out of band (the one
+            # lock-free operation the client allows), so the blocked
+            # call fails NOW and teardown stays bounded
+            client = self._client  # lock-free peek: abort() is the
+            # client's designated out-of-band close, safe mid-call
+            if client is not None:
+                try:
+                    client.abort()
+                except Exception:  # noqa: BLE001
+                    pass
         if thread is not None:
             thread.join(timeout=5)
             self._thread = None
@@ -783,6 +854,7 @@ class ServingNode(object):
             self.replica = Replica(
                 self.server, tuple(spec["reservation_addr"]),
                 beat_interval=float(spec.get("beat_interval", 0.25)),
+                connect_timeout=float(spec.get("connect_timeout", 2.0)),
                 host_meta={"executor": self.executor_id,
                            "pid": os.getpid()})
         except BaseException:
@@ -856,6 +928,12 @@ class RemoteReplica(object):
         self.executor_id = executor_id
         self.admin_timeout = float(admin_timeout)
         self.connect_timeout = float(connect_timeout)
+        #: control epoch stamped on every admin RPC (PR 19): the
+        #: replica keeps a monotonic floor and refuses 409 any write
+        #: stamped below it — a deposed driver's late ship_fence/
+        #: drain/spawn can no longer land. None = unstamped
+        #: (back-compat; replicas admit header-less calls).
+        self.control_epoch = None
 
     @property
     def addr(self):
@@ -878,11 +956,15 @@ class RemoteReplica(object):
             raise RuntimeError(
                 "replica {} has no live lease (no address to reach "
                 "its admin surface)".format(self.replica_id))
+        headers = None
+        if self.control_epoch is not None:
+            headers = {"X-TFOS-Control-Epoch": str(self.control_epoch)}
         status, raw, _ = _http_request(
             addr, "POST", "/admin/{}".format(verb),
             body=json.dumps(body or {}).encode(),
             timeout=timeout if timeout is not None else self.admin_timeout,
             connect_timeout=self.connect_timeout,
+            extra_headers=headers,
             net_src="driver", net_dst=self.replica_id)
         try:
             parsed = json.loads(raw)
@@ -1207,6 +1289,9 @@ class FleetRouter(object):
         # by convention — concurrent observes would silently lose
         # samples in the very numbers the fleet bench publishes
         self._obs_lock = threading.Lock()
+        #: dispatches seen (guarded by _obs_lock) — drives the
+        #: kill_router_at_request chaos site (PR 19)
+        self._dispatch_seen = 0
         self._host, self._port = host, int(port)
         self._httpd = None
         self._thread = None
@@ -1316,6 +1401,17 @@ class FleetRouter(object):
         frees — the router must not insulate replicas from the PR-4
         disconnect contract (:class:`_ClientGone` propagates)."""
         t0 = time.monotonic()
+        # chaos site (PR 19): kill_router_at_request=K dies like a
+        # SIGKILLed router process on the K-th dispatch — mid-request,
+        # listener closed, in-flight connections reset. The standby
+        # takeover e2e and fault_plane.control_mttr bench drive it.
+        with self._obs_lock:
+            self._dispatch_seen += 1
+            seen = self._dispatch_seen
+        if chaos.on_router_request(seen, ident=self.name):
+            self.crash()
+            raise _ClientGone(
+                "chaos: router killed at request {}".format(seen))
         upstream_spent = [0.0]
         tried = set()
         # upstream attempts actually made — counted explicitly because
@@ -2550,6 +2646,30 @@ class FleetRouter(object):
             self._thread.join(timeout=10)
             self._httpd = None
 
+    def crash(self):
+        """Chaos only (PR 19): die the way a SIGKILLed router process
+        looks from outside — listening socket gone mid-traffic, no
+        drain, no goodbye. In-flight requests fail with connection
+        resets, exactly as a real kill's would; the warm-standby
+        takeover e2e pins that the fleet recovers anyway. Runs the
+        serve-loop shutdown from a helper thread because crash() is
+        typically called from INSIDE a handler thread (the
+        kill_router_at_request site)."""
+        self._probe_stop.set()
+        httpd, self._httpd = self._httpd, None
+        self._thread = None
+        if httpd is None:
+            return
+        try:
+            httpd.server_close()  # the listener dies NOW
+        except OSError:
+            pass
+        # tfos: unjoined(crash emulation — a killed process joins nothing)
+        threading.Thread(target=httpd.shutdown, daemon=True,
+                         name="tfos-fleet-router-crash").start()
+        logger.warning("fleet router %r CRASHED (chaos kill) on %s:%d",
+                       self.name, self._host, self._port)
+
     def __enter__(self):
         if self._httpd is None:
             self.start()
@@ -2596,7 +2716,7 @@ class ServingFleet(object):
                  engine_kw=None, host="127.0.0.1", beat_interval=0.25,
                  reservation_server=None, router_kw=None,
                  placement="driver", sc=None, executors=None,
-                 spawn_timeout=120.0, tiers=None):
+                 spawn_timeout=120.0, tiers=None, journal=None):
         #: tier topology (PR 17): ``{"prefill": n, "decode": m}``
         #: (any subset of prefill/decode/mixed). When given it
         #: OVERRIDES ``replicas`` — the fleet forms with exactly the
@@ -2641,9 +2761,30 @@ class ServingFleet(object):
         self.executors = list(executors) if executors is not None \
             else None
         self.spawn_timeout = float(spawn_timeout)
+        #: durable epoch-floor journal (PR 19): a PATH the fleet's
+        #: OWNED reservation server persists its fencing-epoch floors
+        #: to — what lets restart_reservation() (and a whole restarted
+        #: driver) come back unable to re-mint any epoch the old
+        #: incarnation ever issued. None = in-memory floors (pre-PR-19
+        #: behavior exactly). A ControlJournal instance is accepted
+        #: and reduced to its path: restarts must REOPEN the file, not
+        #: share a possibly-dead file handle.
+        if journal is not None and not isinstance(journal, str):
+            journal = getattr(journal, "path", None) or str(journal)
+        if journal is not None and reservation_server is not None:
+            raise ValueError(
+                "journal= applies to the fleet's OWNED reservation "
+                "server; attach the journal to your own Server "
+                "(reservation.Server(..., journal=path)) instead")
+        self.journal_path = journal
+        #: control epoch (PR 19): minted at start(), stamped on every
+        #: admin RPC this driver issues — the leadership fence a
+        #: warm-standby takeover raises to depose this driver
+        self.control_epoch = None
         self._own_reservation = reservation_server is None
         self.reservation = reservation_server \
-            if reservation_server is not None else reservation.Server(0)
+            if reservation_server is not None \
+            else reservation.Server(0, journal=self.journal_path)
         self.replicas = []
         self.router = None
         self.supervisor = None
@@ -2809,6 +2950,7 @@ class ServingFleet(object):
             node_mod.serve_replica(spec), one_task_per_executor=True,
             exclude=[e for e in alive if e != eid])
         replica = RemoteReplica(rid, self.reservation, executor_id=eid)
+        replica.control_epoch = self.control_epoch
         with self._lock:
             self._spawns[rid] = result
             self.replicas.append(replica)
@@ -2853,6 +2995,10 @@ class ServingFleet(object):
                 self._resv_addr = self.reservation.start(host=self.host)
             else:
                 self._resv_addr = self.reservation.addr
+            # leadership fence (PR 19): every admin RPC this driver
+            # issues carries this epoch; a standby that takes over
+            # mints a HIGHER one and the replicas refuse ours 409
+            self.control_epoch = self.reservation.mint_control_epoch()
             plan = self._formation_tiers()
             if self.placement == "driver":
                 for tier in plan:
@@ -3080,6 +3226,9 @@ class ServingFleet(object):
         and warming wrong prefixes."""
         body = json.dumps({"replica_id": str(rid),
                            "min_epoch": int(min_epoch)}).encode()
+        headers = None
+        if self.control_epoch is not None:
+            headers = {"X-TFOS-Control-Epoch": str(self.control_epoch)}
         for other, info in sorted(
                 self.reservation.serving_snapshot().items()):
             if other == str(rid) or not info.get("addr"):
@@ -3087,7 +3236,7 @@ class ServingFleet(object):
             try:
                 status, rbody, _ = _http_request(
                     tuple(info["addr"]), "POST", "/admin/ship_fence",
-                    body=body, timeout=5.0)
+                    body=body, timeout=5.0, extra_headers=headers)
                 if status != 200:
                     logger.warning(
                         "ship-fence broadcast to %s answered %s: %s",
@@ -3095,6 +3244,80 @@ class ServingFleet(object):
             except (OSError, http.client.HTTPException) as e:
                 logger.warning("ship-fence broadcast to %s failed: %s",
                                other, e)
+
+    def _broadcast_control_fence(self, epoch):
+        """Raise every live replica's CONTROL-epoch floor to ``epoch``
+        (POST /admin/control_fence): from the moment a replica adopts
+        it, any admin RPC stamped below — a deposed driver's late
+        ship_fence/drain/stop — is refused 409. Monotonic and
+        idempotent like the ship fence; best-effort per replica (a
+        missed replica still fences the moment the new leader's first
+        stamped admin RPC reaches it, since replicas adopt any
+        higher stamp they see)."""
+        body = json.dumps({"control_epoch": int(epoch)}).encode()
+        headers = {"X-TFOS-Control-Epoch": str(int(epoch))}
+        for other, info in sorted(
+                self.reservation.serving_snapshot().items()):
+            if not info.get("addr"):
+                continue
+            try:
+                status, rbody, _ = _http_request(
+                    tuple(info["addr"]), "POST", "/admin/control_fence",
+                    body=body, timeout=5.0, extra_headers=headers)
+                if status != 200:
+                    logger.warning(
+                        "control-fence broadcast to %s answered %s: %s",
+                        other, status, rbody[:200])
+            except (OSError, http.client.HTTPException) as e:
+                logger.warning("control-fence broadcast to %s "
+                               "failed: %s", other, e)
+
+    def restart_reservation(self, recovery_grace=None):
+        """Replace a dead reservation server with a journal-seeded
+        restart on the SAME port (every replica's beat loop is
+        retrying exactly that address) — the "driver comes back"
+        half of control-plane survivability (PR 19).
+
+        The restarted server can never re-mint a stale epoch (its
+        floors come from the journal), starts in a recovery grace
+        window while journal-known identities re-announce (the
+        supervisor/autoscaler hold dead-lease verdicts until it
+        clears), and rebuilds its serving snapshot purely from the
+        replicas' re-announced BEAT payloads — the replicas are the
+        source of truth. The router keeps routing throughout: its
+        snapshot reads simply go stale during the outage and warm
+        back as beats land. Returns the new server."""
+        old = self.reservation
+        old_addr = self._resv_addr
+        if not old.done.is_set():
+            old.stop()
+        kw = {}
+        if recovery_grace is not None:
+            kw["recovery_grace"] = recovery_grace
+        fresh = reservation.Server(0, journal=self.journal_path, **kw)
+        self._resv_addr = fresh.start(
+            host=self.host,
+            port=old_addr[1] if old_addr else 0)
+        self.reservation = fresh
+        # rewire every reader of the old (dead) server object —
+        # snapshot-based routing and admin addressing both follow
+        # self.reservation, so the swap is one reference each
+        if self.router is not None:
+            self.router.reservation = fresh
+        with self._lock:
+            for replica in self.replicas:
+                if getattr(replica, "remote", False):
+                    replica.reservation = fresh
+        # NOTE: control_epoch is NOT re-minted: the journal's control
+        # floor already covers this driver's stamp, so existing admin
+        # stamps stay valid (and without a journal, re-minting from a
+        # cold floor could mint BELOW the replicas' adopted floors)
+        logger.warning(
+            "reservation server restarted on %s (journal %s, "
+            "recovering=%s)", self._resv_addr,
+            self.journal_path or "ABSENT",
+            fresh.recovering())
+        return fresh
 
     def autoscale(self, policy=None, **controller_kw):
         """Arm the SLO-driven autoscaler (autoscale.py): a driver-side
@@ -3177,8 +3400,11 @@ class ServingFleet(object):
         if self._own_reservation:
             self.reservation.stop()
             # a stopped Server cannot serve again (its done latch stays
-            # set); give a potential re-start() a fresh one
-            self.reservation = reservation.Server(0)
+            # set); give a potential re-start() a fresh one — seeded
+            # from the same journal, so even a stop/start cycle keeps
+            # the epoch floors it already minted
+            self.reservation = reservation.Server(
+                0, journal=self.journal_path)
         self._started = False
 
     def __enter__(self):
@@ -3186,3 +3412,150 @@ class ServingFleet(object):
 
     def __exit__(self, *exc):
         self.stop()
+
+
+# -- router warm standby (PR 19) -------------------------------------------
+
+class RouterStandby(object):
+    """Warm-standby :class:`FleetRouter`: follows the fleet's state
+    passively and takes over on leader death by minting a HIGHER
+    control epoch, so the fleet keeps serving through a router crash
+    and the deposed leader can never act again (its admin RPCs are
+    stamped below the new floor — replicas refuse them 409).
+
+    Detection discipline: only CONNECTION-LEVEL failures of the
+    leader's /healthz count toward takeover. A 503 (no routable
+    replica) is an alive-but-degraded leader — taking over would
+    trade a degraded fleet for a split brain. ``confirm`` consecutive
+    misses at ``probe_interval`` bound the detection window; the
+    takeover itself is one control-epoch mint (journal-durable when
+    the reservation server has one) + one router start, so the
+    fleet-serves-again window is detection + milliseconds.
+
+    While standing by, the watch loop also shadows the leader's
+    soft state (per-tenant quota bucket levels) so the promoted
+    router starts WARM: a tenant in debt cannot launder its backlog
+    through the failover. The AffinityMap deliberately starts cold —
+    affinity is a latency optimization the first post-takeover
+    dispatches rebuild from live traffic, and inheriting stale
+    session pins from a dead router's view risks hotspotting."""
+
+    def __init__(self, fleet, probe_interval=0.25, confirm=3):
+        self.fleet = fleet
+        self.probe_interval = float(probe_interval)
+        self.confirm = int(confirm)
+        #: the promoted router (None until takeover); also installed
+        #: as ``fleet.router`` so every fleet verb follows leadership
+        self.router = None
+        self.took_over = threading.Event()
+        #: control epoch this standby minted at takeover (None before)
+        self.control_epoch = None
+        self.counters = tracing.Counters()
+        self._quota_state = {}
+        self._misses = 0
+        self._stop = threading.Event()
+        self._thread = None
+        #: serializes promotion: the watch thread and a direct
+        #: take_over() call must not both promote
+        self._lock = threading.Lock()
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._watch_loop, daemon=True,
+            name="tfos-router-standby")
+        self._thread.start()
+        return self
+
+    def _leader_alive(self):
+        """True while the leader ANSWERS — any HTTP status counts
+        (503 = degraded, not dead). Only a connection-level failure
+        (listener gone, reset, timeout) is evidence of death."""
+        router = self.fleet.router
+        if router is None or router._httpd is None:
+            return False
+        try:
+            _http_request(router.addr, "GET", "/healthz",
+                          timeout=2.0, connect_timeout=1.0,
+                          net_src="standby", net_dst="router")
+            return True
+        except (OSError, http.client.HTTPException):
+            return False
+
+    def _watch_loop(self):
+        while not self._stop.is_set():
+            if self._leader_alive():
+                self._misses = 0
+                router = self.fleet.router
+                if router is not None:
+                    # shadow the leader's quota view (thread-safe
+                    # snapshot) so takeover restores it warm
+                    self._quota_state = router._quota.snapshot()
+            else:
+                self._misses += 1
+                if self._misses >= self.confirm:
+                    try:
+                        self.take_over()
+                    except Exception:  # noqa: BLE001
+                        logger.exception(
+                            "standby takeover failed; re-confirming "
+                            "leader death")
+                        self._misses = 0
+                        self._stop.wait(self.probe_interval)
+                        continue
+                    return
+            self._stop.wait(self.probe_interval)
+
+    def take_over(self):
+        """Promote this standby NOW: mint a higher control epoch,
+        start a fresh router over the same reservation state, restore
+        the shadowed quota levels, install it as the fleet's router,
+        and fence the deposed leader fleet-wide. Idempotent-ish: a
+        second call is refused once promotion completed."""
+        with self._lock:
+            return self._take_over_locked()
+
+    def _take_over_locked(self):
+        if self.took_over.is_set():
+            raise RuntimeError("standby already took over")
+        fleet = self.fleet
+        epoch = fleet.reservation.mint_control_epoch()
+        old = fleet.router
+        if old is not None:
+            # make the deposition physical, not just logical: even a
+            # wedged-but-listening old router must stop serving before
+            # the standby opens (the no-request-served-by-both pin)
+            try:
+                old.crash()
+            except Exception:  # noqa: BLE001
+                pass
+        router = FleetRouter(fleet.reservation, name=fleet.name,
+                             host=fleet.host, replicas=fleet.replicas,
+                             **fleet.router_kw)
+        router.start()
+        router._quota.restore(self._quota_state)
+        router.metrics.add_counters("tfos_control", self.counters)
+        fleet.router = router
+        fleet.control_epoch = epoch
+        with fleet._lock:
+            for replica in fleet.replicas:
+                if getattr(replica, "remote", False):
+                    replica.control_epoch = epoch
+        fleet._broadcast_control_fence(epoch)
+        self.router = router
+        self.control_epoch = epoch
+        self.counters.inc("takeovers")
+        self.counters.gauge("epoch", epoch)
+        self.took_over.set()
+        logger.warning(
+            "standby TOOK OVER as router for %r on %s:%d (control "
+            "epoch %d; deposed leader's admin writes now refuse 409)",
+            fleet.name, router.addr[0], router.addr[1], epoch)
+        return router
+
+    def stop(self):
+        """Stop WATCHING. The promoted router (if any) now belongs to
+        the fleet — fleet.stop() owns its teardown."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

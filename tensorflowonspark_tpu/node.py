@@ -636,7 +636,11 @@ def _start_beat_thread(cluster_meta, mgr, executor_id):
                 if not chaos.on_heartbeat():
                     try:
                         if client is None:
-                            client = reservation.Client(server_addr)
+                            # short connect bound (PR 19): a dead
+                            # reservation server must cost one tick a
+                            # few seconds, not the OS connect timeout
+                            client = reservation.Client(
+                                server_addr, connect_timeout=5)
                         client.beat(executor_id, payload)
                     except Exception:  # noqa: BLE001 - beat must retry
                         # ANY send failure (conn refused, EOF mid-reply,
@@ -1473,7 +1477,11 @@ def shutdown(cluster_info, cluster_meta, queues=("input",), grace_secs=0):
         # BEFORE the error below reaches the driver.
         try:
             exit_code = None if proc is None else proc.exitcode
-            fc = reservation.Client(tuple(cluster_meta["server_addr"]))
+            # bounded connect (PR 19): "provably alive" above assumes
+            # the driver is healthy — a CRASHED reservation server
+            # must not wedge executor teardown for the OS timeout
+            fc = reservation.Client(tuple(cluster_meta["server_addr"]),
+                                    connect_timeout=5)
             try:
                 # the FULL payload, not a minimal one: a beat REPLACES
                 # the lease payload wholesale, and the goodput plane's

@@ -335,3 +335,22 @@ class QuotaTable(object):
         """{tenant: bucket level} for the tenants with live buckets."""
         with self._lock:
             return {t: b.level for t, b in self._buckets.items()}
+
+    def restore(self, levels, now=None):
+        """Seed bucket levels from another table's :meth:`snapshot` —
+        the warm-standby takeover path (PR 19): a standby router that
+        followed the leader's quota state restores it here so a tenant
+        in debt cannot launder its backlog through the failover.
+        Tenants without a configured quota are skipped; levels clamp
+        to each bucket's capacity (a stale over-full snapshot must not
+        mint burst credit). Restoring into a bucket that already has
+        live charges keeps the LOWER level — never forgives debt."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            for tenant, level in (levels or {}).items():
+                bucket = self._bucket_locked(tenant, now)
+                if bucket is None:
+                    continue
+                bucket.refill(now)
+                bucket.level = min(bucket.level,
+                                   min(bucket.capacity, float(level)))

@@ -229,7 +229,8 @@ class Server(object):
     a background thread serves REG/QUERY/QINFO/STOP until stopped.
     """
 
-    def __init__(self, count, recv_deadline=DEFAULT_RECV_DEADLINE):
+    def __init__(self, count, recv_deadline=DEFAULT_RECV_DEADLINE,
+                 journal=None, recovery_grace=5.0):
         self.reservations = Reservations(count)
         #: mid-message receive deadline armed on every accepted
         #: connection (see MessageSocket) — a half-open peer fails its
@@ -261,6 +262,30 @@ class Server(object):
         # Decision.exclude set arithmetic)
         self._cluster_width = None
         self._cluster_width_target = None
+        # durable safety floors (PR 19): when a journal is attached,
+        # every minted epoch hits disk BEFORE it leaves the building,
+        # and a restarted server seeds its mint state from the
+        # journal's floors — monotonicity survives restart by
+        # construction. `journal` accepts a ControlJournal or a path.
+        if isinstance(journal, str):
+            from tensorflowonspark_tpu import controlstate
+            journal = controlstate.ControlJournal(journal)
+        self.journal = journal
+        self._control_epoch = 0
+        #: identities whose floors came from the journal but whose
+        #: incumbents have not re-announced yet (recovery tracking)
+        self._awaiting_reannounce = set()
+        self._recovery_grace = float(recovery_grace)
+        self._recovery_deadline = None  # armed by start() when recovering
+        #: cumulative BEAT messages handled (guarded by _sup_lock) —
+        #: drives the kill_reservation_server chaos site
+        self._beats_seen = 0
+        if journal is not None:
+            floors = journal.epoch_floors()
+            if floors:
+                self._epochs.update(floors)
+                self._awaiting_reannounce = set(floors)
+            self._control_epoch = journal.control_floor()
 
     def lease_snapshot(self):
         """{executor_id: {"age": seconds since last beat, "payload": ...}}
@@ -286,12 +311,65 @@ class Server(object):
         current — every outstanding older epoch is fenced from this
         moment. The server-side half of ``Client.lease``; also callable
         in-process (the supervisor spawning a replacement replica
-        fences the incumbent BEFORE the replacement's first beat)."""
+        fences the incumbent BEFORE the replacement's first beat).
+
+        With a journal attached, the epoch is fsync'd durable BEFORE
+        it becomes current or is returned: a crash landed anywhere
+        after the journal write leaves the recovered floor >= every
+        epoch any caller ever saw (the safe direction — a floor may
+        exceed reality, never trail it)."""
         with self._sup_lock:
             epoch = self._epochs.get(executor_id, 0) + 1
+            if self.journal is not None:
+                # persist-before-publish: holding _sup_lock through
+                # the fsync serializes mints against the journal, so
+                # no later mint can return before an earlier one is
+                # durable
+                self.journal.record_epoch(executor_id, epoch)
             self._epochs[executor_id] = epoch
+            self._awaiting_reannounce.discard(executor_id)
         logger.info("lease epoch %d minted for %r", epoch, executor_id)
         return epoch
+
+    def mint_control_epoch(self):
+        """Mint the next CONTROL epoch — the admin-plane fencing token
+        (PR 19). A router taking over leadership mints one and stamps
+        every admin RPC with it; replicas refuse writes below their
+        observed floor (409), so a deposed leader's late writes land
+        nowhere. Journal-backed like lease epochs: durable before
+        returned, monotonic across server restarts by construction."""
+        with self._sup_lock:
+            epoch = self._control_epoch + 1
+            if self.journal is not None:
+                self.journal.record_control(epoch)
+            self._control_epoch = epoch
+        logger.info("control epoch %d minted", epoch)
+        return epoch
+
+    def control_epoch(self):
+        """The highest minted control epoch (0 = never minted)."""
+        with self._sup_lock:
+            return self._control_epoch
+
+    def recovering(self):
+        """True while this server is a journal-seeded restart whose
+        incumbents have not all re-announced and the recovery grace
+        window is still open. Supervisor/autoscaler dead-lease
+        classification gates on this: right after a restart the lease
+        table is EMPTY by construction (replicas re-populate it via
+        their next beats), and classifying that emptiness as fleet
+        death would trigger a pointless mass-replacement storm."""
+        with self._sup_lock:
+            if not self._awaiting_reannounce:
+                return False
+            if self._recovery_deadline is None:
+                return True  # start() not called yet — still cold
+            if time.monotonic() >= self._recovery_deadline:
+                # grace expired: whoever never re-announced really is
+                # gone; let the supervisor/autoscaler see it
+                self._awaiting_reannounce.clear()
+                return False
+            return True
 
     def drop_lease(self, identity):
         """Remove ``identity``'s lease (deliberate deregistration — a
@@ -326,6 +404,11 @@ class Server(object):
             if self._cluster_width_target is not None:
                 out["tfos_cluster_width_target"] = \
                     self._cluster_width_target
+            if self._control_epoch:
+                out["tfos_control_epoch"] = self._control_epoch
+            if self.journal is not None:
+                out["tfos_control_recovery_pending"] = \
+                    len(self._awaiting_reannounce)
             return out
 
     def serving_snapshot(self):
@@ -378,8 +461,13 @@ class Server(object):
                         "age": round(lease["age"], 3)}
         return out
 
-    def start(self, host=None):
-        """Bind and serve in the background; returns (host, port)."""
+    def start(self, host=None, port=0):
+        """Bind and serve in the background; returns (host, port).
+
+        ``port`` (default ephemeral) lets a RESTARTED server rebind
+        its predecessor's advertised port, so replicas reconnecting to
+        the address they already hold find the new incarnation without
+        re-discovery (PR 19 headless-fleet recovery)."""
         if host is None:
             from tensorflowonspark_tpu.util import get_ip_address
             host = get_ip_address()
@@ -387,10 +475,14 @@ class Server(object):
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         # Bind the wildcard so both loopback (local tests) and the routable
         # interface (real executors) can connect; advertise the routable host.
-        self._sock.bind(("", 0))
+        self._sock.bind(("", int(port)))
         self._sock.listen(64)
         port = self._sock.getsockname()[1]
         self.addr = (host, port)
+        with self._sup_lock:
+            if self._awaiting_reannounce:
+                self._recovery_deadline = \
+                    time.monotonic() + self._recovery_grace
         self._thread = threading.Thread(target=self._serve, name="reservation-server",
                                         daemon=True)
         self._thread.start()
@@ -461,17 +553,20 @@ class Server(object):
                 logger.debug("stats http: " + fmt, *args)
 
         try:
-            self._stats_httpd = ThreadingHTTPServer(("", 0), Handler)
-            self.stats_addr = (self.addr[0],
-                               self._stats_httpd.server_address[1])
-            # tfos: unjoined(stop() shuts the httpd down; serve_forever returns and the daemon exits)
-            threading.Thread(target=self._stats_httpd.serve_forever,
-                             name="reservation-stats-http",
-                             daemon=True).start()
+            httpd = ThreadingHTTPServer(("", 0), Handler)
         except OSError as e:
             logger.warning("driver stats endpoint failed to start: %s", e)
-            self._stats_httpd = None
+            with self._sup_lock:
+                self._stats_httpd = None
             self.stats_addr = None
+            return
+        with self._sup_lock:
+            self._stats_httpd = httpd
+        self.stats_addr = (self.addr[0], httpd.server_address[1])
+        # tfos: unjoined(stop() shuts the httpd down; serve_forever returns and the daemon exits)
+        threading.Thread(target=httpd.serve_forever,
+                         name="reservation-stats-http",
+                         daemon=True).start()
 
     def _serve(self):
         while not self.done.is_set():
@@ -507,7 +602,27 @@ class Server(object):
                     epoch = msg.get("epoch")
                     payload = msg.get("payload") or {}
                     with self._sup_lock:
+                        self._beats_seen += 1
+                        beats_seen = self._beats_seen
                         current = self._epochs.get(eid)
+                        if current is None and epoch is not None:
+                            # headless-fleet recovery (PR 19): a server
+                            # that never minted for this identity (cold
+                            # start, or journal deliberately moved
+                            # aside) ADOPTS the replica's announced
+                            # epoch as current — the replicas are the
+                            # source of truth for their own leases. A
+                            # journal-seeded restart never lands here:
+                            # its floors cover every epoch ever minted,
+                            # so `current` is the floor and a matching
+                            # re-announce re-registers the SAME epoch.
+                            self._epochs[eid] = int(epoch)
+                            if self.journal is not None:
+                                self.journal.record_epoch(eid, epoch)
+                            current = int(epoch)
+                            logger.info(
+                                "adopted announced epoch %d for %r",
+                                current, eid)
                         fenced = current is not None and epoch != current
                         if not fenced:
                             if epoch is not None:
@@ -516,6 +631,16 @@ class Server(object):
                                 # incarnation is current
                                 payload = dict(payload, epoch=epoch)
                             self._leases[eid] = (time.monotonic(), payload)
+                            self._awaiting_reannounce.discard(eid)
+                    # chaos site (PR 19): kill_reservation_server=N
+                    # crashes the server at the N-th BEAT, AFTER the
+                    # lease-table write but BEFORE the reply — the
+                    # SIGKILL-between-state-and-ack window the journal
+                    # property test pins (the beater sees only a dead
+                    # socket, exactly as a real kill looks)
+                    if chaos.on_reservation_beat(beats_seen):
+                        self.crash()
+                        return  # no reply: the kill ate it
                     if fenced:
                         # the stale beat must NOT refresh the lease —
                         # the replacement's is the live one — and the
@@ -587,12 +712,49 @@ class Server(object):
     def stop(self):
         self.done.set()
         self._close_listener()
-        if self._stats_httpd is not None:
-            self._stats_httpd.shutdown()
-            self._stats_httpd.server_close()
-            self._stats_httpd = None
+        with self._sup_lock:
+            httpd, self._stats_httpd = self._stats_httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self.journal is not None:
+            self.journal.close()
+
+    def crash(self):
+        """Chaos only (PR 19): die the way a SIGKILLed driver process
+        looks from outside — listener gone mid-conversation, no STOP
+        handshake, no replies to in-flight messages, no thread joins.
+        Every lease, epoch, and ack in MEMORY is lost exactly as a
+        real kill loses them; only the journal's fsync'd floors
+        survive, which is the entire point. A restarted server
+        (``journal=`` the same path) re-seeds its floors from disk and
+        re-learns the live leases from the replicas' re-announced
+        beats."""
+        logger.error("reservation server CRASHED (chaos kill) — "
+                     "in-memory leases/epochs lost, journal floors %s",
+                     "retained" if self.journal is not None
+                     else "ABSENT (no journal)")
+        self.done.set()
+        self._close_listener()
+        with self._sup_lock:
+            httpd, self._stats_httpd = self._stats_httpd, None
+        if httpd is not None:
+            try:
+                httpd.server_close()
+            except OSError:
+                pass
+            # shutdown() blocks until the serve loop notices; crash()
+            # can be called from a handler thread, so park it off-path
+            # tfos: unjoined(crash emulation — a killed process joins nothing)
+            threading.Thread(target=httpd.shutdown,
+                             daemon=True,
+                             name="tfos-resv-crash").start()
+        if self.journal is not None:
+            # a killed process's fd is simply gone; everything durable
+            # is already on disk (fsync-before-reply)
+            self.journal.close()
 
 
 class Client(object):
@@ -602,9 +764,10 @@ class Client(object):
     poll until the barrier opens, fetch the full node list.
     """
 
-    def __init__(self, server_addr):
+    def __init__(self, server_addr, connect_timeout=30):
         self.server_addr = tuple(server_addr)
-        sock = socket.create_connection(self.server_addr, timeout=30)
+        sock = socket.create_connection(self.server_addr,
+                                        timeout=connect_timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.settimeout(None)
         self._ms = MessageSocket(sock)
@@ -614,6 +777,16 @@ class Client(object):
         with self._lock:
             self._ms.send(msg)
             return self._ms.receive()
+
+    def abort(self):
+        """Out-of-band close: shut the socket down WITHOUT taking the
+        call lock, so a thread wedged inside :meth:`_call` against a
+        dead server fails out with ``ConnectionError``/``OSError``
+        immediately instead of holding its caller hostage. The bounded
+        close path driver teardown uses after a reservation-server
+        crash (PR 19) — ``close()`` itself is also lock-free, but
+        ``abort`` names the intent at call sites."""
+        self._ms.close()
 
     def register(self, meta):
         resp = self._call({"type": "REG", "meta": meta})

@@ -3107,6 +3107,18 @@ class ModelServer(object):
         # replica must accept fence broadcasts, including driver-local
         # ones that never registered drain/respawn
         self.register_admin("ship_fence", self._admin_ship_fence)
+        #: control-epoch floor (PR 19): the ADMIN-plane fence. Every
+        #: admin RPC a driver issues is stamped with its control epoch
+        #: (X-TFOS-Control-Epoch); this floor rises monotonically to
+        #: the highest stamp seen (or an explicit /admin/control_fence
+        #: broadcast), and any stamped call BELOW it is refused 409
+        #: ``kind: "ControlFenced"`` — a deposed driver's late
+        #: ship_fence/drain/stop can never land after a warm-standby
+        #: takeover. Unstamped calls pass (pre-PR-19 drivers).
+        self._control_epoch = 0
+        self._control_lock = threading.Lock()
+        self._control_counters = tracing.Counters()
+        self.register_admin("control_fence", self._admin_control_fence)
 
     # -- request handling ------------------------------------------------
 
@@ -3434,6 +3446,61 @@ class ModelServer(object):
             raise ValueError("ship_fence needs a replica_id")
         return self.ship_fence(payload["replica_id"],
                                payload.get("min_epoch", 0))
+
+    # -- control-epoch fence (PR 19) --------------------------------------
+
+    def admit_control_epoch(self, epoch):
+        """Admission check + adoption for a stamped admin RPC's
+        control epoch: a stamp at or above the floor is admitted and
+        ADOPTED (the floor rises to it — any replica the takeover
+        broadcast missed still fences the moment the new leader's
+        first stamped call arrives); a stamp below it is refused —
+        the caller is a deposed driver. Returns ``(admitted, floor)``.
+        Monotonic under its own lock; never lowers."""
+        epoch = int(epoch)
+        with self._control_lock:
+            if epoch >= self._control_epoch:
+                self._control_epoch = epoch
+                return True, epoch
+            self._control_counters.inc("admin_rejections")
+            floor = self._control_epoch
+        self._mount_control_counters()
+        logger.warning(
+            "refusing admin RPC stamped control epoch %d < floor %d "
+            "(caller is a deposed driver)", epoch, floor)
+        return False, floor
+
+    def control_epoch_floor(self):
+        """Current admin-plane control-epoch floor (0 = never saw a
+        stamped call — every stamp is admitted)."""
+        with self._control_lock:
+            return self._control_epoch
+
+    def _admin_control_fence(self, payload):
+        """POST /admin/control_fence {"control_epoch": N}: the
+        takeover broadcast. Raises the floor like any admitted stamp;
+        idempotent and monotonic, so re-broadcasts are harmless."""
+        if not isinstance(payload, dict) or \
+                payload.get("control_epoch") is None:
+            raise ValueError("control_fence needs a control_epoch")
+        epoch = int(payload["control_epoch"])
+        with self._control_lock:
+            if epoch > self._control_epoch:
+                self._control_epoch = epoch
+            floor = self._control_epoch
+        logger.info("control fence: admin RPCs now need control epoch "
+                    ">= %d", floor)
+        return {"control_epoch": floor}
+
+    def _mount_control_counters(self):
+        """Expose the control-plane counters on the CURRENT engine's
+        /metrics registry (tfos_control_admin_rejections_total).
+        Idempotent (add_counters replaces by prefix) and engine-swap
+        tolerant — re-mounted on every rejection, so a respawned
+        engine's registry picks the counters back up."""
+        metrics = getattr(self.engine, "metrics", None)
+        if metrics is not None:
+            metrics.add_counters("tfos_control", self._control_counters)
 
     def metadata(self):
         return {"model_spec": {"name": self.name,
@@ -3930,6 +3997,28 @@ class ModelServer(object):
                     if fn is None:
                         return self._send(
                             404, {"error": "not found: %s" % self.path})
+                    # control-epoch fence (PR 19): a stamped call below
+                    # the floor is a DEPOSED driver's — refuse before
+                    # the verb runs. Unstamped calls pass (back-compat;
+                    # the fence guards against a stale LEADER, which
+                    # always stamps).
+                    raw_ce = self.headers.get("X-TFOS-Control-Epoch")
+                    if raw_ce is not None:
+                        try:
+                            ce = int(raw_ce)
+                        except ValueError:
+                            return self._send(
+                                400, {"error": "malformed X-TFOS-"
+                                      "Control-Epoch: %r" % raw_ce})
+                        admitted, floor = server.admit_control_epoch(ce)
+                        if not admitted:
+                            return self._send(
+                                409, {"error": "control epoch %d is "
+                                      "below this replica's floor %d "
+                                      "(a newer driver took over)"
+                                      % (ce, floor),
+                                      "kind": "ControlFenced",
+                                      "control_epoch": floor})
                     try:
                         n = int(self.headers.get("Content-Length", "0"))
                         payload = json.loads(self.rfile.read(n) or b"{}")

@@ -768,6 +768,17 @@ class Supervisor(object):
         if watch is None:
             return
         fleet = watch["fleet"]
+        recovering = getattr(fleet.reservation, "recovering",
+                             None)  # stub reservations lack it
+        if recovering is not None and recovering():
+            # control-plane recovery grace (PR 19): a restarted
+            # journal-seeded reservation server knows the FLOORS but
+            # has not heard the incumbents re-announce yet — every
+            # lease looks expired for a beat interval or two. Those
+            # are recovery artifacts, not deaths; classifying them
+            # now would quiesce (and incident-report) a fleet of
+            # perfectly healthy replicas.
+            return
         snapshot = fleet.reservation.serving_snapshot()
         for replica in list(fleet.replicas):
             if not getattr(replica, "remote", False):

@@ -652,6 +652,30 @@ METRIC_FAMILIES = {
                               "pool_exhausted / malformed / unpaged / "
                               "engine) — 'fenced' growing means a "
                               "retired incarnation is still shipping"),
+    # -- control-plane survivability (PR 19) --
+    "tfos_serving_beat_reconnects":
+        ("counter", "", "beat-loop reconnects to the reservation "
+                        "server (bounded jittered retry after a "
+                        "connection-level beat failure; the lease "
+                        "re-registers with its SAME epoch)"),
+    "tfos_control_epoch":
+        ("gauge", "", "current control epoch (router leadership "
+                      "fence) as the reservation server publishes it; "
+                      "absent until one is minted"),
+    "tfos_control_recovery_pending":
+        ("gauge", "", "journal-seeded identities a restarted "
+                      "reservation server is still waiting to hear "
+                      "re-announce (0 once recovery completes or the "
+                      "grace window expires)"),
+    "tfos_control_takeovers":
+        ("counter", "", "warm-standby router takeovers (leader death "
+                        "detected -> higher control epoch minted -> "
+                        "standby serving)"),
+    "tfos_control_admin_rejections":
+        ("counter", "", "admin RPCs a replica refused 409 "
+                        "ControlFenced because the caller stamped a "
+                        "control epoch below the replica's floor (a "
+                        "deposed driver is still issuing writes)"),
 }
 
 

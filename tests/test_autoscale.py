@@ -609,7 +609,11 @@ def test_autoscale_closed_loop_inprocess(lm):
         assert _scaled_to(f, 2), \
             "burst must have scaled 1 -> 2 (events: {})".format(
                 ctl.events.events("autoscale_decision"))
-        assert ctl.counters.snapshot()["counts"]["scale_ups"] >= 1
+        # the new replica is tracked in fleet.replicas before the
+        # controller tallies the counter — poll the tiny gap closed
+        assert chaos.poll_until(
+            lambda: ctl.counters.snapshot()["counts"]
+            .get("scale_ups", 0) >= 1, timeout=5.0)
         # every response is bitwise solo-identical (spot-check a few)
         for i in (0, 5, 11):
             if outs[i] is not None:

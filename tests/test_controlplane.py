@@ -1,0 +1,448 @@
+"""Control-plane survivability (PR 19): router warm-standby takeover,
+headless-fleet recovery, and the control-epoch admin fence.
+
+Three layers, matching the PR's design:
+
+- UNIT — the ModelServer control-epoch gate (adopt-at-or-above,
+  409-below, malformed-header 400, unstamped back-compat), the
+  supervisor's and autoscaler's recovery-grace gating (a restarted
+  journal-seeded reservation server's empty lease table is a recovery
+  artifact, not fleet death), and the new chaos points' fire/latch
+  semantics.
+- E2E chaos (slow + chaos markers, collected by ``make chaos``) —
+  the reservation server SIGKILLed mid-traffic (in-process
+  ``Server.crash()``: listener dead, lease table dropped) and
+  restarted from its journal: ZERO client-visible failures, replicas
+  re-register with the SAME epoch, post-restart mints are strictly
+  greater, teardown after a control-plane death stays bounded.
+- E2E takeover — a warm RouterStandby promotes itself after leader
+  death at a deterministic dispatch (``kill_router_at_request``),
+  mints a higher control epoch, and the deposed leader's stamped
+  admin writes are refused 409 ControlFenced; the replica-side dedup
+  window (keyed X-TFOS-Request-Id) survives the router swap, so a
+  retried request is REPLAYED, never re-executed.
+
+The journal itself (floors, torn tails, SIGKILL property tests) is
+tests/test_controlstate.py.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tensorflowonspark_tpu import (autoscale, chaos, fleet, reservation,
+                                   serving, supervisor, tracing)
+from tensorflowonspark_tpu.models.decoder import DecoderLM
+
+V, H, NH, L, MAXLEN = 17, 32, 4, 2, 48
+
+
+@pytest.fixture(scope="module")
+def lm():
+    train = DecoderLM(vocab=V, hidden=H, num_heads=NH, num_layers=L,
+                      max_len=MAXLEN, decode=False)
+    dec = DecoderLM(vocab=V, hidden=H, num_heads=NH, num_layers=L,
+                    max_len=MAXLEN, decode=True)
+    params = train.init(jax.random.PRNGKey(7),
+                        jnp.zeros((2, MAXLEN), jnp.int32))["params"]
+    return dec, params
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    chaos.disarm()
+
+
+def _post(url, payload, timeout=120, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers=dict({"Content-Type": "application/json"},
+                     **(headers or {})))
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# -- UNIT: the ModelServer control-epoch fence -----------------------------
+
+class _StubEngine(object):
+    """Just enough engine surface for an admin-plane-only ModelServer."""
+
+    name = "m"
+
+    def __init__(self, replica_id="r0"):
+        self.replica_id = replica_id
+        self.metrics = tracing.MetricsRegistry()
+        self.counters = tracing.Counters()
+
+    def stop(self):
+        pass
+
+
+def test_control_fence_adopts_at_or_above_refuses_below():
+    s = serving.ModelServer(None, engine=_StubEngine(), name="m", port=0)
+    assert s.control_epoch_floor() == 0
+    assert s.admit_control_epoch(5) == (True, 5)   # adopt
+    assert s.admit_control_epoch(5) == (True, 5)   # at-floor: admitted
+    assert s.admit_control_epoch(4) == (False, 5)  # below: refused
+    assert s.admit_control_epoch(9) == (True, 9)   # newer leader
+
+
+def test_control_fence_http_409_400_and_unstamped_passthrough():
+    eng = _StubEngine()
+    s = serving.ModelServer(None, engine=eng, name="m", port=0)
+    host, port = s.start()
+    base = "http://%s:%d" % (host, port)
+    try:
+        # a takeover broadcast raises the floor
+        st, body = _post(base + "/admin/control_fence",
+                         {"control_epoch": 7},
+                         headers={"X-TFOS-Control-Epoch": "7"})
+        assert (st, body) == (200, {"control_epoch": 7})
+        # the deposed leader's stamped write: 409, typed kind, floor
+        st, body = _post(base + "/admin/ship_fence",
+                         {"replica_id": "x", "min_epoch": 1},
+                         headers={"X-TFOS-Control-Epoch": "3"})
+        assert st == 409
+        assert body["kind"] == "ControlFenced"
+        assert body["control_epoch"] == 7
+        # refusals are counted (tfos_control_admin_rejections_total)
+        counts = eng.metrics.snapshot()["counters"]["tfos_control"]
+        assert counts["counts"]["admin_rejections"] == 1
+        # malformed stamp: a 400, never a silent pass
+        st, body = _post(base + "/admin/ship_fence",
+                         {"replica_id": "x", "min_epoch": 1},
+                         headers={"X-TFOS-Control-Epoch": "bogus"})
+        assert st == 400
+        # UNSTAMPED writes pass (pre-PR-19 drivers keep working)
+        st, _ = _post(base + "/admin/ship_fence",
+                      {"replica_id": "x", "min_epoch": 1})
+        assert st == 200
+    finally:
+        s.stop()
+
+
+# -- UNIT: recovery-grace gating (supervisor + autoscaler) -----------------
+
+class _RecoveringReservation(object):
+    def __init__(self, recovering=True):
+        self._recovering = recovering
+        self.snapshot = {}
+
+    def recovering(self):
+        return self._recovering
+
+    def serving_snapshot(self):
+        return dict(self.snapshot)
+
+    def lease_epoch(self, rid):
+        return (self.snapshot.get(rid) or {}).get("epoch")
+
+
+class _HoldStubRouter(object):
+    def __init__(self):
+        self.holds = []
+
+    def quiesce(self, rid, reason="", owner="operator"):
+        self.holds.append(("quiesce", rid, owner))
+
+    def readmit(self, rid, owner="operator"):
+        self.holds.append(("readmit", rid, owner))
+
+
+def test_supervisor_lease_watch_holds_fire_during_recovery():
+    """Right after a journal-seeded reservation restart the lease
+    table is EMPTY by construction (replicas repopulate it with their
+    next beats). The supervisor's serving-lease watch must read that
+    as a recovery artifact — no quiesce, no loss events — until the
+    grace clears; then classification resumes as usual."""
+
+    class _Remote(object):
+        remote = True
+        replica_id = "replica-0"
+        executor_id = "e0"
+
+    class _Fleet(object):
+        def __init__(self):
+            self.replicas = [_Remote()]
+            self.reservation = _RecoveringReservation(recovering=True)
+            self.router = _HoldStubRouter()
+
+    fleet_stub = _Fleet()
+    sup = supervisor.Supervisor()
+    sup._serving_watch = {"fleet": fleet_stub, "stale_after": 1.0,
+                          "reported": set()}
+    sup._check_serving_leases()  # empty snapshot + recovering
+    assert fleet_stub.router.holds == [], \
+        "recovery-window emptiness classified as replica death"
+    assert not sup.events.events("serving_replica_lost")
+    # grace cleared, lease still missing: NOW it is a real death
+    fleet_stub.reservation._recovering = False
+    sup._check_serving_leases()
+    assert ("quiesce", "replica-0", "supervisor") \
+        in fleet_stub.router.holds
+
+
+def test_autoscaler_holds_during_recovery():
+    """The post-restart empty snapshot reads as age-None views — the
+    REPLACE signature. Scaling on it would spawn replacements (fresh
+    epochs!) for replicas that are alive and about to re-announce."""
+
+    class _R(object):
+        def __init__(self, rid):
+            self.replica_id = rid
+
+    class _Fleet(object):
+        placement = "driver"
+        router = None
+
+        def __init__(self):
+            self.replicas = [_R("replica-0")]
+            self.reservation = _RecoveringReservation(recovering=True)
+
+    stub = _Fleet()
+    ctl = autoscale.AutoscaleController(stub)
+    d = ctl.poll_once()
+    assert d.action == autoscale.ScaleDecision.HOLD
+    assert "recovering" in d.reason
+    # the hold is a decision, not a skipped poll: counted + recorded
+    assert ctl.counters.snapshot()["counts"]["decisions"] == 1
+
+
+# -- UNIT: the new chaos points --------------------------------------------
+
+def test_chaos_kill_reservation_server_point_fires_once():
+    chaos.arm("kill_reservation_server=3")
+    assert not chaos.on_reservation_beat(2)
+    assert chaos.on_reservation_beat(3)
+    # single-shot: the fired latch survives an in-process restart, so
+    # the restarted server is never re-killed at the same beat count
+    assert not chaos.on_reservation_beat(99)
+
+
+def test_chaos_kill_router_at_request_scopes_by_name():
+    chaos.arm("kill_router_at_request=2,only=lm")
+    assert not chaos.on_router_request(5, ident="other")
+    assert not chaos.on_router_request(1, ident="lm")
+    assert chaos.on_router_request(2, ident="lm")
+    assert not chaos.on_router_request(3, ident="lm")
+
+
+# -- E2E: reservation-server death + journal-seeded restart ----------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_reservation_bounce_zero_failures_same_epochs(lm, tmp_path):
+    """The headless-fleet acceptance e2e: chaos SIGKILLs the
+    reservation server at the N-th BEAT (in-process ``crash()`` —
+    lease state gone, reply never sent), the fleet keeps serving
+    HEADLESS (beat loops back off with jitter, replicas never stop
+    answering), and ``schedule_reservation_restart`` brings the
+    driver back from the journal. Pins: zero client-visible failures,
+    every replica re-registers with the SAME epoch it already held,
+    reconnects are counted, ``recovering()`` clears on re-announce,
+    and a post-restart mint is strictly above every pre-crash epoch."""
+    dec, params = lm
+    journal = str(tmp_path / "control.journal")
+    with fleet.ServingFleet(dec, params, replicas=2, name="lm",
+                            engine_kw={"slots": 2}, beat_interval=0.1,
+                            journal=journal) as f:
+        url = f.url("/v1/models/lm:generate")
+        _post(url, {"prompt": [1, 2, 3], "max_new_tokens": 2})  # warm
+        pre_epochs = {r.replica_id: r.epoch for r in f.replicas}
+        assert all(e is not None for e in pre_epochs.values())
+
+        chaos.arm("kill_reservation_server=8;"
+                  "restart_reservation_after=0.4")
+        restarter = chaos.schedule_reservation_restart(f)
+        dead_server = f.reservation
+
+        failures, ok = [], []
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                try:
+                    st, body = _post(url, {"prompt": [1, 2, 3],
+                                           "max_new_tokens": 4})
+                    (ok if st == 200 else failures).append((st, body))
+                except Exception as e:  # noqa: BLE001 - the assertion
+                    failures.append(("exc", repr(e)))
+                time.sleep(0.05)
+
+        t = threading.Thread(target=client, daemon=True,
+                             name="tfos-test-bounce-client")
+        t.start()
+        try:
+            assert chaos.poll_until(dead_server.done.is_set,
+                                    timeout=30), "chaos kill never fired"
+            restarter.join(timeout=30)
+            assert f.reservation is not dead_server, "never restarted"
+            # replicas re-announce with the SAME epoch (no re-mint:
+            # the incumbents were never superseded)
+            assert chaos.poll_until(
+                lambda: {k: v.get("epoch") for k, v in
+                         f.reservation.serving_snapshot().items()
+                         } == pre_epochs,
+                timeout=30), "replicas never re-registered"
+            assert chaos.poll_until(
+                lambda: not f.reservation.recovering(), timeout=30)
+            # a few more requests through the healed plane
+            time.sleep(0.5)
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        assert ok, "no traffic made it through at all"
+        assert not failures, \
+            "client-visible failures across the bounce: %s" % failures[:3]
+        # every reconnect was survived, counted, and exported
+        for r in f.replicas:
+            assert r.beat_reconnects >= 1, r.replica_id
+            assert r.engine.counters.snapshot()["counts"].get(
+                "beat_reconnects", 0) >= 1, r.replica_id
+        # durable floors: a fresh mint lands strictly above the
+        # pre-crash epoch even though the server restarted
+        assert f.reservation.mint_epoch("some-new-identity") == 1
+        fenced_rid = f.replicas[0].replica_id
+        assert f.reservation.mint_epoch(fenced_rid) \
+            > pre_epochs[fenced_rid]
+        f.replicas[0].re_register()  # undo the probe mint's fence
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_stop_bounded_after_reservation_crash(lm, tmp_path):
+    """Teardown wall-time pin: ``ServingFleet.stop()`` after the
+    reservation server died must complete in bounded time — the beat
+    loops' in-flight reconnect attempts are aborted out-of-band
+    (Client.abort), not waited out."""
+    dec, params = lm
+    journal = str(tmp_path / "control.journal")
+    f = fleet.ServingFleet(dec, params, replicas=2, name="lm",
+                           engine_kw={"slots": 2}, beat_interval=0.1,
+                           journal=journal)
+    f.start()
+    _post(f.url("/v1/models/lm:generate"),
+          {"prompt": [1, 2, 3], "max_new_tokens": 2})
+    f.reservation.crash()
+    t0 = time.monotonic()
+    f.stop()
+    took = time.monotonic() - t0
+    assert took < 10.0, \
+        "teardown hung %.1fs waiting on a dead reservation server" % took
+
+
+# -- E2E: router warm-standby takeover -------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_router_standby_takeover_fences_old_leader(lm, tmp_path):
+    """Leader death at a deterministic dispatch: chaos crashes the
+    router on its K-th request (listener closed mid-traffic, no
+    drain); the warm standby confirms death over consecutive probes,
+    mints a HIGHER control epoch, starts a fresh router on the same
+    replica set, and broadcasts the new floor. Pins: the standby
+    serves within a bounded window; the deposed leader's stamped
+    admin write is refused 409 ControlFenced (split-brain cannot
+    write); the old listener is actually dead (no request can be
+    served by both); the replica-side dedup window survives the swap
+    (a retried X-TFOS-Request-Id is REPLAYED, not re-executed)."""
+    dec, params = lm
+    journal = str(tmp_path / "control.journal")
+    with fleet.ServingFleet(dec, params, replicas=2, name="lm",
+                            engine_kw={"slots": 2}, beat_interval=0.1,
+                            journal=journal) as f:
+        url = f.url("/v1/models/lm:generate")
+        _post(url, {"prompt": [1, 2, 3], "max_new_tokens": 2})  # warm
+        old_router = f.router
+        old_addr = old_router.addr
+        old_epoch = f.control_epoch
+        assert old_epoch is not None and old_epoch >= 1
+
+        # seed a completion on a known id DIRECTLY on a replica: the
+        # dedup window is server-level state, untouched by routers
+        rep = f.replicas[0]
+        rep_url = "http://%s:%d/v1/models/lm:generate" % tuple(rep.addr)
+        body = {"prompt": [2, 3, 4], "max_new_tokens": 4}
+        st, first = _post(rep_url, body,
+                          headers={"X-TFOS-Request-Id": "req-pr19"})
+        assert st == 200
+        prefills = rep.engine.counters.snapshot()["counts"]["prefills"]
+
+        sb = fleet.RouterStandby(f, probe_interval=0.1, confirm=3)
+        sb.start()
+        try:
+            chaos.arm("kill_router_at_request=2,only=lm")
+            # drive dispatches until the kill lands; the in-flight
+            # request dies WITH the leader (connection reset) — that
+            # one client retries after takeover, like any real client
+            pending = []
+            for i in range(2):
+                try:
+                    st, _ = _post(url, {"prompt": [1 + i, 2, 3],
+                                        "max_new_tokens": 2})
+                    assert st == 200
+                except Exception:  # noqa: BLE001 - retried below
+                    pending.append(i)
+            assert sb.took_over.wait(timeout=30), \
+                "standby never took over"
+            assert f.control_epoch > old_epoch
+            assert f.router is not old_router
+            # bounded takeover window: the promoted router serves
+            new_url = f.url("/v1/models/lm:generate")
+            deadline = time.monotonic() + 15
+            served = False
+            while time.monotonic() < deadline and not served:
+                try:
+                    st, _ = _post(new_url, {"prompt": [5, 2, 3],
+                                            "max_new_tokens": 2},
+                                  timeout=30)
+                    served = st == 200
+                except Exception:  # noqa: BLE001 - until deadline
+                    time.sleep(0.1)
+            assert served, "promoted router never served"
+            for i in pending:  # the killed request's retry completes
+                st, _ = _post(new_url, {"prompt": [1 + i, 2, 3],
+                                        "max_new_tokens": 2})
+                assert st == 200
+            # no request can be served by BOTH: old listener is dead
+            with pytest.raises(OSError):
+                _post("http://%s:%d/v1/models/lm:generate"
+                      % tuple(old_addr),
+                      {"prompt": [1], "max_new_tokens": 1}, timeout=5)
+            # the deposed leader's late admin write: 409 ControlFenced
+            st, resp = _post(
+                "http://%s:%d/admin/ship_fence" % tuple(rep.addr),
+                {"replica_id": "x", "min_epoch": 1},
+                headers={"X-TFOS-Control-Epoch": str(old_epoch)})
+            assert st == 409 and resp["kind"] == "ControlFenced", \
+                (st, resp)
+            assert resp["control_epoch"] == f.control_epoch
+            # ...while the NEW leader's stamp is admitted
+            st, _ = _post(
+                "http://%s:%d/admin/ship_fence" % tuple(rep.addr),
+                {"replica_id": "x", "min_epoch": 1},
+                headers={"X-TFOS-Control-Epoch": str(f.control_epoch)})
+            assert st == 200
+            # takeover observability: counted on the standby's family
+            assert sb.counters.snapshot()["counts"]["takeovers"] == 1
+            # dedup survived the router swap: same id -> REPLAY of the
+            # original completion, zero duplicate execution
+            st, again = _post(rep_url, body,
+                              headers={"X-TFOS-Request-Id": "req-pr19"})
+            assert st == 200
+            assert again == first, "replay must be the ORIGINAL result"
+            assert rep.engine.counters.snapshot()["counts"][
+                "prefills"] == prefills, \
+                "duplicate completion after router death"
+        finally:
+            sb.stop()

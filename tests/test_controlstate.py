@@ -1,0 +1,291 @@
+"""Durable control-plane state (PR 19): the append-only fsync'd
+journal under the reservation server's fencing epochs.
+
+The safety property the whole file circles: **a restarted reservation
+server can never mint an epoch at-or-below one any caller ever saw.**
+The journal guarantees it by persist-before-publish — the epoch hits
+disk (fsync) BEFORE it becomes current or is returned — so a crash
+anywhere in the mint path leaves the recovered floor >= every epoch
+that escaped. The floor may run AHEAD of reality (crash after fsync,
+before reply: the caller never saw the epoch the journal remembers);
+it can never trail it. Tests pin both directions:
+
+- journal mechanics: floor = max not last, torn FINAL line tolerated
+  (the one write a SIGKILL can shear), mid-file corruption refused
+  LOUDLY (``JournalCorrupt`` — silently dropping floors would unlock
+  split-brain), compaction preserves floors, close is idempotent;
+- server integration: journal-seeded restart mints strictly above
+  every pre-crash epoch, the crash-between-fsync-and-reply window
+  (monkeypatched record-then-raise), ``recovering()`` grace
+  semantics;
+- property tests: seeded-random mint/crash interleavings, in-process
+  (abandon the server object: SIGKILL runs no handlers) and
+  out-of-process (a real SIGKILL mid-mint-loop) — after every
+  restart, floor >= every epoch the dead server ever returned.
+"""
+
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import controlstate, reservation
+from tensorflowonspark_tpu.controlstate import ControlJournal, JournalCorrupt
+
+
+# -- journal mechanics -----------------------------------------------------
+
+def test_journal_roundtrip_floors_and_meta(tmp_path):
+    path = str(tmp_path / "control.journal")
+    j = ControlJournal(path)
+    j.record_epoch("replica-0", 1)
+    j.record_epoch("replica-0", 2)
+    j.record_epoch("replica-1", 7)
+    j.record_control(3)
+    j.record_lease_meta("replica-0", {"addr": ["127.0.0.1", 9000]})
+    j.close()
+
+    j2 = ControlJournal(path)
+    assert j2.epoch_floors() == {"replica-0": 2, "replica-1": 7}
+    assert j2.epoch_floor("replica-0") == 2
+    assert j2.epoch_floor("never-seen") == 0
+    assert j2.control_floor() == 3
+    assert j2.lease_meta()["replica-0"] == {"addr": ["127.0.0.1", 9000]}
+    j2.close()
+
+
+def test_journal_floor_is_max_not_last(tmp_path):
+    # out-of-order records (a compaction artifact, or clock-free
+    # replay): recovery must take the MAX per identity, not the last
+    path = str(tmp_path / "control.journal")
+    j = ControlJournal(path)
+    j.record_epoch("r", 5)
+    j.record_epoch("r", 3)
+    j.record_control(4)
+    j.record_control(2)
+    j.close()
+    j2 = ControlJournal(path)
+    assert j2.epoch_floor("r") == 5
+    assert j2.control_floor() == 4
+    j2.close()
+
+
+def test_torn_final_line_tolerated(tmp_path):
+    # SIGKILL mid-write shears at most the FINAL line; recovery keeps
+    # every complete line before it and appending again just works
+    path = str(tmp_path / "control.journal")
+    j = ControlJournal(path)
+    j.record_epoch("r", 1)
+    j.record_epoch("r", 2)
+    j.close()
+    with open(path, "ab") as fh:
+        fh.write(b'{"t":"epoch","id":"r","e')  # torn: no newline, half JSON
+    j2 = ControlJournal(path)
+    assert j2.epoch_floor("r") == 2
+    j2.record_epoch("r", 3)  # appends cleanly after the torn tail
+    j2.close()
+    j3 = ControlJournal(path)
+    assert j3.epoch_floor("r") == 3
+    j3.close()
+
+
+def test_mid_file_corruption_refuses_loudly(tmp_path):
+    # a bad line ANYWHERE but the tail is not a crash artifact — it is
+    # lost floors. Guessing here could mint below an issued epoch
+    # (split-brain), so recovery must refuse loudly instead.
+    path = str(tmp_path / "control.journal")
+    j = ControlJournal(path)
+    j.record_epoch("r", 1)
+    j.record_epoch("r", 2)
+    j.close()
+    lines = open(path, "rb").read().splitlines(keepends=True)
+    lines[0] = b"@@garbage@@\n"
+    with open(path, "wb") as fh:
+        fh.writelines(lines)
+    with pytest.raises(JournalCorrupt):
+        ControlJournal(path)
+
+
+def test_compaction_preserves_floors(tmp_path):
+    path = str(tmp_path / "control.journal")
+    j = ControlJournal(path, compact_every=8)
+    for e in range(1, 50):
+        j.record_epoch("a", e)
+    j.record_control(9)
+    j.record_lease_meta("a", {"k": "v"})
+    # compaction rewrote the file down to one record per key
+    assert sum(1 for _ in open(path)) < 49
+    j.close()
+    j2 = ControlJournal(path)
+    assert j2.epoch_floor("a") == 49
+    assert j2.control_floor() == 9
+    assert j2.lease_meta()["a"] == {"k": "v"}
+    j2.close()
+
+
+def test_journal_close_idempotent(tmp_path):
+    j = ControlJournal(str(tmp_path / "c.journal"))
+    j.record_epoch("r", 1)
+    j.close()
+    j.close()  # no raise
+
+
+# -- server integration ----------------------------------------------------
+
+def test_server_seeds_floors_and_mints_strictly_above(tmp_path):
+    path = str(tmp_path / "control.journal")
+    srv = reservation.Server(0, journal=path)
+    e_a = srv.mint_epoch("replica-a")
+    e_a = srv.mint_epoch("replica-a")
+    e_b = srv.mint_epoch("replica-b")
+    ce = srv.mint_control_epoch()
+    # abandon without stop(): SIGKILL runs no handlers
+    srv2 = reservation.Server(0, journal=path)
+    assert srv2.lease_epoch("replica-a") == e_a  # floor seeded
+    assert srv2.mint_epoch("replica-a") > e_a
+    assert srv2.mint_epoch("replica-b") > e_b
+    assert srv2.mint_control_epoch() > ce
+
+
+def test_crash_between_fsync_and_reply_floor_runs_ahead(tmp_path):
+    # the narrowest kill window: journal write landed, the reply never
+    # did. The caller never saw epoch 2 — but the restarted floor
+    # remembers it, so the next mint is 3. The floor exceeds reality;
+    # it never trails it (the safe direction).
+    path = str(tmp_path / "control.journal")
+    srv = reservation.Server(0, journal=path)
+    e1 = srv.mint_epoch("r")
+    real = srv.journal.record_epoch
+
+    def record_then_die(identity, epoch):
+        real(identity, epoch)
+        raise RuntimeError("SIGKILL between fsync and reply")
+
+    srv.journal.record_epoch = record_then_die
+    with pytest.raises(RuntimeError):
+        srv.mint_epoch("r")
+    assert srv.lease_epoch("r") == e1  # never published in-process
+
+    srv2 = reservation.Server(0, journal=path)
+    e_next = srv2.mint_epoch("r")
+    assert e_next == e1 + 2, \
+        "floor must cover the unacked epoch (ahead of reality, never behind)"
+
+
+def test_recovering_grace_semantics(tmp_path):
+    path = str(tmp_path / "control.journal")
+    seed = reservation.Server(0, journal=path)
+    seed.mint_epoch("replica-0")
+    seed.mint_epoch("replica-1")
+
+    srv = reservation.Server(0, journal=path, recovery_grace=5.0)
+    # cold (start() not called): still recovering — no deadline armed
+    assert srv.recovering()
+    # a fresh mint for an identity is an explicit supersession — that
+    # identity is no longer awaited
+    srv.mint_epoch("replica-0")
+    assert srv.recovering(), "replica-1 still awaited"
+    # grace expiry: whoever never re-announced really is gone
+    srv._recovery_deadline = time.monotonic() - 1.0
+    assert not srv.recovering()
+    assert not srv.recovering()  # stays cleared
+
+
+def test_server_without_journal_unchanged(tmp_path):
+    # back-compat: journal-less servers mint from memory exactly as
+    # before and never report recovering
+    srv = reservation.Server(0)
+    assert srv.mint_epoch("r") == 1
+    assert not srv.recovering()
+    assert srv.mint_control_epoch() == 1
+
+
+# -- property tests: random mint/crash interleavings -----------------------
+
+def test_property_floor_covers_every_returned_epoch(tmp_path):
+    """Seeded-random interleavings of mint_epoch / mint_control_epoch /
+    crash-and-restart (abandoning the server object — SIGKILL runs no
+    handlers, so no stop()/close() runs). Invariant after EVERY
+    restart: the next mint for any identity is strictly greater than
+    every epoch any incarnation ever returned for it."""
+    rng = random.Random(0xC0FFEE)
+    for trial in range(10):
+        path = str(tmp_path / ("j%d.journal" % trial))
+        srv = reservation.Server(0, journal=path)
+        returned = {}     # identity -> max epoch ever handed to a caller
+        control_max = 0
+        for _ in range(rng.randint(20, 80)):
+            roll = rng.random()
+            if roll < 0.55:
+                ident = "id-%d" % rng.randint(0, 4)
+                e = srv.mint_epoch(ident)
+                assert e > returned.get(ident, 0), (trial, ident, e)
+                returned[ident] = e
+            elif roll < 0.75:
+                ce = srv.mint_control_epoch()
+                assert ce > control_max, (trial, ce, control_max)
+                control_max = ce
+            else:
+                # crash: abandon without cleanup, restart from journal
+                srv = reservation.Server(0, journal=path)
+        # final crash + restart, then audit every identity
+        srv = reservation.Server(0, journal=path)
+        for ident, emax in returned.items():
+            assert srv.mint_epoch(ident) > emax, (trial, ident)
+        if control_max:
+            assert srv.mint_control_epoch() > control_max, trial
+
+
+_KILL_CHILD = r"""
+import sys, time
+from tensorflowonspark_tpu import reservation
+srv = reservation.Server(0, journal=sys.argv[1])
+out = open(sys.argv[2], "a", buffering=1)
+i = 0
+while True:
+    e = srv.mint_epoch("id-%d" % (i % 3))
+    # the epoch is "returned to a caller" the moment it is written out
+    out.write("id-%d %d\n" % (i % 3, e))
+    i += 1
+    time.sleep(0.002)
+"""
+
+
+@pytest.mark.parametrize("delay", [0.05, 0.15, 0.3])
+def test_real_sigkill_mid_mint_loop_floor_covers_output(tmp_path, delay):
+    """Out-of-process kill point: a child mints epochs in a tight loop,
+    reporting each one the instant a caller would see it; the parent
+    SIGKILLs it at an arbitrary moment (no handlers run — the genuine
+    article, not an emulation). A journal-seeded restart must mint
+    strictly above every epoch the dead child ever reported."""
+    journal = str(tmp_path / "control.journal")
+    report = str(tmp_path / "minted.txt")
+    env = dict(os.environ, PYTHONPATH=os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + [p for p in (os.environ.get("PYTHONPATH"),) if p]))
+    proc = subprocess.Popen([sys.executable, "-c", _KILL_CHILD,
+                             journal, report], env=env)
+    try:
+        deadline = time.monotonic() + 30
+        while not (os.path.exists(report) and os.path.getsize(report)):
+            assert proc.poll() is None, "mint child died on its own"
+            assert time.monotonic() < deadline, "child never minted"
+            time.sleep(0.01)
+        time.sleep(delay)
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+
+    seen = {}
+    for line in open(report):
+        parts = line.split()
+        if len(parts) == 2:  # final line may be torn, like the journal's
+            seen[parts[0]] = max(seen.get(parts[0], 0), int(parts[1]))
+    assert seen, "child reported no mints"
+    srv = reservation.Server(0, journal=journal)
+    for ident, emax in seen.items():
+        assert srv.mint_epoch(ident) > emax, ident

@@ -576,7 +576,7 @@ class _FenceOnceClient(object):
     barrier = None
     fenced_once = False
 
-    def __init__(self, addr):
+    def __init__(self, addr, **kw):  # accepts connect_timeout etc.
         pass
 
     def lease(self, rid):

@@ -289,6 +289,37 @@ def test_quota_table_unlimited_tenant_has_no_bucket():
     assert qt.snapshot() == {}
 
 
+def test_quota_table_restore_never_forgives_debt():
+    """Warm-standby takeover (PR 19): the promoted router restores the
+    dead leader's quota snapshot so a tenant in debt cannot launder
+    its backlog through the failover. Restore keeps the LOWER of the
+    snapshot and the live level, clamps to capacity (a stale over-full
+    snapshot must not mint burst credit), and skips tenants without a
+    configured quota."""
+    policy = qos.QosPolicy(quotas={"debtor": 10.0, "saver": 10.0},
+                           burst_s=1.0)
+    clock = [0.0]
+    qt = qos.QuotaTable(policy, clock=lambda: clock[0])
+    # the leader's last known state: debtor deep in debt
+    leader_state = {"debtor": -15.0, "saver": 4.0,
+                    "overfull": 999.0, "unlimited-tenant": 1.0}
+    qt.restore(leader_state)
+    assert qt.snapshot()["debtor"] == pytest.approx(-15.0)
+    assert qt.snapshot()["saver"] == pytest.approx(4.0)
+    assert "unlimited-tenant" not in qt.snapshot()  # no quota, no bucket
+    with pytest.raises(qos.QuotaExceeded):
+        qt.admit("debtor")  # the debt followed the failover
+    qt.admit("saver")
+    # restoring an over-full level clamps to capacity
+    qt2 = qos.QuotaTable(policy, clock=lambda: clock[0])
+    qt2.restore({"saver": 999.0})
+    assert qt2.snapshot()["saver"] == pytest.approx(10.0)
+    # restoring ONTO live charges keeps the lower level (never up)
+    qt2.charge("saver", 8)
+    qt2.restore({"saver": 10.0})
+    assert qt2.snapshot()["saver"] == pytest.approx(2.0)
+
+
 # -- engine integration ----------------------------------------------------
 
 

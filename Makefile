@@ -9,7 +9,7 @@ PYTHON ?= python
 SHELL := /bin/bash
 
 .PHONY: test test-fast bench smoke install lint native clean chaos \
-  metrics-lint racecheck goodput-report
+  metrics-lint racecheck goodput-report slo-lint slo-report
 
 install:
 	$(PYTHON) -m pip install -e .
@@ -35,6 +35,22 @@ metrics-lint:
 racecheck:
 	$(PYTHON) -m tensorflowonspark_tpu.analysis
 
+# SLO-spec drift gate (PR 20): every spec in slo.DEFAULT_SPECS (plus
+# any deployment extras passed as args) must reference a family that
+# exists in tracing.METRIC_FAMILIES with the right type — a spec
+# naming a family the code no longer exports would evaluate against
+# silence forever (scripts/slo_lint.py; merge-gate prerequisite)
+slo-lint:
+	$(PYTHON) scripts/slo_lint.py
+
+# serving SLO plane (PR 20): render the budget/burn/canary verdict —
+# hermetic demo here; point scripts/slo_report.py --url at a live
+# fleet router for a real fleet (the burn-rate and canary e2es ride
+# `make chaos`; `make bench` publishes the serving_fleet.slo leg)
+slo-report:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+	$(PYTHON) scripts/slo_report.py --demo
+
 # goodput plane (PR 10): render the badput/straggler tables — hermetic
 # demo here; point scripts/goodput_report.py --url at a live driver's
 # stats port for a real job (the chaos goodput e2e rides `make chaos`
@@ -45,7 +61,7 @@ goodput-report:
 
 # per-suite wall clock cap via coreutils timeout (pytest-timeout is not a
 # hard dependency); a wedged multi-process test fails CI instead of hanging
-test: metrics-lint racecheck
+test: metrics-lint racecheck slo-lint
 	timeout $(SUITE_TIMEOUT) $(PYTHON) -m pytest tests/ -q
 
 # example-surface smokes (tests/test_examples.py) add ~12 min of

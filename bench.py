@@ -1696,6 +1696,181 @@ def _qos_leg(slots=4, block_size=16, kv_blocks=192, quiet_reqs=10,
     }
 
 
+def _slo_leg(slots=4, n_requests=12, gray_delay_s=0.5):
+    """serving_fleet.slo (PR 20): the SLO plane's three verdicts,
+    measured live rather than asserted.
+
+    ``burn`` — a 1-replica fleet with a tiny-window router-observed
+    latency SLO (threshold well under the injected delay): error-budget
+    remaining and firing state healthy vs under a gray link
+    (``net_delay`` on the router->replica hop) vs after the heal — the
+    raise/clear cycle the chaos e2e pins, with the measured fast-window
+    burn published.  The windows are driven with an injected clock
+    (``SloMonitor.sample(now=)``), so the leg takes seconds, not the
+    window lengths.
+
+    ``canary`` — a real tenant's request p99 with the canary loop OFF
+    vs ON at a 4 Hz cadence (~20x a production probe rate) against a
+    2-replica fleet, plus the canary's own probe/failure/drift
+    counters: the zero-displacement claim as a measured ratio (the
+    acceptance pin is <= 1.05x on a quiet box; CI noise is published,
+    not hidden).
+
+    ``attribution`` — mean cost of the pure critical-path sweep over
+    the fleet's real stitched traces vs the mean request wall; the
+    acceptance pin is < 1% of request wall."""
+    import json as json_mod
+    import threading
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu import chaos
+    from tensorflowonspark_tpu import fleet as fleet_mod
+    from tensorflowonspark_tpu import slo as slo_mod
+
+    train, dec = _serving_model(False)
+    params = train.init(jax.random.PRNGKey(0),
+                        np.zeros((1, dec.max_len), np.int32))["params"]
+    rs = np.random.RandomState(11)
+    prompts = [[int(t) for t in rs.randint(1, dec.vocab, 8)]
+               for _ in range(n_requests)]
+
+    def pctl(walls, q):
+        walls = sorted(walls)
+        return walls[min(len(walls) - 1,
+                         int(math.ceil(q * len(walls))) - 1)]
+
+    def post(url, prompt, max_new, tenant=None):
+        payload = {"prompt": prompt, "max_new_tokens": max_new}
+        if tenant is not None:
+            payload["tenant"] = tenant
+        req = urllib.request.Request(
+            url, data=json_mod.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        t0 = time.monotonic()
+        with urllib.request.urlopen(req, timeout=600) as r:
+            r.read()
+        return time.monotonic() - t0
+
+    spec = ("name=wall,kind=latency,family=tfos_fleet_request_seconds,"
+            "threshold=0.25,objective=0.9,fast=2/8/2,slow=4/16/1.5")
+    out = {}
+    with fleet_mod.ServingFleet(dec, params, replicas=1, name="model",
+                                engine_kw={"slots": slots},
+                                router_kw={"slo": spec}) as f:
+        url = f.url("/v1/models/model:generate")
+        monitor = f.router.slo
+        for p in prompts:  # warm + healthy traffic under the bound
+            post(url, p, 4)
+        monitor.sample(now=0.0)
+        healthy = monitor.sample(now=1.0)[0]
+        try:
+            chaos.arm("net_delay={},only=router:replica-0".format(
+                gray_delay_s))
+            gray_walls = [post(url, p, 4) for p in prompts[:6]]
+        finally:
+            chaos.disarm()
+        gray = monitor.sample(now=3.0)[0]
+        monitor.sample(now=18.0)
+        healed_walls = [post(url, p, 4) for p in prompts[:4]]
+        healed = monitor.sample(now=19.5)[0]
+        out["burn"] = {
+            "gray_delay_s": gray_delay_s,
+            "healthy": {
+                "firing": healthy["firing"],
+                "budget_remaining": healthy["error_budget_remaining"],
+            },
+            "gray": {
+                "firing": gray["firing"],
+                "budget_remaining": gray["error_budget_remaining"],
+                "fast_short_burn": gray["windows"][0]["short_burn"],
+                "request_p99_ms": round(pctl(gray_walls, 0.99) * 1e3, 1),
+            },
+            "healed": {
+                "firing": healed["firing"],
+                "request_p99_ms": round(
+                    pctl(healed_walls, 0.99) * 1e3, 1),
+            },
+            "alerts_total": monitor.engine.alerts_total(),
+            "incidents": [i["kind"] for i in monitor.incidents()],
+        }
+        # the full /slo-shaped document, for slo_report.py --from-bench
+        out["verdict"] = monitor.verdict(now=20.0)
+        # attribution overhead over the SAME fleet's real traces
+        with urllib.request.urlopen(f.url("/debug/trace"),
+                                    timeout=60) as r:
+            doc = json_mod.loads(r.read())
+        ids = sorted({int(e["tid"]) for e in doc["traceEvents"]
+                      if e.get("ph") == "X"
+                      and int(e.get("tid", 0)) > 0})
+        t0 = time.monotonic()
+        reports = [slo_mod.attribute_trace(doc, trace) for trace in ids]
+        sweep_s = time.monotonic() - t0
+        walls = [rep["wall_s"] for rep in reports if rep["wall_s"]]
+        mean_wall = sum(walls) / max(len(walls), 1)
+        per_request = sweep_s / max(len(ids), 1)
+        out["attribution"] = {
+            "requests_attributed": len(ids),
+            "mean_request_wall_ms": round(mean_wall * 1e3, 2),
+            "sweep_us_per_request": round(per_request * 1e6, 1),
+            "overhead_pct_of_wall": round(
+                100.0 * per_request / mean_wall, 4) if mean_wall else None,
+        }
+    # canary displacement: a fresh 2-replica fleet, default specs
+    with fleet_mod.ServingFleet(dec, params, replicas=2, name="model",
+                                engine_kw={"slots": slots}) as f:
+        url = f.url("/v1/models/model:generate")
+        for p in prompts[:4]:  # warm both replicas
+            post(url, p, 4, tenant="prod")
+        # warm the CONCURRENT decode paths too (batch>1 step shapes):
+        # a canary overlapping a real request must not be the first
+        # batch-2 step a replica ever compiles, or the one-time compile
+        # stall would be billed to the canary as displacement
+        for _ in range(6):
+            threads = [threading.Thread(
+                target=post, args=(url, p, 4),
+                kwargs={"tenant": "prod"}) for p in prompts[:3]]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        n_measure = 160
+        off = [post(url, prompts[i % n_requests], 4, tenant="prod")
+               for i in range(n_measure)]
+        # canary prompt reuses the real traffic's shapes so the prober
+        # never triggers a fresh compile mid-measurement; 4 Hz is ~20x
+        # a production cadence yet still a tiny occupancy fraction
+        prober = f.router.slo.attach_canary(slo_mod.CanaryProber(
+            url, prompts[0], max_new_tokens=4, interval=0.25))
+        prober.start()
+        time.sleep(0.3)  # first probe lands before the measured window
+        try:
+            on = [post(url, prompts[i % n_requests], 4, tenant="prod")
+                  for i in range(n_measure)]
+        finally:
+            prober.stop()
+        counters = prober.counters()
+        out["verdict"]["canary"] = {
+            "counters": counters,
+            "expected_pinned": prober.expected is not None,
+            "history": prober.history()[-8:],
+        }
+        p99_off, p99_on = pctl(off, 0.99), pctl(on, 0.99)
+        p50_off, p50_on = pctl(off, 0.50), pctl(on, 0.50)
+        out["canary"] = {
+            "real_p99_ms_off": round(p99_off * 1e3, 1),
+            "real_p99_ms_on": round(p99_on * 1e3, 1),
+            "p99_ratio_on_over_off": round(p99_on / p99_off, 3),
+            "p50_ratio_on_over_off": round(p50_on / p50_off, 3),
+            "probes": counters["probes"],
+            "failures": counters["failures"],
+            "drift": counters["drift"],
+        }
+    return out
+
+
 def _serving_fleet_bench(on_tpu, replica_counts=(1, 2, 4)):
     """Aggregate serving throughput at 1 vs 2 vs 4 router-fronted
     replicas on the shared mixed-length workload. Returns the
@@ -1781,6 +1956,16 @@ def _serving_fleet_bench(on_tpu, replica_counts=(1, 2, 4)):
             print("serving_fleet.qos failed: {}".format(e),
                   file=sys.stderr)
             block["qos"] = {"error": str(e)}
+    # serving SLO plane leg (PR 20): error-budget burn gray vs healthy,
+    # canary displacement ratio, attribution sweep overhead.
+    # TFOS_BENCH_SLO=0 skips just this leg.
+    if os.environ.get("TFOS_BENCH_SLO", "1") == "1":
+        try:
+            block["slo"] = _slo_leg()
+        except Exception as e:  # noqa: BLE001 - report, not die
+            print("serving_fleet.slo failed: {}".format(e),
+                  file=sys.stderr)
+            block["slo"] = {"error": str(e)}
     return block
 
 

@@ -1,0 +1,94 @@
+"""Why was THIS request slow — per-request critical-path attribution.
+
+Pulls a stitched end-to-end trace (the router's ``GET /debug/trace``
+document, or a saved chrome-trace JSON from ``trace_dump.py``), runs
+the pure attribution sweep (``slo.attribute_trace``) over the named
+trace id's span tree, and prints the stage table: every wall-clock
+second classified as router_overhead / queue_wait / admission /
+prefill / kv_ship / decode / preempted / hedge_wait, summing to the
+request's wall by construction.
+
+Usage:
+
+    # against a live router (trace ids come from response spans,
+    # exemplars on /metrics, or the flight ring):
+    python scripts/explain_request.py TRACE_ID --url http://ROUTER:PORT
+
+    # against a saved chrome-trace document:
+    python scripts/explain_request.py TRACE_ID --from-file trace.json
+
+    # list the trace ids present in a source instead of explaining one:
+    python scripts/explain_request.py --list --from-file trace.json
+
+Exit 0 on a rendered table; 1 when the trace id has no spans in the
+source (wrong id, or the ring already evicted it).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tensorflowonspark_tpu import metrics_report, slo  # noqa: E402
+
+
+def _fetch_trace(url):
+    import urllib.request
+
+    with urllib.request.urlopen(url.rstrip("/") + "/debug/trace",
+                                timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _trace_ids(doc):
+    events = doc.get("traceEvents", []) if isinstance(doc, dict) else doc
+    ids = set()
+    for event in events:
+        if event.get("ph") == "X" and int(event.get("tid", 0)) > 0:
+            ids.add(int(event["tid"]))
+    return sorted(ids)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="per-request critical-path attribution from a "
+                    "stitched trace")
+    ap.add_argument("trace_id", nargs="?", type=int,
+                    help="the request's trace id (X-TFOS-Trace)")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="fleet router base URL (reads "
+                                   "GET /debug/trace)")
+    src.add_argument("--from-file", metavar="JSON",
+                     help="saved chrome-trace document")
+    ap.add_argument("--list", action="store_true",
+                    help="print the trace ids present in the source")
+    args = ap.parse_args(argv)
+
+    if args.from_file:
+        with open(args.from_file) as f:
+            doc = json.load(f)
+    else:
+        doc = _fetch_trace(args.url)
+
+    if args.list:
+        for trace in _trace_ids(doc):
+            print(trace)
+        return 0
+    if args.trace_id is None:
+        ap.error("trace_id required unless --list")
+
+    report = slo.attribute_trace(doc, args.trace_id)
+    if not report["wall_s"]:
+        print("no spans for trace {} in the source (wrong id, or the "
+              "flight ring already evicted it)".format(args.trace_id),
+              file=sys.stderr)
+        return 1
+    print("trace {}".format(args.trace_id))
+    print(metrics_report.format_attribution(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,86 @@
+"""SLO-spec drift gate (``make slo-lint``).
+
+An SLO spec is a promise about a metric family: the burn engine reads
+its SLI from that family's histograms or tallies every scrape. A spec
+naming a family the code no longer exports evaluates against silence —
+no traffic, no burn, no alert — which is exactly the failure mode a
+lint must catch before it ships.
+
+This script pins every spec in ``slo.DEFAULT_SPECS`` (and any extra
+spec strings passed as arguments, so CI can vet a deployment's custom
+specs too) against ``tracing.METRIC_FAMILIES``:
+
+1. the spec parses under the documented grammar;
+2. its family exists in METRIC_FAMILIES;
+3. a latency spec's family is a histogram (bucket counts are where the
+   good/total SLI comes from), an availability spec's a counter;
+4. window pairs are sane (short < long, positive burn thresholds).
+
+Exit 0 on agreement; 1 with findings otherwise. Pure python (no jax),
+safe as a default-test-target prerequisite beside metrics-lint and
+racecheck.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def lint_specs(spec_texts):
+    """List of ``analysis.report.Finding`` for the given spec strings."""
+    from tensorflowonspark_tpu import slo, tracing
+    from tensorflowonspark_tpu.analysis import report
+
+    findings = []
+    for text in spec_texts:
+        try:
+            specs = slo.parse_specs(text)
+        except ValueError as e:
+            findings.append(report.Finding(
+                "bad-spec", "slo.DEFAULT_SPECS", 0, str(text)[:60],
+                "spec does not parse: {}".format(e)))
+            continue
+        for spec in specs:
+            meta = tracing.METRIC_FAMILIES.get(spec.family)
+            if meta is None:
+                findings.append(report.Finding(
+                    "unknown-family", "slo.DEFAULT_SPECS", 0, spec.name,
+                    "spec {!r} references {!r}, which is not in "
+                    "tracing.METRIC_FAMILIES — the SLI would evaluate "
+                    "against silence".format(spec.name, spec.family)))
+                continue
+            want = "histogram" if spec.kind == "latency" else "counter"
+            if meta[0] != want:
+                findings.append(report.Finding(
+                    "family-kind-mismatch", "slo.DEFAULT_SPECS", 0,
+                    spec.name,
+                    "spec {!r} (kind={}) needs a {} family but "
+                    "{!r} is a {}".format(spec.name, spec.kind, want,
+                                          spec.family, meta[0])))
+            for short_s, long_s, burn in spec.windows:
+                if not (0 < short_s < long_s and burn > 0):
+                    findings.append(report.Finding(
+                        "bad-window", "slo.DEFAULT_SPECS", 0, spec.name,
+                        "spec {!r} window ({}, {}, {}) violates "
+                        "0 < short < long, burn > 0".format(
+                            spec.name, short_s, long_s, burn)))
+    return findings
+
+
+def main(argv=None):
+    from tensorflowonspark_tpu import slo
+    from tensorflowonspark_tpu.analysis import report
+
+    argv = sys.argv[1:] if argv is None else argv
+    spec_texts = list(slo.DEFAULT_SPECS) + list(argv)
+    findings = lint_specs(spec_texts)
+    n_specs = len(slo.parse_specs(None)) + len(argv)
+    return report.emit(
+        "slo-lint", findings,
+        ok_summary="{} specs reference only cataloged families".format(
+            n_specs))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,123 @@
+"""Render the serving SLO verdict: budgets, burn, canary history.
+
+Reads the SAME evaluation the router serves — the ``GET /slo`` verdict
+document the ``SloMonitor`` computes from its own histograms, tenant
+tallies, and beat-carried replica snapshots — and prints the operator
+view: per-spec budget burn-down with the window/burn table, alert
+counts, and the canary's recent probe history. Formatting comes from
+the shared ``metrics_report`` helpers, so the bench's slo leg, this
+CLI, and the scrape all describe one evaluation.
+
+Three sources:
+
+    # a live fleet router:
+    python scripts/slo_report.py --url http://ROUTER:PORT
+
+    # a bench artifact's slo block (bench.py output JSON):
+    python scripts/slo_report.py --from-bench bench.json
+
+    # hermetic demo: a synthetic burn series driven through the pure
+    # engine (no fleet, <1s):
+    python scripts/slo_report.py --demo
+
+Exit code 0 (1 on a failed bench leg / missing block); ``make
+slo-report`` runs the demo.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tensorflowonspark_tpu import metrics_report, slo  # noqa: E402
+
+
+def _fetch_verdict(url):
+    import urllib.request
+
+    with urllib.request.urlopen(url.rstrip("/") + "/slo",
+                                timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _demo():
+    """Drive the pure burn engine through healthy -> gray -> healed
+    deterministically (synthetic clock — the point is the table)."""
+    engine = slo.BurnRateAlerts(
+        "name=availability,kind=availability,"
+        "family=tfos_fleet_requests,objective=0.99,"
+        "fast=30/120/10,slow=60/300/5")
+    t, good, total = 0.0, 0, 0
+    for _ in range(120):          # healthy minute: all good
+        good += 2
+        total += 2
+        engine.observe("availability", t, good, total)
+        t += 1.0
+    for _ in range(60):           # gray replica: half the fleet 500s
+        good += 1
+        total += 2
+        engine.observe("availability", t, good, total)
+        t += 1.0
+    verdicts, _ = engine.evaluate(t)
+    return {
+        "specs": verdicts,
+        "firing": [v["slo"] for v in verdicts if v["firing"]],
+        "alerts_total": engine.alerts_total(),
+        "canary": {
+            "counters": {"probes": 24, "failures": 1, "drift": 0},
+            "expected_pinned": True,
+            "history": [
+                {"ok": True, "status": 200, "latency_s": 0.021,
+                 "drift": False, "error": None},
+                {"ok": False, "status": None, "latency_s": 5.0,
+                 "drift": False, "error": "timeout"},
+                {"ok": True, "status": 200, "latency_s": 0.019,
+                 "drift": False, "error": None},
+            ],
+        },
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="render the serving SLO verdict + canary history")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="fleet router base URL (reads "
+                                   "GET /slo)")
+    src.add_argument("--from-bench", metavar="JSON",
+                     help="bench.py artifact; renders its 'slo' block")
+    src.add_argument("--demo", action="store_true",
+                     help="hermetic synthetic burn run")
+    args = ap.parse_args(argv)
+
+    if args.demo:
+        verdict = _demo()
+    elif args.from_bench:
+        with open(args.from_bench) as f:
+            artifact = json.load(f)
+        block = (artifact.get("slo")
+                 or artifact.get("serving_fleet", {}).get("slo")
+                 or {})
+        if block.get("error"):
+            # a failed bench leg must not render as a healthy verdict
+            print("bench slo leg failed: {}".format(block["error"]),
+                  file=sys.stderr)
+            return 1
+        verdict = block.get("verdict") or block
+        if "specs" not in verdict:
+            print("no slo block in {}".format(args.from_bench),
+                  file=sys.stderr)
+            return 1
+    else:
+        verdict = _fetch_verdict(args.url)
+
+    print(metrics_report.format_slo_verdict(verdict))
+    print()
+    print(metrics_report.format_canary(verdict.get("canary")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,7 +86,7 @@ class AutoscalePolicy(object):
                  queue_wait_slo_s=0.75, ttft_p99_slo_s=None,
                  occupancy_high=0.85, occupancy_low=0.25,
                  up_cooldown_s=2.0, down_cooldown_s=20.0,
-                 dead_after_s=3.0):
+                 dead_after_s=3.0, burn_rate_up_threshold=None):
         if int(min_replicas) < 1:
             raise ValueError("min_replicas must be >= 1")
         if int(max_replicas) < int(min_replicas):
@@ -101,6 +101,13 @@ class AutoscalePolicy(object):
         self.up_cooldown_s = float(up_cooldown_s)
         self.down_cooldown_s = float(down_cooldown_s)
         self.dead_after_s = float(dead_after_s)
+        #: SLO-plane coupling (PR 20): when the router's SloMonitor
+        #: reports a fast-window error-budget burn above this multiple,
+        #: that is UP pressure even before queues visibly back up — a
+        #: gray replica burns budget while the healthy one keeps the
+        #: queue short. None disables the term.
+        self.burn_rate_up_threshold = None if burn_rate_up_threshold \
+            is None else float(burn_rate_up_threshold)
 
 
 class ScaleDecision(object):
@@ -212,7 +219,7 @@ def _state_key(base, tier):
     return base if tier is None else "{}:{}".format(base, tier)
 
 
-def decide(policy, views, state, now):
+def decide(policy, views, state, now, burn_rate=None):
     """PURE scaling decision: per-replica ``views`` (see
     :func:`replica_view`), controller ``state`` ({"last_up",
     "last_down"} monotonic stamps or None, plus per-tier
@@ -246,12 +253,13 @@ def decide(policy, views, state, now):
                 tier=view.get("tier"))
     tiers = sorted({str(v.get("tier") or "mixed") for v in views})
     if len(tiers) <= 1:
-        return _decide_pool(policy, views, state, now)
+        return _decide_pool(policy, views, state, now,
+                            burn_rate=burn_rate)
     decisions = [
         _decide_pool(policy,
                      [v for v in views
                       if str(v.get("tier") or "mixed") == tier],
-                     state, now, tier=tier)
+                     state, now, tier=tier, burn_rate=burn_rate)
         for tier in tiers]
     for decision in decisions:
         if decision.action == ScaleDecision.UP:
@@ -266,7 +274,7 @@ def decide(policy, views, state, now):
         evidence={"tiers": {d.tier: d.evidence for d in decisions}})
 
 
-def _decide_pool(policy, views, state, now, tier=None):
+def _decide_pool(policy, views, state, now, tier=None, burn_rate=None):
     """One pool's scaling verdict (the whole fleet, or one tier of a
     tiered fleet): the breach/idle policy table over ``views``, with
     cooldown stamps read from the pool's own sub-state."""
@@ -320,6 +328,18 @@ def _decide_pool(policy, views, state, now, tier=None):
         breach.append(
             "slots saturated ({:.0%}) with {} queued".format(
                 occupancy, queue))
+    # SLO-plane burn (PR 20): evidence-gated on the pool having served
+    # at all — unlike the queue-gated terms above, budget burn IS
+    # current pain (the windowed SLI only moves while bad requests
+    # land), so a gray replica scales the pool before queues back up
+    if burn_rate is not None \
+            and policy.burn_rate_up_threshold is not None \
+            and completed > 0 \
+            and burn_rate > policy.burn_rate_up_threshold:
+        evidence["burn_rate"] = round(burn_rate, 3)
+        breach.append(
+            "error-budget burn {:.1f}x > {:.1f}x threshold".format(
+                burn_rate, policy.burn_rate_up_threshold))
     if breach:
         reason = "; ".join(breach)
         # per-priority breach view (PR 18): a backlog made ENTIRELY of
@@ -330,7 +350,7 @@ def _decide_pool(policy, views, state, now, tier=None):
         # tally accounting for the WHOLE queue: replicas predating the
         # gauge report nothing, and an unaccounted backlog must keep
         # the legacy scale-up behavior.
-        if by_class["high"] + by_class["normal"] == 0 \
+        if queue > 0 and by_class["high"] + by_class["normal"] == 0 \
                 and by_class["low"] >= queue:
             return ScaleDecision(
                 ScaleDecision.HOLD,
@@ -492,7 +512,22 @@ class AutoscaleController(object):
             self._record(decision, 0, len(self.fleet.replicas))
             return decision
         views = self.views()
-        decision = decide(self.policy, views, self._state, now)
+        burn_rate = None
+        if self.policy.burn_rate_up_threshold is not None:
+            # SLO-plane coupling (PR 20): the router's monitor samples
+            # on demand; the largest fast-window burn across specs is
+            # the scalar UP-pressure signal. Best-effort — a fleet
+            # without a router (or a sampling hiccup) scales on the
+            # classic terms alone.
+            monitor = getattr(getattr(self.fleet, "router", None),
+                              "slo", None)
+            if monitor is not None:
+                try:
+                    burn_rate = monitor.max_fast_burn()
+                except Exception:  # noqa: BLE001 - advisory signal
+                    burn_rate = None
+        decision = decide(self.policy, views, self._state, now,
+                          burn_rate=burn_rate)
         self.counters.inc("decisions")
         live = sum(1 for v in views
                    if v["age"] is not None

@@ -64,6 +64,7 @@ import uuid
 
 from tensorflowonspark_tpu import chaos, paging, reservation, serving, \
     tracing
+from tensorflowonspark_tpu import slo as slo_mod
 from tensorflowonspark_tpu.qos import (
     DEFAULT_PRIORITY, QosPolicy, QuotaExceeded, QuotaTable,
     validate_priority, validate_tenant)
@@ -1192,7 +1193,7 @@ class FleetRouter(object):
                  affinity_capacity=2048,
                  load_guard=DEFAULT_LOAD_GUARD,
                  affinity_enabled=True, two_stage=True,
-                 prefill_timeout=120.0, qos=None):
+                 prefill_timeout=120.0, qos=None, slo=None):
         self.reservation = reservation_server
         self.name = name
         self.replicas = list(replicas or [])
@@ -1260,6 +1261,15 @@ class FleetRouter(object):
         #: reason -> count behind tfos_fleet_affinity_breaks{reason}
         #: (written under _obs_lock like every other router tally)
         self._affinity_breaks = {}
+        #: reason -> count behind tfos_fleet_affinity_resets{reason}: a
+        #: router that came up COLD over a fleet already holding
+        #: serving sessions (standby takeover, same-name restart) —
+        #: the honest explanation for a warm-hit-rate dip
+        self._affinity_resets = {}
+        # what start() labels a cold-over-live-fleet reset with;
+        # RouterStandby overrides to "takeover" before starting its
+        # replacement router
+        self._affinity_reset_reason = "restart"
         self.health = ReplicaHealth(fail_threshold=fail_threshold,
                                     cooldown=cooldown,
                                     max_cooldown=max_cooldown)
@@ -1281,6 +1291,23 @@ class FleetRouter(object):
         #: timeline of a (possibly failed-over) request
         self.flight = tracing.FlightRecorder()
         tracing.expose_flight_drops(self.metrics, self.flight)
+        # router-side slices of the per-request attribution families:
+        # dispatch-minus-upstream residual, hedge-race overlap, and the
+        # two-stage kv ship (the engine owns queue/prefill/decode)
+        self._hist_attrib = {
+            stage: self.metrics.histogram(
+                "tfos_slo_attrib_{}_seconds".format(stage))
+            for stage in ("router_overhead", "hedge_wait", "kv_ship")}
+        #: serving SLO plane (PR 20): burn-rate alerts + /slo verdict
+        #: over this router's own histograms, per-tenant availability
+        #: tallies, and beat-carried replica snapshots. ``slo=`` takes
+        #: a spec string/list (slo.parse_specs grammar); None = the
+        #: default objectives. Evaluation is scrape-driven.
+        self.slo = slo_mod.SloMonitor(self, specs=slo)
+        #: tenant -> [good, total] availability tallies (guarded by
+        #: _obs_lock): client disconnects never counted, quota 429s
+        #: excluded as policy-not-failure, >=500 counts against
+        self._slo_tallies = {}
         self._inflight = {}
         self._inflight_lock = threading.Lock()
         # every histogram/timer/counter write goes through this lock:
@@ -1299,6 +1326,17 @@ class FleetRouter(object):
         self._probe_thread = None
 
     # -- fleet view --------------------------------------------------------
+
+    def slo_tallies(self):
+        """Per-tenant cumulative availability ``(good, total)`` pairs —
+        the SLI source for ``kind=availability`` SLO specs."""
+        with self._obs_lock:
+            return {t: tuple(v) for t, v in self._slo_tallies.items()}
+
+    def _note_affinity_reset(self, reason):
+        with self._obs_lock:
+            self._affinity_resets[reason] = \
+                self._affinity_resets.get(reason, 0) + 1
 
     def _snapshot(self):
         return self.reservation.serving_snapshot()
@@ -1517,9 +1555,19 @@ class FleetRouter(object):
                              attempts=attempts_made[0] or 1)
             with self._obs_lock:
                 self.counters.inc("requests")
-                self._hist_request.observe(wall)
+                self._hist_request.observe(wall, trace=trace)
                 self._hist_overhead.observe(
                     max(wall - upstream_spent[0], 0.0))
+                self._hist_attrib["router_overhead"].observe(
+                    max(wall - upstream_spent[0], 0.0), trace=trace)
+                # per-tenant availability tally (SLO plane): a client
+                # that hung up is nobody's failure and a quota 429 is
+                # policy — neither spends error budget; >=500 does
+                if status is not None and status != 429:
+                    tally = self._slo_tallies.setdefault(tenant, [0, 0])
+                    tally[1] += 1
+                    if status < 500:
+                        tally[0] += 1
         return status, body, retry_after
 
     @staticmethod
@@ -1675,6 +1723,7 @@ class FleetRouter(object):
             }).encode()
             with self._obs_lock:
                 self.counters.inc("prefill_dispatches")
+            ship_t0 = time.monotonic()
             status, rbody, _hdrs = _http_request(
                 tuple(p_addr), "POST",
                 "/v1/models/{}:prefill".format(self.name), body=body,
@@ -1691,6 +1740,11 @@ class FleetRouter(object):
             if status == 200 and out.get("shipped"):
                 with self._obs_lock:
                     self.counters.inc("prefill_ships")
+                    # the staged prefill+ship ran BEFORE the decode
+                    # attempt, serially on the dispatch path: its wall
+                    # is the request's kv_ship attribution slice
+                    self._hist_attrib["kv_ship"].observe(
+                        time.monotonic() - ship_t0, trace=trace)
                 self.flight.instant(
                     "prefill_staged", trace=trace, prefill=p_rid,
                     decode=d_rid, blocks=out.get("blocks", 0),
@@ -1914,11 +1968,13 @@ class FleetRouter(object):
                 cv.wait(hedge_delay)
             hedged = not outcomes
         live = 1
+        hedge_t0 = None
         if hedged:
             with self._obs_lock:
                 self.counters.inc("hedges")
             self.flight.instant("hedge_fired", trace=trace,
                                 delay_s=round(hedge_delay, 4))
+            hedge_t0 = time.monotonic()
             # tfos: unjoined(same contract as the primary attempt above)
             threading.Thread(target=_run,
                              args=("hedge", True), daemon=True,
@@ -1932,6 +1988,16 @@ class FleetRouter(object):
                     cv.wait(0.05)
                 label, kind, payload = outcomes[seen]
             seen += 1
+            if hedge_t0 is not None:
+                # first arrival after the hedge launched ends the
+                # two-attempt race window — the hedge_wait slice of the
+                # request's attribution (a _HedgeLost means the hedge
+                # never actually ran, so no overlap existed)
+                if not isinstance(payload, _HedgeLost):
+                    with self._obs_lock:
+                        self._hist_attrib["hedge_wait"].observe(
+                            time.monotonic() - hedge_t0, trace=trace)
+                hedge_t0 = None
             if kind == "ok":
                 lose.set()
                 if label == "hedge":
@@ -2324,11 +2390,19 @@ class FleetRouter(object):
         # read the map size BEFORE taking _obs_lock (the AffinityMap
         # has its own lock; never nest the two)
         affinity_entries = len(self.affinity)
+        # SLO sampling ALSO runs before _obs_lock: the monitor takes
+        # its own lock then calls router accessors that take _obs_lock
+        # — the one allowed ordering (monitor lock -> _obs_lock)
+        try:
+            slo_lines = self.slo.metric_lines(now=now)
+        except Exception:
+            slo_lines = []
         with self._obs_lock:
             self.counters.gauge("replicas", len(views))
             self.counters.gauge("replicas_routable", len(order))
             self.counters.gauge("affinity_entries", affinity_entries)
             breaks = dict(self._affinity_breaks)
+            resets = dict(self._affinity_resets)
         lines = []
         if breaks:
             lines.append("# TYPE tfos_fleet_affinity_breaks counter")
@@ -2336,6 +2410,13 @@ class FleetRouter(object):
                 lines.append(
                     'tfos_fleet_affinity_breaks{{reason="{}"}} {}'
                     .format(reason, breaks[reason]))
+        if resets:
+            lines.append("# TYPE tfos_fleet_affinity_resets counter")
+            for reason in sorted(resets):
+                lines.append(
+                    'tfos_fleet_affinity_resets_total{{reason="{}"}} {}'
+                    .format(reason, resets[reason]))
+        lines.extend(slo_lines)
         for family, key in (
                 ("tfos_fleet_replica_up",
                  lambda v: 1 if v["replica_id"] in order else 0),
@@ -2561,6 +2642,8 @@ class FleetRouter(object):
                     return self._send(
                         200, router.metrics_text().encode("utf-8"),
                         serving.OPENMETRICS_CONTENT_TYPE)
+                if self.path == "/slo":
+                    return self._send_json(200, router.slo.verdict())
                 if self.path == "/debug/trace":
                     stitched, dropped = router.debug_trace()
                     return self._send(
@@ -2627,6 +2710,24 @@ class FleetRouter(object):
             target=self._probe_loop, name="tfos-fleet-probe",
             daemon=True)
         self._probe_thread.start()
+        # honesty tally (PR 20): a router starting with an EMPTY
+        # AffinityMap over replicas that have ALREADY served traffic
+        # lost someone's session warmth — record why (takeover vs
+        # restart) so the warm-hit-rate dip is attributable from the
+        # scrape alone. A fresh fleet (no completions yet) is not a
+        # reset; it never had warmth to lose.
+        if len(self.affinity) == 0:
+            try:
+                snapshot = self._snapshot()
+            except Exception:
+                snapshot = {}
+            served = any(
+                ((info.get("metrics") or {}).get("counters", {})
+                 .get("tfos_serving", {}) or {}).get("counts", {})
+                .get("requests_completed", 0)
+                for info in snapshot.values())
+            if served:
+                self._note_affinity_reset(self._affinity_reset_reason)
         logger.info("fleet router for %r on %s:%d", self.name,
                     self._host, self._port)
         return self._host, self._port
@@ -3531,6 +3632,10 @@ class RouterStandby(object):
         router = FleetRouter(fleet.reservation, name=fleet.name,
                              host=fleet.host, replicas=fleet.replicas,
                              **fleet.router_kw)
+        # the replacement router's AffinityMap deliberately starts
+        # cold; label the reset start() records so the scrape explains
+        # the warm-hit dip as a TAKEOVER, not a mere restart
+        router._affinity_reset_reason = "takeover"
         router.start()
         router._quota.restore(self._quota_state)
         router.metrics.add_counters("tfos_control", self.counters)

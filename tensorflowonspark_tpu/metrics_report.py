@@ -98,6 +98,85 @@ def format_goodput(report):
     return "\n".join(lines)
 
 
+def format_slo_verdict(verdict):
+    """Multi-line rendering of a ``GET /slo`` verdict document: one
+    headline per spec (budget remaining + firing state), then the
+    window/burn table — what ``scripts/slo_report.py`` prints and the
+    bench's slo leg logs."""
+    lines = []
+    for spec in verdict.get("specs") or []:
+        budget = spec.get("error_budget_remaining")
+        lines.append(
+            "slo {:16s} tenant={:12s} {}  budget {}".format(
+                spec.get("slo", "?"), spec.get("tenant", "?"),
+                "FIRING" if spec.get("firing") else "ok    ",
+                "n/a" if budget is None
+                else "{:7.2%}".format(budget)))
+        for window in spec.get("windows") or []:
+            lines.append(
+                "    window {:>6g}s/{:>6g}s  burn {:>8s}/{:>8s}  "
+                "(threshold {:g}x{})".format(
+                    window.get("short_s", 0), window.get("long_s", 0),
+                    _burn(window.get("short_burn")),
+                    _burn(window.get("long_burn")),
+                    window.get("threshold", 0),
+                    ", firing" if window.get("firing") else ""))
+    alerts = verdict.get("alerts_total") or {}
+    if any(alerts.values()):
+        lines.append("alerts raised: " + "  ".join(
+            "{}={}".format(name, alerts[name])
+            for name in sorted(alerts) if alerts[name]))
+    return "\n".join(lines) if lines else "no SLO specs configured"
+
+
+def _burn(value):
+    return "-" if value is None else "{:.2f}x".format(value)
+
+
+def format_canary(canary):
+    """Canary summary block from a verdict's ``canary`` section (or
+    ``None`` when no prober is attached)."""
+    if not canary:
+        return "canary: not attached"
+    counters = canary.get("counters") or {}
+    lines = ["canary: {} probes, {} failures, {} drift{}".format(
+        counters.get("probes", 0), counters.get("failures", 0),
+        counters.get("drift", 0),
+        "" if canary.get("expected_pinned")
+        else "  (expected tokens not pinned yet)")]
+    history = canary.get("history") or []
+    for record in history[-8:]:
+        lines.append(
+            "  probe ok={} status={} latency={:.1f}ms{}{}".format(
+                record.get("ok"), record.get("status"),
+                (record.get("latency_s") or 0.0) * 1e3,
+                " DRIFT" if record.get("drift") else "",
+                "" if not record.get("error")
+                else " ({})".format(record["error"])))
+    return "\n".join(lines)
+
+
+def format_attribution(report):
+    """Per-request critical-path table from an ``slo.attribute_trace``
+    report: stage seconds sorted by cost with shares of wall — what
+    ``scripts/explain_request.py`` prints for one trace id."""
+    wall = report.get("wall_s") or 0.0
+    lines = ["request wall {:.3f}s".format(wall)]
+    stages = report.get("stages") or {}
+    for stage in sorted(stages, key=stages.get, reverse=True):
+        seconds = stages[stage]
+        if not seconds:
+            continue
+        lines.append("  {:16s} {:9.3f}s  ({:5.1%})".format(
+            stage, seconds, seconds / wall if wall else 0.0))
+    unattributed = report.get("unattributed_s")
+    if unattributed:
+        lines.append("  {:16s} {:9.3f}s  ({:5.1%})".format(
+            "unattributed", unattributed,
+            unattributed / wall if wall else 0.0))
+    return "\n".join(lines)
+
+
 def format_straggler_table(rows):
     """Straggler table from per-executor skew rows
     ``[{executor, skew, step_ewma_s?}]`` (or a plain {executor: skew}

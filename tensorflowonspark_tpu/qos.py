@@ -43,6 +43,14 @@ PRIORITY_RANK = {name: rank for rank, name in enumerate(PRIORITIES)}
 DEFAULT_TENANT = "default"
 DEFAULT_PRIORITY = "normal"
 
+#: reserved tenant for the SLO plane's synthetic canary probes: always
+#: issued at ``low`` priority (the class that never preempts and never
+#: displaces waiting real traffic), so a canary's presence is invisible
+#: to every other tenant's latency. Real callers should not mint
+#: traffic under this name — its tallies are interpreted as black-box
+#: probe results, not customer load.
+CANARY_TENANT = "slo-canary"
+
 #: tenant identity grammar: it becomes a metric label value and a
 #: ``X-TFOS-Tenant`` header, so it is deliberately narrow — no quotes,
 #: no spaces, no control characters, bounded length

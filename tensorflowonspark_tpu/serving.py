@@ -65,6 +65,7 @@ from tensorflowonspark_tpu import frames
 from tensorflowonspark_tpu import kvship
 from tensorflowonspark_tpu import paging
 from tensorflowonspark_tpu import qos
+from tensorflowonspark_tpu import slo
 from tensorflowonspark_tpu import tracing
 from tensorflowonspark_tpu.qos import QuotaExceeded  # noqa: F401 - HTTP taxonomy re-export
 
@@ -278,6 +279,11 @@ class GenerationHandle(object):
         # observability cursors (scheduler thread writes)
         self._last_emit_at = None   # monotonic of the last emitted token
         self._decode_t0 = None      # monotonic of prefill completion
+        self._preempt_at = None     # monotonic of the last eviction
+        # (name, t0, t1) lifecycle spans accumulated for critical-path
+        # attribution (slo.attribute_intervals) at request finish; the
+        # scheduler thread is the only writer
+        self._attr_spans = []
 
     # -- scheduler side --------------------------------------------------
 
@@ -702,6 +708,15 @@ class DecodeEngine(object):
             "tfos_serving_request_seconds")
         self._hist_drain = self.metrics.histogram(
             "tfos_serving_drain_seconds")
+        # per-request critical-path attribution (PR 20): at finish, the
+        # request's lifecycle spans are partitioned into named stages
+        # (slo.attribute_intervals, sum-to-wall by construction) and
+        # each stage's seconds land in its own histogram
+        self._hist_attrib = {
+            stage: self.metrics.histogram(
+                "tfos_slo_attrib_{}_seconds".format(stage))
+            for stage in ("queue_wait", "admission", "prefill",
+                          "decode", "preempted")}
         #: request trace timeline (PR 5): span events for every request
         #: (admit -> queue -> prefill -> decode -> finish/evict/shed)
         #: land in this bounded ring; GET /debug/trace and
@@ -1693,17 +1708,41 @@ class DecodeEngine(object):
         internally locked, so their spans still record."""
         now = handle.completed if handle.completed is not None \
             else time.monotonic()
-        if handle._decode_t0 is not None:
+        # a request evicted BETWEEN preemption and re-admission never
+        # resumed decoding: its decode-so-far span was already closed
+        # by _preempt, and stretching a new one over the evicted gap
+        # would misattribute the wait as decode
+        resumed = (handle._preempt_at is None
+                   or (handle._decode_t0 is not None
+                       and handle._decode_t0 > handle._preempt_at))
+        if handle._decode_t0 is not None and resumed:
             self.flight.span("decode", handle._decode_t0, now,
                              trace=handle.trace,
                              tokens=len(handle._tokens))
+            handle._attr_spans.append(("decode", handle._decode_t0, now))
         self.flight.span("request", handle.submitted, now,
                          trace=handle.trace, outcome=outcome,
                          tokens=len(handle._tokens),
                          error=None if error is None else str(error))
         self.flight.instant(outcome, trace=handle.trace)
         if outcome == "finish" and record_latency:
-            self._hist_request.observe(now - handle.submitted)
+            self._hist_request.observe(now - handle.submitted,
+                                       trace=handle.trace)
+            self._observe_attribution(handle, now)
+
+    def _observe_attribution(self, handle, now):
+        """Partition the finished request's wall into named stages and
+        feed the per-stage attribution histograms (scheduler thread
+        only, like every engine histogram). The sweep is pure and runs
+        over a handful of lifecycle spans — well under the <1%-of-wall
+        overhead bar."""
+        intervals = list(handle._attr_spans)
+        intervals.append(("request", handle.submitted, now))
+        report = slo.attribute_intervals(intervals)
+        for stage, hist in self._hist_attrib.items():
+            seconds = report["stages"].get(stage)
+            if seconds:
+                hist.observe(seconds, trace=handle.trace)
 
     def _evict(self, handle, err):
         handle._finish(err)
@@ -2456,6 +2495,17 @@ class DecodeEngine(object):
         handle = self._slot_req[slot]
         self._slot_req[slot] = None
         self._release_slot(slot)
+        now = time.monotonic()
+        if handle._decode_t0 is not None:
+            # close the decode-so-far segment: attribution must not
+            # lose the work done before eviction, and the preempted
+            # stage starts HERE, not at the last decode step
+            self.flight.span("decode", handle._decode_t0, now,
+                             trace=handle.trace,
+                             tokens=len(handle._tokens),
+                             preempted=True)
+            handle._attr_spans.append(("decode", handle._decode_t0, now))
+        handle._preempt_at = now
         with self._cv:
             self._queue.appendleft(handle)
             key = (handle.tenant, handle.priority)
@@ -2596,6 +2646,14 @@ class DecodeEngine(object):
                                           t0 - handle.submitted)
             self.flight.span("queue", handle.submitted, t0,
                              trace=handle.trace, slot=slot)
+            handle._attr_spans.append(("queue", handle.submitted, t0))
+        elif handle._preempt_at is not None:
+            # preemption continuation: everything since the eviction
+            # was time the request spent OUT of its slot
+            self.flight.span("preempted", handle._preempt_at, t0,
+                             trace=handle.trace, slot=slot)
+            handle._attr_spans.append(
+                ("preempted", handle._preempt_at, t0))
         with self.timers.timed("prefill"):
             self._cache, first = self._prefill_fn(
                 self.params, self._cache, jnp.asarray(row),
@@ -2607,6 +2665,7 @@ class DecodeEngine(object):
         self.flight.span("prefill", t0, t1, trace=handle.trace,
                          bucket=bucket, prompt_len=n,
                          prefix_blocks=len(shared))
+        handle._attr_spans.append(("prefill", t0, t1))
         handle._decode_t0 = t1
         self.counters.inc("prefills")
         if self._spec_k:
@@ -2668,6 +2727,7 @@ class DecodeEngine(object):
                 t0 - handle.submitted)
         self.flight.span("queue", handle.submitted, t0,
                          trace=handle.trace, slot=slot)
+        handle._attr_spans.append(("queue", handle.submitted, t0))
         with self.timers.timed("prefill"):
             self._cache, first = self._prefill_fn(
                 self.params, self._cache, jnp.int32(slot),
@@ -2679,6 +2739,7 @@ class DecodeEngine(object):
                                       t0 - handle.submitted)
         self.flight.span("prefill", t0, t1, trace=handle.trace,
                          bucket=bucket, prompt_len=n)
+        handle._attr_spans.append(("prefill", t0, t1))
         handle._decode_t0 = t1
         self.counters.inc("prefills")
         self._idx[slot] = n
@@ -2696,9 +2757,11 @@ class DecodeEngine(object):
         handle._emit(token)
         now = time.monotonic()
         if handle._last_emit_at is None:
-            self._hist_ttft.observe(now - handle.submitted)
+            self._hist_ttft.observe(now - handle.submitted,
+                                    trace=handle.trace)
         else:
-            self._hist_token.observe(now - handle._last_emit_at)
+            self._hist_token.observe(now - handle._last_emit_at,
+                                     trace=handle.trace)
         handle._last_emit_at = now
         self._last[slot] = token
         # QoS usage accounting (PR 18), post-paid at ACTUAL delivery:

@@ -666,9 +666,20 @@ class Supervisor(object):
             self._incidents.append(incident)
         logger.warning("supervisor incident (observe-only): %s", event)
 
+    def record_slo_incident(self, kind, detail, payload=None):
+        """Public observe-only incident entry point for the serving SLO
+        plane (:mod:`tensorflowonspark_tpu.slo`): a burn-rate raise or
+        canary drift lands in :meth:`incidents` with the standard
+        evidence schema (payload + flight-recorder tail), never in the
+        failure list the recovery loop drains — an SLO page is a human
+        signal, not a restart trigger."""
+        self._report_incident(
+            FailureEvent(kind, "serving", detail, dict(payload or {})))
+
     def incidents(self):
-        """Observe-only incidents recorded so far (straggler skew);
-        each carries the same evidence schema as a failure."""
+        """Observe-only incidents recorded so far (straggler skew,
+        serving SLO burn/drift); each carries the same evidence schema
+        as a failure."""
         with self._lock:
             return list(self._incidents)
 

@@ -676,6 +676,62 @@ METRIC_FAMILIES = {
                         "ControlFenced because the caller stamped a "
                         "control epoch below the replica's floor (a "
                         "deposed driver is still issuing writes)"),
+    # -- serving SLO plane (slo.py) ------------------------------------
+    "tfos_fleet_affinity_resets":
+        ("counter", "reason", "times a router came up with an EMPTY "
+                              "AffinityMap over a fleet that already "
+                              "held serving sessions (takeover = warm-"
+                              "standby promotion, restart = same-name "
+                              "router restart): the honest explanation "
+                              "for a warm-hit-rate dip after failover"),
+    "tfos_slo_error_budget_remaining":
+        ("gauge", "slo,tenant", "fraction of the error budget left "
+                                "over the slowest window (1 - burn); "
+                                "negative when the budget is spent"),
+    "tfos_slo_burn_rate":
+        ("gauge", "slo,tenant,window", "error-budget burn multiple per "
+                                       "window (1.0 = spending exactly "
+                                       "the budget)"),
+    "tfos_slo_alerts":
+        ("counter", "slo", "burn-rate alert raises per SLO (clears do "
+                           "not decrement; the count is incident "
+                           "history)"),
+    "tfos_slo_canary_probes":
+        ("counter", "", "synthetic canary probes issued through the "
+                        "real router path under the reserved "
+                        "low-priority canary tenant"),
+    "tfos_slo_canary_failures":
+        ("counter", "", "canary probes that failed (non-200 or "
+                        "transport error): black-box availability"),
+    "tfos_slo_canary_drift":
+        ("counter", "", "canary probes whose temp=0 output diverged "
+                        "from the pinned expected tokens: bitwise "
+                        "correctness alert"),
+    "tfos_slo_attrib_router_overhead_seconds":
+        ("histogram", "", "per-request seconds attributed to router "
+                          "work (dispatch minus upstream residency)"),
+    "tfos_slo_attrib_queue_wait_seconds":
+        ("histogram", "", "per-request seconds attributed to the "
+                          "engine admission queue"),
+    "tfos_slo_attrib_admission_seconds":
+        ("histogram", "", "per-request seconds inside the engine "
+                          "request span not covered by a deeper stage "
+                          "(scheduler bookkeeping)"),
+    "tfos_slo_attrib_prefill_seconds":
+        ("histogram", "", "per-request seconds attributed to prefill"),
+    "tfos_slo_attrib_kv_ship_seconds":
+        ("histogram", "", "per-request seconds attributed to KV-block "
+                          "pack/ship/splice (disaggregated path)"),
+    "tfos_slo_attrib_decode_seconds":
+        ("histogram", "", "per-request seconds attributed to decode "
+                          "slot residency"),
+    "tfos_slo_attrib_preempted_seconds":
+        ("histogram", "", "per-request seconds spent evicted between "
+                          "preemption and re-admission"),
+    "tfos_slo_attrib_hedge_wait_seconds":
+        ("histogram", "", "per-request seconds where two upstream "
+                          "attempts raced (hedge launched, winner "
+                          "undecided)"),
 }
 
 
@@ -697,7 +753,7 @@ class Histogram(object):
     """
 
     __slots__ = ("lo", "growth", "_bounds", "_counts", "_sum", "_n",
-                 "_min", "_max")
+                 "_min", "_max", "_exemplars")
 
     def __init__(self, lo=1e-4, hi=3600.0, growth=math.sqrt(2.0)):
         self.lo = float(lo)
@@ -710,9 +766,14 @@ class Histogram(object):
         self._n = 0
         self._min = None
         self._max = None
+        # bucket index -> (trace_id, value): the LAST traced sample per
+        # bucket, emitted as an OpenMetrics exemplar so a scraped p99
+        # bucket links straight to a loadable trace
+        self._exemplars = {}
 
-    def observe(self, value):
-        """Record one sample (seconds)."""
+    def observe(self, value, trace=None):
+        """Record one sample (seconds); ``trace`` attaches the trace id
+        as that bucket's exemplar."""
         value = float(value)
         self._sum += value
         self._n += 1
@@ -721,18 +782,19 @@ class Histogram(object):
         if self._max is None or value > self._max:
             self._max = value
         if value <= self._bounds[0]:
-            self._counts[0] += 1
-            return
-        if value > self._bounds[-1]:
-            self._counts[-1] += 1
-            return
-        # log-position, then the forward scan only to absorb float edge
-        # error: O(1) in practice
-        i = int(math.log(value / self.lo) / math.log(self.growth))
-        i = max(0, min(i, len(self._bounds) - 1))
-        while self._bounds[i] < value:
-            i += 1
+            i = 0
+        elif value > self._bounds[-1]:
+            i = len(self._counts) - 1
+        else:
+            # log-position, then the forward scan only to absorb float
+            # edge error: O(1) in practice
+            i = int(math.log(value / self.lo) / math.log(self.growth))
+            i = max(0, min(i, len(self._bounds) - 1))
+            while self._bounds[i] < value:
+                i += 1
         self._counts[i] += 1
+        if trace:
+            self._exemplars[i] = (int(trace), value)
 
     @property
     def count(self):
@@ -775,10 +837,14 @@ class Histogram(object):
     def snapshot(self):
         """Compact JSON-able state (mergeable via
         :func:`merge_snapshots` when the layouts match)."""
-        return {"lo": self.lo, "growth": self.growth,
+        snap = {"lo": self.lo, "growth": self.growth,
                 "counts": list(self._counts),
                 "sum": self._sum, "n": self._n,
                 "min": self._min, "max": self._max}
+        if self._exemplars:
+            snap["exemplars"] = {i: list(ex)
+                                 for i, ex in self._exemplars.items()}
+        return snap
 
 
 def snapshot_quantile(snap, q):
@@ -1004,16 +1070,30 @@ def _render(labeled_snapshots):
                 continue
             bounds = [snap["lo"] * snap["growth"] ** i
                       for i in range(len(snap["counts"]) - 1)]
+            # exemplar keys arrive as ints locally but as strings after
+            # a JSON round-trip (beat wire); normalise once
+            exemplars = {int(k): v for k, v in
+                         (snap.get("exemplars") or {}).items()}
+
+            def _exemplar(index):
+                ex = exemplars.get(index)
+                if not ex:
+                    return ""
+                return ' # {{trace_id="{}"}} {}'.format(
+                    ex[0], _fmt(ex[1]))
+
             cum = 0
-            for bound, count in zip(bounds, snap["counts"]):
+            for i, (bound, count) in enumerate(zip(bounds,
+                                                   snap["counts"])):
                 cum += count
-                lines.append("{}_bucket{} {}".format(
+                lines.append("{}_bucket{} {}{}".format(
                     family,
                     _labels((("le", "{:.6g}".format(bound)),) + extra),
-                    cum))
-            lines.append("{}_bucket{} {}".format(
+                    cum, _exemplar(i)))
+            lines.append("{}_bucket{} {}{}".format(
                 family, _labels((("le", "+Inf"),) + extra),
-                cum + snap["counts"][-1]))
+                cum + snap["counts"][-1],
+                _exemplar(len(snap["counts"]) - 1)))
             lines.append("{}_sum{} {}".format(
                 family, _labels(extra), _fmt(snap["sum"])))
             lines.append("{}_count{} {}".format(

@@ -623,7 +623,10 @@ def test_autoscale_closed_loop_inprocess(lm):
         assert chaos.poll_until(lambda: _scaled_to(f, 1),
                                 timeout=30.0), \
             "idle fleet must retire to min_replicas"
-        assert ctl.counters.snapshot()["counts"]["scale_downs"] >= 1
+        # same tracked-before-tallied gap as scale_ups above — poll it
+        assert chaos.poll_until(
+            lambda: ctl.counters.snapshot()["counts"]
+            .get("scale_downs", 0) >= 1, timeout=5.0)
         down = ctl.events.events("autoscale_scaled_down")
         assert down and down[-1]["drained_clean"], \
             "retirement must be the zero-loss drain path"

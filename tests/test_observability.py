@@ -162,7 +162,12 @@ _SAMPLE = re.compile(
     r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
     r'(?P<labels>\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
     r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})?'
-    r' (?P<value>-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|\+Inf|NaN)$')
+    r' (?P<value>-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|\+Inf|NaN)'
+    # optional OpenMetrics exemplar (PR 20): histogram buckets carry
+    # the trace id of a recent observation so a slow scrape bucket
+    # links straight to scripts/explain_request.py's input
+    r'(?P<exemplar> # \{trace_id="[0-9]+"\}'
+    r' -?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?)?$')
 _META = re.compile(r"^# (TYPE|HELP) ([a-zA-Z_:][a-zA-Z0-9_:]*) (.+)$")
 
 _HIST_SUFFIX = re.compile(r"_(bucket|sum|count)$")
@@ -191,6 +196,9 @@ def _parse_openmetrics(text):
         m = _SAMPLE.match(line)
         assert m, "malformed sample line: %r" % line
         name = m.group("name")
+        if m.group("exemplar"):
+            assert name.endswith("_bucket"), \
+                "exemplar on a non-bucket sample: %r" % line
         family = name
         if _HIST_SUFFIX.search(name) and \
                 _HIST_SUFFIX.sub("", name) in types:
@@ -239,6 +247,31 @@ def test_metrics_exposition_grammar_and_catalog(live_server):
         # _count renders last within the family block
         total = [v for f, labels, v in samples if f == family][-1]
         assert inf and inf[0] == total
+
+
+def test_histogram_exemplars_in_live_scrape(live_server):
+    """The exemplar grammar pin (PR 20): traced observations render an
+    OpenMetrics exemplar on their bucket line, the trace id is a real
+    request trace present in /debug/trace, and exemplars never leak
+    onto non-bucket samples (enforced inside _parse_openmetrics)."""
+    url, eng = live_server
+    _generate(url, [[2, 4, 6]], max_new=3)
+    text = _scrape(url)
+    _parse_openmetrics(text)  # grammar (incl. bucket-only placement)
+    exemplars = re.findall(
+        r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*_bucket)\{[^}]*\} '
+        r'[0-9.eE+-]+ # \{trace_id="(?P<trace>[0-9]+)"\} '
+        r'(?P<val>[0-9.eE+-]+)$', text, re.M)
+    assert exemplars, "no exemplars rendered on any bucket line"
+    families = {name[:-len("_bucket")] for name, _, _ in exemplars}
+    assert "tfos_serving_ttft_seconds" in families
+    with urllib.request.urlopen(url + "/debug/trace", timeout=30) as r:
+        doc = json.loads(r.read())
+    trace_ids = {int(e.get("tid", 0)) for e in doc["traceEvents"]
+                 if e.get("ph") == "X"}
+    for _, trace, _ in exemplars:
+        assert int(trace) in trace_ids, \
+            "exemplar trace %s not in the flight ring" % trace
 
 
 def test_metrics_counters_monotonic_across_scrapes(live_server):

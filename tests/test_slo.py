@@ -1,0 +1,799 @@
+"""Serving SLO plane (PR 20): burn-rate alerts, canaries, attribution.
+
+Layers, matching the module split:
+
+- PURE — the spec grammar (``k=v`` fields, window triplets,
+  rejections), ``SliSeries`` window deltas under counter resets and
+  partial windows, the multi-window multi-burn-rate raise/clear
+  hysteresis with injected time, the histogram-bucket latency SLI,
+  and table-driven critical-path attribution for plain / preempted /
+  hedged / two-stage request shapes (sum-to-wall by construction).
+- MONITOR — ``SloMonitor`` against a duck-typed fake router: SLI
+  source resolution, incident evidence, supervisor forwarding, the
+  hand-rendered ``tfos_slo_*`` metric lines.
+- CANARY — ``CanaryProber`` against a stub HTTP server: expected
+  tokens pinned on first success, drift detection, failure tallies,
+  the reserved low-priority tenant on the wire.
+- E2E (slow) — a real fleet: a gray replica (``net_delay``) trips the
+  fast-window burn alert on a router-observed latency SLO and CLEARS
+  after the heal with the replica snapshot in the incident evidence;
+  canary probes through the live router are bitwise-stable; the
+  ``GET /slo`` verdict and ``tfos_slo_*`` scrape families render; a
+  preempted engine request's attribution sums to its wall; a hedged
+  request's attribution carries ``hedge_wait``.  The canary
+  zero-displacement leg rides ``make chaos`` (chaos marker).
+"""
+
+import http.server
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import chaos, fleet, generation, qos, serving, \
+    slo, tracing
+from tensorflowonspark_tpu.models.decoder import DecoderLM
+
+V, H, NH, L, MAXLEN = 17, 32, 4, 2, 48
+
+
+@pytest.fixture(scope="module")
+def lm():
+    dec = DecoderLM(vocab=V, hidden=H, num_heads=NH, num_layers=L,
+                    max_len=MAXLEN, decode=True)
+    train = DecoderLM(vocab=V, hidden=H, num_heads=NH, num_layers=L,
+                      max_len=MAXLEN, decode=False)
+    params = train.init(jax.random.PRNGKey(7),
+                        jnp.zeros((2, MAXLEN), jnp.int32))["params"]
+    return dec, params
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    chaos.disarm()
+
+
+def _solo(dec, params, prompt, max_new):
+    out = generation.generate_jit(
+        dec, params, jnp.asarray([prompt], jnp.int32), max_new)
+    return np.asarray(out)[0].tolist()
+
+
+def _post(url, payload, timeout=120, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers=dict({"Content-Type": "application/json"},
+                     **(headers or {})))
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get_json(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+# -- spec grammar (pure) ----------------------------------------------------
+
+def test_spec_grammar_parses_fields_and_windows():
+    spec = slo.SloSpec.parse(
+        "name=ttft,kind=latency,family=tfos_serving_ttft_seconds,"
+        "threshold=0.5,objective=0.99,tenant=acme,"
+        "fast=60/600/10,slow=300/3600/4")
+    assert spec.name == "ttft" and spec.kind == "latency"
+    assert spec.family == "tfos_serving_ttft_seconds"
+    assert spec.threshold == 0.5 and spec.objective == 0.99
+    assert spec.tenant == "acme"
+    assert spec.windows == ((60.0, 600.0, 10.0), (300.0, 3600.0, 4.0))
+    # defaults: DEFAULT_WINDOWS, the QoS default tenant
+    avail = slo.SloSpec.parse(
+        "name=a,kind=availability,family=tfos_fleet_requests,"
+        "objective=0.999")
+    assert avail.windows == slo.DEFAULT_WINDOWS
+    assert avail.tenant == qos.DEFAULT_TENANT
+    assert avail.threshold is None
+    # round-trip shape the /slo verdict and slo-lint read
+    assert avail.to_dict()["objective"] == 0.999
+
+
+@pytest.mark.parametrize("text,match", [
+    ("kind=latency,family=tfos_x,objective=0.9,threshold=1",
+     "missing name"),
+    ("name=x,kind=weird,family=tfos_x,objective=0.9", "kind"),
+    ("name=x,kind=latency,family=tfos_x,objective=0.9", "threshold"),
+    ("name=x,kind=latency,family=nope,objective=0.9,threshold=1",
+     "tfos_"),
+    ("name=x,kind=availability,family=tfos_x,objective=1.5",
+     "objective"),
+    ("name=x,kind=availability,family=tfos_x,objective=0.9,bogus=1",
+     "unknown spec fields"),
+    ("name=x,kind=availability,family=tfos_x,objective=0.9,"
+     "fast=600/60/10", "short window"),
+    ("name=x,kind=availability,family=tfos_x,objective=0.9,"
+     "fast=60/600", "short/long/burn"),
+])
+def test_spec_grammar_rejections(text, match):
+    with pytest.raises(ValueError, match=match):
+        slo.SloSpec.parse(text)
+
+
+def test_parse_specs_sources_and_duplicate_names():
+    assert [s.name for s in slo.parse_specs(None)] == \
+        ["availability", "ttft_p99", "token_p99"]
+    joined = ("name=a,kind=availability,family=tfos_fleet_requests,"
+              "objective=0.9;"
+              "name=b,kind=availability,family=tfos_fleet_requests,"
+              "objective=0.99")
+    assert [s.name for s in slo.parse_specs(joined)] == ["a", "b"]
+    ready = slo.parse_specs(joined)
+    assert [s.name for s in slo.parse_specs(ready)] == ["a", "b"]
+    with pytest.raises(ValueError, match="duplicate"):
+        slo.parse_specs(joined.replace("name=b", "name=a"))
+
+
+def test_latency_good_total_reads_bucket_bounds():
+    hist = tracing.Histogram(lo=1e-4, growth=2.0)
+    for value in (0.01, 0.01, 0.1, 3.0):
+        hist.observe(value)
+    snap = hist.snapshot()
+    good, total = slo.latency_good_total(snap, 0.5)
+    assert total == 4 and good == 3, "3.0 lands past the 0.5 bound"
+    # the +Inf overflow bucket is never good
+    good, _ = slo.latency_good_total(snap, 1e9)
+    assert good >= 3
+    assert slo.latency_good_total({}, 1.0) == (0, 0)
+    assert slo.latency_good_total(None, 1.0) == (0, 0)
+
+
+# -- SliSeries (pure) -------------------------------------------------------
+
+def test_sli_series_window_deltas_and_partial_window_honesty():
+    s = slo.SliSeries()
+    assert s.window(10.0, 5.0) is None, "one sample cannot difference"
+    s.record(0.0, 0, 0)
+    s.record(10.0, 8, 10)
+    # the series is younger than the window: difference against the
+    # oldest retained sample instead of silently reporting zero
+    assert s.window(10.0, 3600.0) == (8, 10)
+    s.record(20.0, 18, 20)
+    assert s.window(20.0, 10.0) == (10, 10), \
+        "baseline = latest sample at or before now - W"
+    assert s.burn_rate(20.0, 10.0, 0.9) == 0.0
+    # errors land: 0 good of 10 over the trailing 10s
+    s.record(30.0, 18, 30)
+    assert s.window(30.0, 10.0) == (0, 10)
+    assert s.burn_rate(30.0, 10.0, 0.9) == pytest.approx(10.0)
+
+
+def test_sli_series_counter_reset_and_time_travel():
+    s = slo.SliSeries()
+    s.record(0.0, 100, 100)
+    s.record(10.0, 2, 3)  # replica restarted; cumulative fell
+    assert s.window(10.0, 60.0) is None, \
+        "a negative delta must abstain, not alias as traffic"
+    assert s.burn_rate(10.0, 60.0, 0.9) is None
+    s.record(5.0, 50, 50)  # time travel is refused silently
+    assert s._samples[-1][0] == 10.0
+    # zero traffic in the window burns at 0: idle is not an outage
+    s.record(20.0, 2, 3)
+    assert s.burn_rate(20.0, 5.0, 0.9) == 0.0
+
+
+# -- burn-rate raise/clear hysteresis (pure, injected time) -----------------
+
+# objective 0.9 caps burn at 10x (a 100%-error outage), so thresholds
+# sit safely below that ceiling
+_FAST_SPEC = ("name=avail,kind=availability,family=tfos_fleet_requests,"
+              "objective=0.9,fast=30/120/4,slow=60/300/5")
+
+
+def _drive(engine, t0, seconds, good_per_s, bad_per_s, good=0, total=0):
+    t = t0
+    for _ in range(int(seconds)):
+        good += good_per_s
+        total += good_per_s + bad_per_s
+        engine.observe("avail", t, good, total)
+        t += 1.0
+    return t, good, total
+
+
+def test_burn_alert_requires_both_windows_hot_then_clears_on_short():
+    engine = slo.BurnRateAlerts(_FAST_SPEC)
+    t, good, total = _drive(engine, 0.0, 150, 2, 0)
+    verdicts, transitions = engine.evaluate(t)
+    assert not verdicts[0]["firing"] and transitions == []
+    assert verdicts[0]["error_budget_remaining"] == pytest.approx(1.0)
+    # a full outage: error fraction 1.0 -> burn 10x; the fast pair
+    # fires once the LONG window's blended fraction crosses too
+    t, good, total = _drive(engine, t, 60, 0, 2, good, total)
+    verdicts, transitions = engine.evaluate(t)
+    assert verdicts[0]["firing"]
+    assert [k for k, _ in transitions] == ["raise"]
+    fast = verdicts[0]["windows"][0]
+    assert fast["short_burn"] > 9.0 and fast["long_burn"] > 4.0
+    assert verdicts[0]["error_budget_remaining"] < 1.0
+    # heal: once every SHORT window recovers the alert clears, even
+    # though the long windows still remember the incident
+    t, good, total = _drive(engine, t, 90, 2, 0, good, total)
+    verdicts, transitions = engine.evaluate(t)
+    assert not verdicts[0]["firing"]
+    assert [k for k, _ in transitions] == ["clear"]
+    assert verdicts[0]["windows"][0]["long_burn"] > 0.0, \
+        "the long window keeps memory of the incident"
+    assert engine.alerts_total() == {"avail": 1}
+    # a second evaluation with no change is transition-free
+    assert engine.evaluate(t)[1] == []
+
+
+def test_burn_alert_short_spike_alone_does_not_page():
+    """A burst too short to move the long window never fires — the
+    whole point of the multi-window recipe."""
+    engine = slo.BurnRateAlerts(_FAST_SPEC)
+    t, good, total = _drive(engine, 0.0, 290, 2, 0)
+    # 3 bad seconds: short window hot, long window barely moved
+    t, good, total = _drive(engine, t, 3, 0, 2, good, total)
+    verdicts, _ = engine.evaluate(t)
+    fast = verdicts[0]["windows"][0]
+    assert fast["short_burn"] > 1.0
+    assert not verdicts[0]["firing"]
+    assert engine.alerts_total() == {"avail": 0}
+
+
+# -- critical-path attribution (pure, table-driven) -------------------------
+
+def _attr(spans):
+    report = slo.attribute_intervals(spans)
+    total = sum(report["stages"].values()) + report["unattributed_s"]
+    assert total == pytest.approx(report["wall_s"], abs=1e-9), \
+        "attribution must sum to wall by construction"
+    return report
+
+
+def test_attribution_plain_request():
+    report = _attr([
+        ("dispatch", 0.0, 10.0),
+        ("upstream", 0.5, 9.8),
+        ("request", 0.6, 9.7),
+        ("queue", 0.6, 1.6),
+        ("prefill", 1.6, 3.0),
+        ("decode", 3.0, 9.5),
+    ])
+    stages = report["stages"]
+    assert report["wall_s"] == pytest.approx(10.0)
+    assert stages["queue_wait"] == pytest.approx(1.0)
+    assert stages["prefill"] == pytest.approx(1.4)
+    assert stages["decode"] == pytest.approx(6.5)
+    # request-envelope time no finer span claims is admission:
+    # the [9.5, 9.7] tail after the decode span ends
+    assert stages["admission"] == pytest.approx(0.2)
+    # dispatch/upstream residue (pick, wire, bookkeeping) is router
+    assert stages["router_overhead"] == pytest.approx(0.9)
+    assert report["unattributed_s"] == 0.0
+
+
+def test_attribution_preempted_request_sums_to_wall():
+    report = _attr([
+        ("request", 0.0, 12.0),
+        ("queue", 0.0, 1.0),
+        ("prefill", 1.0, 2.0),
+        ("decode", 2.0, 5.0),
+        ("preempted", 5.0, 9.0),
+        ("prefill", 9.0, 9.5),   # re-admission re-prefills
+        ("decode", 9.5, 12.0),
+    ])
+    stages = report["stages"]
+    assert stages["preempted"] == pytest.approx(4.0)
+    assert stages["prefill"] == pytest.approx(1.5)
+    assert stages["decode"] == pytest.approx(5.5)
+    assert stages["queue_wait"] == pytest.approx(1.0)
+    assert stages["router_overhead"] == 0.0, "engine-only trace"
+
+
+def test_attribution_hedged_request_overlap_is_hedge_wait():
+    """Two upstream attempts racing: the overlap region is time spent
+    WAITING on the race, not router CPU — level 2 outranks upstream."""
+    report = _attr([
+        ("dispatch", 0.0, 5.0),
+        ("upstream", 0.1, 4.0),
+        ("upstream", 2.0, 4.5),
+    ])
+    stages = report["stages"]
+    assert stages["hedge_wait"] == pytest.approx(2.0), \
+        "the [2.0, 4.0] overlap is the hedge race"
+    assert stages["router_overhead"] == pytest.approx(3.0)
+
+
+def test_attribution_two_stage_disagg_kv_ship():
+    report = _attr([
+        ("dispatch", 0.0, 8.0),
+        ("upstream", 0.2, 2.0),   # prefill-tier attempt
+        ("kv.ship", 1.2, 1.9),
+        ("upstream", 2.1, 7.8),   # decode-tier attempt
+        ("request", 2.2, 7.7),
+        ("prefill", 2.3, 2.5),
+        ("decode", 2.5, 7.6),
+    ])
+    stages = report["stages"]
+    assert stages["kv_ship"] == pytest.approx(0.7)
+    assert stages["decode"] == pytest.approx(5.1)
+    assert stages["prefill"] == pytest.approx(0.2)
+
+
+def test_attribution_clamps_strays_and_handles_degenerates():
+    # spans outside the base dispatch window are clamped to it
+    report = _attr([
+        ("dispatch", 1.0, 3.0),
+        ("decode_step", 0.0, 10.0),  # engine-row span leaking in
+    ])
+    assert report["wall_s"] == pytest.approx(2.0)
+    assert report["stages"]["decode"] == pytest.approx(2.0)
+    # unknown span names are ignored; no spans at all is a zero report
+    empty = slo.attribute_intervals([("mystery", 0.0, 5.0)])
+    assert empty["wall_s"] == 0.0
+    assert sum(empty["stages"].values()) == 0.0
+
+
+def test_attribute_trace_reads_chrome_trace_microseconds():
+    doc = {"traceEvents": [
+        {"ph": "X", "tid": 7, "name": "request",
+         "ts": 1_000_000, "dur": 4_000_000},
+        {"ph": "X", "tid": 7, "name": "decode",
+         "ts": 2_000_000, "dur": 3_000_000},
+        {"ph": "X", "tid": 9, "name": "decode",  # another request
+         "ts": 0, "dur": 9_000_000},
+        {"ph": "M", "tid": 7, "name": "meta"},
+    ]}
+    report = slo.attribute_trace(doc, 7)
+    assert report["wall_s"] == pytest.approx(4.0)
+    assert report["stages"]["decode"] == pytest.approx(3.0)
+    assert report["stages"]["admission"] == pytest.approx(1.0)
+
+
+# -- SloMonitor against a fake router ---------------------------------------
+
+class _FakeRouter(object):
+    def __init__(self):
+        self.metrics = tracing.MetricsRegistry()
+        self.flight = tracing.FlightRecorder()
+        self.tallies = {}
+        self.views = [{"replica_id": "replica-0", "metrics": {}}]
+
+    def slo_tallies(self):
+        return {t: tuple(v) for t, v in self.tallies.items()}
+
+    def replica_views(self):
+        return list(self.views)
+
+
+class _FakeSupervisor(object):
+    def __init__(self):
+        self.incidents = []
+
+    def record_slo_incident(self, kind, detail, payload=None):
+        self.incidents.append((kind, detail, payload))
+
+
+def test_monitor_availability_burn_raises_and_forwards_incident():
+    router = _FakeRouter()
+    monitor = slo.SloMonitor(router, specs=_FAST_SPEC)
+    sup = _FakeSupervisor()
+    monitor.attach_supervisor(sup)
+    router.tallies["default"] = [100, 100]
+    monitor.sample(now=0.0)
+    router.tallies["default"] = [140, 140]
+    verdicts = monitor.sample(now=150.0)
+    assert not verdicts[0]["firing"] and monitor.firing() == []
+    # outage: only errors land
+    router.tallies["default"] = [140, 260]
+    verdicts = monitor.sample(now=210.0)
+    assert verdicts[0]["firing"] and monitor.firing() == ["avail"]
+    incidents = monitor.incidents()
+    assert incidents and incidents[-1]["kind"] == "slo_raise"
+    evidence = incidents[-1]["evidence"]
+    assert evidence["verdict"]["slo"] == "avail"
+    assert evidence["replicas"][0]["replica_id"] == "replica-0"
+    assert "flight" in evidence
+    assert sup.incidents and sup.incidents[0][0] == "slo_burn_rate"
+    assert monitor.max_fast_burn(now=210.0) >= 9.0
+    # heal clears, recording the clear but not paging the supervisor
+    router.tallies["default"] = [380, 500]
+    monitor.sample(now=300.0)
+    assert monitor.firing() == []
+    assert monitor.incidents()[-1]["kind"] == "slo_clear"
+    assert len(sup.incidents) == 1
+
+
+def test_monitor_latency_sli_sources_by_family():
+    """tfos_fleet_* reads the router's OWN histograms; tfos_serving_*
+    merges the beat-carried replica snapshots."""
+    router = _FakeRouter()
+    fleet_spec = ("name=wall,kind=latency,family=tfos_fleet_request_seconds,"
+                  "threshold=0.5,objective=0.9,fast=30/120/10,"
+                  "slow=60/300/5")
+    monitor = slo.SloMonitor(router, specs=fleet_spec)
+    hist = router.metrics.histogram("tfos_fleet_request_seconds")
+    for value in (0.01, 0.02, 2.0, 3.0):
+        hist.observe(value)
+    assert monitor._sli(monitor.specs[0]) == (2, 4)
+    serving_spec = ("name=ttft,kind=latency,"
+                    "family=tfos_serving_ttft_seconds,threshold=0.5,"
+                    "objective=0.9")
+    monitor2 = slo.SloMonitor(router, specs=serving_spec)
+    replica_hist = tracing.Histogram()
+    for value in (0.1, 0.2, 4.0):
+        replica_hist.observe(value)
+    snap = json.loads(json.dumps(replica_hist.snapshot()))
+    router.views = [
+        {"replica_id": "replica-0",
+         "metrics": {"hists": {"tfos_serving_ttft_seconds": snap}}},
+        {"replica_id": "replica-1",
+         "metrics": {"hists": {"tfos_serving_ttft_seconds": snap}}},
+    ]
+    assert monitor2._sli(monitor2.specs[0]) == (4, 6), \
+        "replica snapshots sum across the fleet"
+
+
+def test_monitor_metric_lines_render_openmetrics():
+    router = _FakeRouter()
+    monitor = slo.SloMonitor(router, specs=_FAST_SPEC)
+    router.tallies["default"] = [10, 10]
+    monitor.sample(now=0.0)
+    router.tallies["default"] = [20, 22]
+    lines = monitor.metric_lines(now=60.0)
+    text = "\n".join(lines)
+    assert "# TYPE tfos_slo_error_budget_remaining gauge" in text
+    assert 'tfos_slo_error_budget_remaining{slo="avail",' \
+        'tenant="default"}' in text
+    assert 'tfos_slo_burn_rate{slo="avail",tenant="default",' \
+        'window="30"}' in text
+    assert 'tfos_slo_alerts_total{slo="avail"} 0' in text
+    # a canary adds its counter families
+    prober = slo.CanaryProber("http://127.0.0.1:9/none", [1, 2])
+    monitor.attach_canary(prober)
+    text = "\n".join(monitor.metric_lines(now=120.0))
+    assert "tfos_slo_canary_probes_total 0" in text
+    assert "# TYPE tfos_slo_canary_drift counter" in text
+    assert monitor.verdict(now=180.0)["canary"]["counters"] == \
+        {"probes": 0, "failures": 0, "drift": 0}
+
+
+# -- canary prober against a stub server ------------------------------------
+
+class _StubHandler(http.server.BaseHTTPRequestHandler):
+    tokens = [3, 1, 4, 1]
+    fail_next = []           # mutable: pop -> fail this request
+    seen = []
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers["Content-Length"])))
+        type(self).seen.append(body)
+        if type(self).fail_next:
+            type(self).fail_next.pop()
+            self.send_error(503)
+            return
+        payload = json.dumps({"tokens": type(self).tokens}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def stub_server():
+    _StubHandler.tokens = [3, 1, 4, 1]
+    _StubHandler.fail_next = []
+    _StubHandler.seen = []
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _StubHandler)
+    thread = threading.Thread(target=srv.serve_forever,
+                              name="slo-stub-http", daemon=True)
+    thread.start()
+    yield "http://127.0.0.1:%d/generate" % srv.server_address[1]
+    srv.shutdown()
+    srv.server_close()
+    thread.join(timeout=10)
+
+
+def test_canary_pins_expected_and_detects_drift(stub_server):
+    drifts = []
+    prober = slo.CanaryProber(stub_server, [1, 2, 3], max_new_tokens=4,
+                              on_drift=lambda rec, exp:
+                              drifts.append((rec, exp)))
+    first = prober.probe_once()
+    assert first["ok"] and prober.expected == [3, 1, 4, 1]
+    # the probe travels as the reserved low-priority canary tenant
+    sent = _StubHandler.seen[0]
+    assert sent["tenant"] == slo.CANARY_TENANT == "slo-canary"
+    assert sent["priority"] == "low"
+    assert sent["prompt"] == [1, 2, 3]
+    # stable output: no drift
+    assert not prober.probe_once()["drift"]
+    # the engine goes numerically wrong: bitwise mismatch = drift
+    _StubHandler.tokens = [3, 1, 4, 2]
+    record = prober.probe_once()
+    assert record["drift"] and drifts and drifts[0][1] == [3, 1, 4, 1]
+    # failures count but never repin or drift
+    _StubHandler.tokens = [3, 1, 4, 1]
+    _StubHandler.fail_next = [True]
+    assert not prober.probe_once()["ok"]
+    assert prober.counters() == \
+        {"probes": 4, "failures": 1, "drift": 1}
+    assert prober.sli() == (3, 4)
+    assert prober.expected == [3, 1, 4, 1], "a failure must not repin"
+
+
+def test_canary_background_loop_and_monitor_drift_incident(stub_server):
+    router = _FakeRouter()
+    monitor = slo.SloMonitor(router, specs=_FAST_SPEC)
+    sup = _FakeSupervisor()
+    monitor.attach_supervisor(sup)
+    prober = monitor.attach_canary(
+        slo.CanaryProber(stub_server, [5, 6], interval=0.02))
+    assert prober.on_drift is not None, \
+        "attach_canary wires drift into the monitor"
+    prober.start()
+    assert chaos.poll_until(
+        lambda: prober.counters()["probes"] >= 3, timeout=10)
+    _StubHandler.tokens = [9, 9, 9, 9]
+    assert chaos.poll_until(
+        lambda: prober.counters()["drift"] >= 1, timeout=10)
+    prober.stop()
+    assert prober._thread is None
+    kinds = [i["kind"] for i in monitor.incidents()]
+    assert "slo_canary_drift" in kinds
+    assert any(k == "slo_canary_drift" for k, _, _ in sup.incidents)
+
+
+# -- e2e: live fleet ---------------------------------------------------------
+
+# tiny windows so the e2e fits in seconds; the SLI is ROUTER-observed
+# request wall (tfos_fleet_request_seconds), which includes the gray
+# link's injected delay — engine-side clocks never see it
+_E2E_SPEC = ("name=wall,kind=latency,family=tfos_fleet_request_seconds,"
+             "threshold=0.25,objective=0.9,fast=2/8/2,slow=4/16/1.5")
+
+
+@pytest.mark.slow
+def test_gray_replica_trips_burn_alert_then_heals(lm):
+    """THE tentpole pin: a gray replica (alive, beating, slow on the
+    wire) trips the fast-window burn alert on the router-observed
+    latency SLO; the raise incident carries the offending replica's
+    snapshot; healing the link clears the alert."""
+    dec, params = lm
+    with fleet.ServingFleet(dec, params, replicas=1, name="model",
+                            engine_kw={"slots": 2},
+                            router_kw={"slo": _E2E_SPEC}) as f:
+        url = f.url("/v1/models/model:generate")
+        monitor = f.router.slo
+        for i in range(4):  # warm + healthy traffic under the bound
+            status, _ = _post(url, {"prompt": [1 + i, 2],
+                                    "max_new_tokens": 2})
+            assert status == 200
+        # injected clock: SliSeries only needs the cumulative counts,
+        # so the windows can be driven without waiting wall time
+        monitor.sample(now=0.0)
+        verdicts = monitor.sample(now=1.0)
+        assert not verdicts[0]["firing"], "healthy fleet must not page"
+        chaos.arm("net_delay=0.6,only=router:replica-0")
+        for i in range(4):  # every request rides the gray link
+            status, _ = _post(url, {"prompt": [5 + i, 6],
+                                    "max_new_tokens": 2})
+            assert status == 200, "gray is slow, not down"
+        chaos.disarm()
+        verdicts = monitor.sample(now=3.0)
+        assert verdicts[0]["firing"], \
+            "short window all-bad + long window blended must page"
+        assert monitor.firing() == ["wall"]
+        incident = monitor.incidents()[-1]
+        assert incident["kind"] == "slo_raise"
+        replicas = incident["evidence"]["replicas"]
+        assert any(v["replica_id"] == "replica-0" for v in replicas), \
+            "the raise evidence carries the offending replica snapshot"
+        assert incident["evidence"]["verdict"]["windows"][0]["firing"]
+        # heal: healthy traffic, short window recovers, alert clears
+        monitor.sample(now=18.0)
+        for i in range(4):
+            status, _ = _post(url, {"prompt": [9 + i, 3],
+                                    "max_new_tokens": 2})
+            assert status == 200
+        verdicts = monitor.sample(now=19.5)
+        assert not verdicts[0]["firing"], "the heal must clear the page"
+        assert monitor.incidents()[-1]["kind"] == "slo_clear"
+        assert monitor.engine.alerts_total() == {"wall": 1}
+
+
+@pytest.mark.slow
+def test_slo_endpoint_and_scrape_families_on_live_fleet(lm):
+    dec, params = lm
+    with fleet.ServingFleet(dec, params, replicas=2, name="model",
+                            engine_kw={"slots": 2}) as f:
+        url = f.url("/v1/models/model:generate")
+        prober = f.router.slo.attach_canary(slo.CanaryProber(
+            url, [2, 3], max_new_tokens=2))
+        record = prober.probe_once()
+        assert record["ok"], record
+        assert prober.probe_once()["tokens"] == record["tokens"], \
+            "temp=0 canary output must be bitwise-stable"
+        assert prober.counters()["drift"] == 0
+        # GET /slo: the verdict document
+        status, verdict = _get_json(f.url("/slo"))
+        assert status == 200
+        assert [s["slo"] for s in verdict["specs"]] == \
+            ["availability", "ttft_p99", "token_p99"]
+        assert verdict["canary"]["counters"]["probes"] >= 2
+        assert verdict["canary"]["expected_pinned"]
+        assert verdict["firing"] == []
+        # /metrics renders the tfos_slo_* families beside the fleet's
+        with urllib.request.urlopen(f.url("/metrics"), timeout=30) as r:
+            text = r.read().decode()
+        assert "# TYPE tfos_slo_burn_rate gauge" in text
+        assert 'tfos_slo_alerts_total{slo="availability"} 0' in text
+        assert "tfos_slo_canary_probes_total" in text
+        # the dispatch tallies behind the availability SLI: canary
+        # probes tally under THEIR reserved tenant, not the default
+        tallies = f.router.slo_tallies()
+        assert tallies[slo.CANARY_TENANT][1] >= 2
+        assert tallies[slo.CANARY_TENANT][0] == \
+            tallies[slo.CANARY_TENANT][1], "all probes succeeded"
+
+
+@pytest.mark.slow
+def test_preempted_engine_request_attribution_sums_to_wall(lm):
+    """A LOW admission preempted by a HIGH arrival: its flight spans
+    attribute queue/prefill/decode/preempted and sum to the request's
+    wall within the acceptance bound (2%)."""
+    dec, params = lm
+    with serving.DecodeEngine(dec, params, slots=2, kv_block_size=8,
+                              kv_blocks=16, prefix_cache=False) as eng:
+        lows = [eng.submit([1 + i, 2, 3], 24, tenant="bg",
+                           priority="low") for i in range(2)]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if eng.load_stats()["slot_occupancy"] == 2:
+                break
+            time.sleep(0.005)
+        high = eng.submit([9, 8, 7], 4, tenant="vip", priority="high")
+        assert high.result(120) == _solo(dec, params, [9, 8, 7], 4)
+        for i, h in enumerate(lows):
+            assert h.result(120) == _solo(dec, params, [1 + i, 2, 3], 24)
+        assert sum(eng.qos_tallies()["preemptions"].values()) >= 1
+        doc = eng.flight.chrome_trace()
+        preempted = None
+        for handle in lows:
+            report = slo.attribute_trace(doc, handle.trace)
+            if report["stages"]["preempted"] > 0:
+                preempted = report
+        assert preempted is not None, \
+            "one LOW request must carry a preempted span"
+        stages = preempted["stages"]
+        assert stages["decode"] > 0 and stages["prefill"] > 0
+        total = sum(stages.values()) + preempted["unattributed_s"]
+        assert abs(total - preempted["wall_s"]) <= \
+            0.02 * preempted["wall_s"] + 1e-9
+        # the engine feeds the same sweep into the scrape histograms
+        hist = eng.metrics.get_histogram("tfos_slo_attrib_preempted_seconds")
+        assert hist is not None and hist.snapshot().get("n", 0) >= 1
+
+
+@pytest.mark.slow
+def test_hedged_request_attribution_carries_hedge_wait(lm):
+    """A hedge racing a gray primary shows up as hedge_wait in the
+    stitched-trace attribution, and the router's hedge_wait histogram
+    observes it."""
+    dec, params = lm
+    with fleet.ServingFleet(
+            dec, params, replicas=2, name="model",
+            engine_kw={"slots": 2},
+            router_kw={"hedge_quantile": 0.95, "hedge_min_samples": 4,
+                       "hedge_min_delay": 0.05}) as f:
+        url = f.url("/v1/models/model:generate")
+        for i in range(6):
+            _post(url, {"prompt": [1 + (i % 3), 2], "max_new_tokens": 2})
+        assert f.router._hedge_delay() is not None
+        target = fleet.route_order(f.router.replica_views(),
+                                   f.router.stale_after)[0]
+        chaos.arm("net_delay=2.0,only=router:{}".format(target))
+        status, _ = _post(url, {"prompt": [7, 8, 9],
+                                "max_new_tokens": 4})
+        chaos.disarm()
+        assert status == 200
+        assert f.router.counters.snapshot()["counts"].get("hedges", 0) >= 1
+
+        # the losing attempt's upstream span lands when its (delayed)
+        # thread completes — poll until the stitched doc carries the
+        # overlap instead of racing it
+        found = [None]
+
+        def _hedged_report():
+            _, doc = _get_json(f.url("/debug/trace"))
+            for event in doc["traceEvents"]:
+                if event.get("ph") != "X" or int(event.get("tid", 0)) <= 0:
+                    continue
+                report = slo.attribute_trace(doc, int(event["tid"]))
+                if report["stages"]["hedge_wait"] > 0:
+                    found[0] = report
+                    return True
+            return False
+
+        assert chaos.poll_until(_hedged_report, timeout=15), \
+            "the hedged request must attribute hedge_wait"
+        hedged = found[0]
+        total = sum(hedged["stages"].values()) + hedged["unattributed_s"]
+        assert abs(total - hedged["wall_s"]) <= \
+            0.02 * hedged["wall_s"] + 1e-9
+        hist = f.router.metrics.get_histogram(
+            "tfos_slo_attrib_hedge_wait_seconds")
+        assert hist is not None and hist.snapshot().get("n", 0) >= 1
+
+
+class _EmptyReservation(object):
+    def serving_snapshot(self):
+        return {}
+
+
+def test_affinity_reset_counter_renders_on_scrape():
+    router = fleet.FleetRouter(_EmptyReservation())
+    router._note_affinity_reset("takeover")
+    router._note_affinity_reset("restart")
+    router._note_affinity_reset("restart")
+    text = router.metrics_text()
+    assert "# TYPE tfos_fleet_affinity_resets counter" in text
+    assert 'tfos_fleet_affinity_resets_total{reason="takeover"} 1' in text
+    assert 'tfos_fleet_affinity_resets_total{reason="restart"} 2' in text
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_canary_never_displaces_real_traffic(lm):
+    """The `make chaos` leg: an aggressive canary loop against a live
+    fleet while a real tenant sends traffic — every real request
+    succeeds, the canary stays bitwise-stable, and the real tenant's
+    p99 is not displaced (generous CI bound; bench publishes the
+    strict <=1.05x ratio)."""
+    dec, params = lm
+    with fleet.ServingFleet(dec, params, replicas=2, name="model",
+                            engine_kw={"slots": 2}) as f:
+        url = f.url("/v1/models/model:generate")
+
+        def run_real(n):
+            walls = []
+            for i in range(n):
+                t0 = time.monotonic()
+                status, body = _post(url, {"prompt": [1 + (i % 5), 2, 3],
+                                           "max_new_tokens": 3,
+                                           "tenant": "prod"})
+                walls.append(time.monotonic() - t0)
+                assert status == 200
+                assert body["tokens"] == \
+                    _solo(dec, params, [1 + (i % 5), 2, 3], 3)
+            walls.sort()
+            return walls[int(0.99 * (len(walls) - 1))]
+
+        run_real(4)  # warm both replicas
+        baseline_p99 = run_real(12)
+        prober = f.router.slo.attach_canary(slo.CanaryProber(
+            url, [4, 5], max_new_tokens=2, interval=0.05))
+        prober.start()
+        try:
+            canary_p99 = run_real(12)
+        finally:
+            prober.stop()
+        counters = prober.counters()
+        assert counters["probes"] >= 2, "the canary must actually run"
+        assert counters["drift"] == 0, "canary output drifted"
+        assert counters["failures"] == 0, \
+            "canary probes must succeed against a healthy fleet"
+        # zero displacement: the bound is generous for CI timing noise;
+        # the bench.py serving_fleet.slo leg publishes the strict ratio
+        assert canary_p99 <= max(1.5 * baseline_p99, baseline_p99 + 0.25), \
+            (baseline_p99, canary_p99)
